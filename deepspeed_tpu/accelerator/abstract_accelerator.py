@@ -1,0 +1,118 @@
+"""Accelerator HAL.
+
+Analogue of reference ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator`` ABC). The surface is reshaped for XLA: JAX owns
+streams/events (async dispatch) and RNG (explicit keys), so those APIs become
+fences and key helpers; memory queries come from device ``memory_stats()``.
+"""
+
+import abc
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # Device APIs
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    def set_device(self, device_index):
+        pass
+
+    def current_device(self):
+        return 0
+
+    def current_device_name(self):
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    # RNG APIs — JAX RNG is explicit keys; these helpers exist for parity
+    @abc.abstractmethod
+    def manual_seed(self, seed):
+        ...
+
+    def initial_seed(self):
+        return self._seed
+
+    # Memory APIs
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None):
+        ...
+
+    def memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    def reset_peak_memory_stats(self, device_index=None):
+        pass
+
+    def empty_cache(self):
+        pass
+
+    # Dtype APIs
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    # Misc
+    @abc.abstractmethod
+    def communication_backend_name(self):
+        ...
+
+    def is_triton_supported(self):
+        return False
+
+    def use_host_timers(self):
+        return True
+
+    # Profiler range markers (NVTX equivalent: jax named scopes / trace
+    # annotations, reference utils/nvtx.py)
+    def range_push(self, msg):
+        pass
+
+    def range_pop(self):
+        pass
+
+    def lazy_call(self, callback):
+        callback()
+
+    def pin_memory(self, tensor, align_bytes=1):
+        return tensor
+
+    def is_pinned(self, tensor):
+        return False
+
+    def on_accelerator(self, tensor):
+        return False

@@ -1,0 +1,58 @@
+"""CPU accelerator: used for tests on a virtual CPU device mesh and for
+host-side buffers (offload targets)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "gloo"
+        self._seed = 0
+
+    def device_name(self, device_index=None):
+        return "cpu"
+
+    def device(self, device_index=None):
+        return jax.devices("cpu")[device_index or 0]
+
+    def device_count(self):
+        return len(jax.devices("cpu"))
+
+    def synchronize(self, device_index=None):
+        pass
+
+    def manual_seed(self, seed):
+        self._seed = seed
+
+    def rng_key(self):
+        return jax.random.key(self._seed)
+
+    def memory_stats(self, device_index=None):
+        try:
+            import psutil
+            vm = psutil.virtual_memory()
+            return {"bytes_in_use": vm.used, "bytes_limit": vm.total, "peak_bytes_in_use": vm.used}
+        except Exception:
+            return {}
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return False
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16]
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def peak_flops(self, dtype=jnp.bfloat16):
+        return 1e12

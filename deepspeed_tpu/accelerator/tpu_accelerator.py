@@ -1,0 +1,98 @@
+"""Concrete TPU accelerator (the CUDA_Accelerator analogue,
+reference ``accelerator/cuda_accelerator.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+        self._seed = 0
+
+    def _devices(self):
+        return jax.local_devices()
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index=None):
+        devs = self._devices()
+        return devs[device_index or 0]
+
+    def device_count(self):
+        return jax.device_count()
+
+    def local_device_count(self):
+        return jax.local_device_count()
+
+    def synchronize(self, device_index=None):
+        jax.block_until_ready(jax.device_put(np.zeros(()), self.device(device_index)))
+
+    def manual_seed(self, seed):
+        self._seed = seed
+
+    def rng_key(self):
+        return jax.random.key(self._seed)
+
+    def memory_stats(self, device_index=None):
+        try:
+            return self.device(device_index).memory_stats() or {}
+        except Exception:
+            return {}
+
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True  # emulated via f32 accumulate; bf16 is the native type
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.float8_e4m3fn, jnp.float8_e5m2]
+
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    def on_accelerator(self, tensor):
+        try:
+            return any(d.platform != "cpu" for d in tensor.devices())
+        except Exception:
+            return False
+
+    def range_push(self, msg):
+        ann = jax.profiler.TraceAnnotation(msg)
+        ann.__enter__()
+        self._range_stack = getattr(self, "_range_stack", [])
+        self._range_stack.append(ann)
+
+    def range_pop(self):
+        stack = getattr(self, "_range_stack", [])
+        if stack:
+            stack.pop().__exit__(None, None, None)
+
+    def device_kind(self):
+        devs = self._devices()
+        return devs[0].device_kind if devs else "unknown"
+
+    def peak_flops(self, dtype=jnp.bfloat16):
+        """Peak per-chip matmul FLOP/s for MFU math (best-effort by kind)."""
+        kind = self.device_kind().lower()
+        table = {
+            "v5 lite": 394e12,  # v5e bf16
+            "v5litepod": 394e12,
+            "v4": 275e12,
+            "v5p": 459e12,
+            "v6": 918e12,  # trillium
+        }
+        for k, v in table.items():
+            if k in kind:
+                return v
+        return 275e12

@@ -1,0 +1,12 @@
+"""``deepspeed_tpu.comm`` — functional collectives over mesh axes.
+
+Usable as ``import deepspeed_tpu.comm as dist`` for reference API parity
+(``deepspeed/comm/__init__.py``).
+"""
+from .comm import *  # noqa: F401,F403
+from .comm import (  # noqa: F401
+    ReduceOp, init_distributed, is_initialized, get_world_size, get_rank, get_local_rank, barrier, all_reduce,
+    all_gather, all_gather_into_tensor, reduce_scatter, reduce_scatter_tensor, all_to_all, all_to_all_single,
+    broadcast, reduce, ppermute, send_recv_next, send_recv_prev, axis_index, axis_size, initialize_mesh, get_mesh,
+    set_mesh, has_mesh, mesh_context, new_group, configure, log_summary, host_broadcast, host_allgather,
+    PIPE_AXIS, EXPERT_AXIS, DATA_AXIS, SEQ_AXIS, TENSOR_AXIS, DP_AXES, MESH_AXES, WORLD)

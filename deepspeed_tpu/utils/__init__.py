@@ -1,0 +1,3 @@
+from .logging import logger, log_dist, print_rank_0  # noqa: F401
+from .timer import SynchronizedWallClockTimer, ThroughputTimer, NoopTimer  # noqa: F401
+from . import groups  # noqa: F401

@@ -1,0 +1,91 @@
+"""Comms logger.
+
+Analogue of reference ``deepspeed/utils/comms_logging.py`` (``CommsLogger``
+:61, ``calc_bw_log`` :28). Inside a compiled XLA program per-op latency is
+not host-observable, so records are made at *trace time* (op, group, message
+size) with algorithmic-bandwidth estimates left to the profiler; the summary
+table reports op counts and total bytes per (op, group, size) bucket.
+"""
+
+from .logging import logger
+
+
+def get_caller_func(frame=3):
+    import sys
+    return sys._getframe(frame).f_code.co_name
+
+
+def convert_size(size_bytes):
+    import math
+    if size_bytes == 0:
+        return "0B"
+    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    s = round(size_bytes / p, 2)
+    return "%s %s" % (s, size_name[i])
+
+
+def calc_bw_log(comm_op, size, duration, n):
+    """Algorithmic and bus bandwidth (Gbps) for a collective.
+
+    Mirrors the reference formulas (``utils/comms_logging.py:28``): ring
+    all-reduce moves 2(n-1)/n of the data, gather/scatter move the full
+    gathered size. Consumed by measured-latency paths (host-timed collectives
+    in benches/profiling); trace-time logging records sizes only.
+    """
+    duration = max(duration, 1e-9)
+    if comm_op in ("all_to_all", "all_to_all_single"):
+        tput = (size / duration) * 8
+        busbw = (size / duration) * ((n - 1) / n) * 8
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor"):
+        size *= n
+        tput = (size / duration) * 8
+        busbw = (size / duration) * ((n - 1) / n) * 8
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        tput = (size * 2 / duration) * 8
+        busbw = (size / duration) * (2 * (n - 1) / n) * 8
+    else:
+        tput = (size / duration) * 8
+        busbw = tput
+    return tput * 1e-9, busbw * 1e-9
+
+
+class CommsLogger:
+
+    def __init__(self, comms_config=None):
+        if comms_config is not None:
+            self.enabled = comms_config.enabled
+            self.prof_all = comms_config.prof_all
+            self.debug = comms_config.debug
+            self.prof_ops = comms_config.prof_ops or []
+            self.verbose = comms_config.verbose
+        else:
+            self.enabled = False
+            self.prof_all = True
+            self.debug = False
+            self.prof_ops = []
+            self.verbose = False
+        # {op_name: {group: {size: count}}}
+        self.comms_dict = {}
+
+    def append(self, op_name, group, size):
+        if self.prof_ops and op_name not in self.prof_ops:
+            return
+        per_op = self.comms_dict.setdefault(op_name, {})
+        per_group = per_op.setdefault(group, {})
+        per_group[size] = per_group.get(size, 0) + 1
+        if self.verbose:
+            logger.info(f"comm op: {op_name} | group: {group} | msg size: {convert_size(size)}")
+
+    def log_all(self, print_log=True):
+        lines = [f"{'Comm. Op':20s} {'Group':30s} {'Message Size':15s} {'Trace Count':12s} {'Total Bytes':15s}"]
+        for op_name, groups in self.comms_dict.items():
+            for group, sizes in groups.items():
+                for size, count in sorted(sizes.items()):
+                    lines.append(f"{op_name:20s} {group:30s} {convert_size(size):15s} {count:<12d} "
+                                 f"{convert_size(size * count):15s}")
+        summary = "\n".join(lines)
+        if print_log:
+            logger.info("Communication trace summary\n" + summary)
+        return summary

@@ -1,0 +1,101 @@
+"""Parallel group registry.
+
+Analogue of reference ``deepspeed/utils/groups.py`` (expert/data/model group
+creation :46,59,108,202). Process groups are mesh axis names here; this
+module keeps the reference's naming and answers "which axis (group) do I
+reduce over" questions for the engine and MoE layers.
+"""
+
+from ..comm import comm as dist
+from ..utils.logging import log_dist
+
+# registry: expert-group name -> ep size (parity with ref's dict of groups
+# keyed by "ep_size_{N}")
+_EXPERT_PARALLEL_GROUP = {}
+_WORLD_GROUP = None
+_mpu = None
+
+
+def initialize(ep_size=1, mpu=None):
+    """Reference ``groups.initialize`` — on TPU, expert parallelism is the
+    ``expert`` mesh axis; its size is fixed at mesh construction."""
+    global _mpu
+    _mpu = mpu
+    _create_expert_and_data_parallel(ep_size)
+
+
+def _create_expert_and_data_parallel(expert_parallel_size_):
+    name = f"ep_size_{expert_parallel_size_}"
+    if name not in _EXPERT_PARALLEL_GROUP:
+        mesh_ep = dist.get_mesh().shape[dist.EXPERT_AXIS] if dist.has_mesh() else 1
+        if expert_parallel_size_ not in (1, mesh_ep):
+            log_dist(
+                f"Requested ep_size={expert_parallel_size_} but mesh expert axis is {mesh_ep}; "
+                f"collectives run over the mesh axis", [0])
+        _EXPERT_PARALLEL_GROUP[name] = dist.EXPERT_AXIS
+    return _EXPERT_PARALLEL_GROUP[name]
+
+
+def _get_max_expert_size():
+    return max([int(name.split("_")[-1]) for name in _EXPERT_PARALLEL_GROUP] or [1])
+
+
+def get_expert_parallel_group(group_name=None):
+    return dist.EXPERT_AXIS
+
+
+def get_expert_data_parallel_group(group_name=None):
+    return dist.DATA_AXIS
+
+
+def get_data_parallel_group():
+    """DP group for non-expert parameters: expert × data axes."""
+    return dist.DP_AXES
+
+
+def get_model_parallel_group():
+    return dist.TENSOR_AXIS
+
+
+get_tensor_model_parallel_group = get_model_parallel_group
+
+
+def get_sequence_parallel_group():
+    return dist.SEQ_AXIS
+
+
+def get_pipeline_parallel_group():
+    return dist.PIPE_AXIS
+
+
+def get_expert_parallel_world_size(group_name=None):
+    return dist.get_world_size(dist.EXPERT_AXIS)
+
+
+def get_expert_data_parallel_world_size(group_name=None):
+    return dist.get_world_size(dist.DATA_AXIS)
+
+
+def get_data_parallel_world_size():
+    return dist.get_world_size(dist.DP_AXES)
+
+
+def get_model_parallel_world_size():
+    return dist.get_world_size(dist.TENSOR_AXIS)
+
+
+def get_sequence_parallel_world_size():
+    return dist.get_world_size(dist.SEQ_AXIS)
+
+
+def get_pipeline_parallel_world_size():
+    return dist.get_world_size(dist.PIPE_AXIS)
+
+
+def get_data_parallel_rank():
+    # host-context: meaningful per-chip only inside shard_map
+    return 0
+
+
+def get_world_size():
+    return dist.get_world_size()

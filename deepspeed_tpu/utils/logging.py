@@ -1,0 +1,95 @@
+"""Rank-aware logging.
+
+TPU-native analogue of the reference's ``deepspeed/utils/logging.py``
+(``logger`` / ``log_dist`` rank-filtered logging). Process identity comes from
+``jax.process_index()`` instead of ``torch.distributed.get_rank()``.
+"""
+
+import functools
+import logging
+import os
+import sys
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class LoggerFactory:
+
+    @staticmethod
+    def create_logger(name=None, level=logging.INFO):
+        if name is None:
+            raise ValueError("name for logger cannot be None")
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s:%(lineno)d:%(funcName)s] %(message)s")
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            ch = logging.StreamHandler(stream=sys.stdout)
+            ch.setLevel(level)
+            ch.setFormatter(formatter)
+            logger_.addHandler(ch)
+        return logger_
+
+
+logger = LoggerFactory.create_logger(
+    name="DeepSpeedTPU", level=log_levels.get(os.environ.get("DSTPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+def _process_index():
+    # Not cached: the index can change from 0 after jax.distributed.initialize()
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def _should_log(ranks):
+    if ranks is None:
+        ranks = [-1]
+    my_rank = _process_index()
+    return my_rank in ranks or -1 in ranks
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the listed process ranks (``-1`` = all).
+
+    Mirrors the reference ``log_dist`` semantics but keyed on JAX process
+    index (one process per host on TPU, not one per chip).
+    """
+    if _should_log(ranks):
+        logger.log(level, f"[Rank {_process_index()}] {message}")
+
+
+def print_rank_0(message):
+    if _process_index() == 0:
+        print(message, flush=True)
+
+
+def warning_once(message):
+    _warn_cache(message)
+
+
+@functools.lru_cache(None)
+def _warn_cache(message):
+    logger.warning(message)
+
+
+def get_current_level():
+    return logger.getEffectiveLevel()
+
+
+def should_log_le(max_log_level_str):
+    if not isinstance(max_log_level_str, str):
+        raise ValueError("max_log_level_str must be a string")
+    max_log_level_str = max_log_level_str.lower()
+    if max_log_level_str not in log_levels:
+        raise ValueError(f"{max_log_level_str} is not one of the `logging` levels")
+    return get_current_level() <= log_levels[max_log_level_str]

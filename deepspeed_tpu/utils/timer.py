@@ -1,0 +1,239 @@
+"""Wall-clock + throughput timers.
+
+TPU-native analogue of the reference ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` :33, ``ThroughputTimer`` :137). CUDA events do
+not exist here; device-synchronized timing is achieved by fencing with
+``block_until_ready`` on a marker array when ``synchronized=True``.
+"""
+
+import time
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+BACKWARD_INNER_MICRO_TIMER = "bwd_inner_microstep"
+BACKWARD_INNER_GLOBAL_TIMER = "bwd_inner"
+BACKWARD_REDUCE_MICRO_TIMER = "bwd_allreduce_microstep"
+BACKWARD_REDUCE_GLOBAL_TIMER = "bwd_allreduce"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+try:
+    import psutil
+    PSUTIL_AVAILABLE = True
+except ImportError:
+    PSUTIL_AVAILABLE = False
+
+
+def _device_sync():
+    """Fence: wait for all enqueued device work to complete."""
+    try:
+        import jax
+        # effectively a full-device fence on the default device
+        jax.block_until_ready(jax.device_put(0.0))
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers, optionally fenced against async device work."""
+
+    class Timer:
+
+        def __init__(self, name):
+            self.name_ = name
+            self.started_ = False
+            self.start_time = time.time()
+            self.elapsed_records = []
+
+        def start(self, synchronize=False):
+            assert not self.started_, f"{self.name_} timer has already been started"
+            if synchronize:
+                _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset=False, record=True, synchronize=False):
+            assert self.started_, "timer is not started"
+            if synchronize:
+                _device_sync()
+            elapsed = time.time() - self.start_time
+            if record:
+                self.elapsed_records.append(elapsed)
+            self.started_ = False
+
+        def _get_elapsed_msec(self):
+            return sum(self.elapsed_records) * 1000.0
+
+        def reset(self):
+            self.started_ = False
+            self.elapsed_records = []
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if self.started_:
+                self.stop()
+            elapsed = self._get_elapsed_msec()
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed
+
+        def mean(self):
+            if not self.elapsed_records:
+                return 0.0
+            return sum(self.elapsed_records) / len(self.elapsed_records) * 1000.0
+
+    def __init__(self):
+        self.timers = {}
+
+    def get_timers(self):
+        return self.timers
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    @staticmethod
+    def memory_usage():
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats() or {}
+            alloc = stats.get("bytes_in_use", 0) / (1024**3)
+            peak = stats.get("peak_bytes_in_use", 0) / (1024**3)
+            return f"DeviceMem: alloc {alloc:.4f} GB, peak {peak:.4f} GB"
+        except Exception:
+            return "DeviceMem: unavailable"
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        from .logging import log_dist
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].elapsed(reset=reset) / normalizer
+                string += " | {}: {:.2f}".format(name, elapsed_time)
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                elapsed_time = self.timers[name].mean() * 1.0 / normalizer
+                means[name] = elapsed_time
+        return means
+
+
+class NoopTimer:
+
+    class Timer:
+
+        def start(self, **kwargs):
+            ...
+
+        def reset(self):
+            ...
+
+        def stop(self, **kwargs):
+            ...
+
+        def elapsed(self, **kwargs):
+            return 0
+
+        def mean(self):
+            return 0
+
+    def __init__(self):
+        self.timer = self.Timer()
+
+    def __call__(self, name):
+        return self.timer
+
+    def get_timers(self):
+        return {}
+
+    def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
+        ...
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        ...
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS estimate (reference ``utils/timer.py:137``)."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn
+        if self.logging is None:
+            from .logging import logger
+            self.logging = logger.info
+        self.initialized = False
+        if self.monitor_memory and not PSUTIL_AVAILABLE:
+            self.monitor_memory = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step:
+                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    self.logging("epoch={}/micro_step={}/global_step={}, RunningAvgSamplesPerSec={}, "
+                                 "CurrSamplesPerSec={}".format(self.epoch_count, self.micro_step_count,
+                                                               self.global_step_count, self.avg_samples_per_sec(),
+                                                               self.batch_size / self.step_elapsed_time))
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > 0 and self.total_elapsed_time > 0:
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            return self.batch_size / avg_time_per_step
+        return float("-inf")
+
+
+def trim_mean(data, trim_percent):
+    """Compute the trimmed mean of a list of numbers."""
+    assert 0.0 <= trim_percent <= 1.0
+    n = len(data)
+    data.sort()
+    k = int(round(n * trim_percent))
+    return sum(data[k:n - k]) / max(1, n - 2 * k)

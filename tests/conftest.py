@@ -1,0 +1,26 @@
+"""Pytest plumbing: force an 8-device virtual CPU mesh so DP/TP/PP/EP/SP
+logic runs under pytest without a pod (SURVEY §4 'implications').
+
+Note: the session environment pins JAX_PLATFORMS=axon (the real TPU tunnel)
+and a sitecustomize imports jax before this file runs, so plain env vars are
+already latched — use jax.config.update instead, which works as long as no
+backend has been initialized yet.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_mesh():
+    yield
+    from deepspeed_tpu.comm import comm
+    comm._state["mesh"] = None

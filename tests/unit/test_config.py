@@ -1,0 +1,90 @@
+"""Config parsing + batch-size arithmetic (parity with
+tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_resolution_all_given():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+                           "gradient_accumulation_steps": 8}, world_size=1)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 8
+
+
+def test_batch_resolution_micro_only():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4}, world_size=4)
+    assert cfg.train_batch_size == 16
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_resolution_train_and_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 64, "train_micro_batch_size_per_gpu": 4}, world_size=2)
+    assert cfg.gradient_accumulation_steps == 8
+
+
+def test_batch_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4,
+                         "gradient_accumulation_steps": 8}, world_size=1)
+
+
+def test_no_batch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=1)
+
+
+def test_zero_config():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu"},
+            "stage3_prefetch_bucket_size": 1e7,
+        },
+    }, world_size=1)
+    assert cfg.zero_optimization.stage == 3
+    assert cfg.zero_optimization.offload_optimizer.device == "cpu"
+    assert cfg.zero_optimization.overlap_comm is True  # stage-3 default
+    assert cfg.zero_enabled
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, world_size=1)
+
+
+def test_precision_dtype():
+    import jax.numpy as jnp
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True}}, world_size=1)
+    assert cfg.compute_dtype == jnp.bfloat16
+
+
+def test_deprecated_alias():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "bfloat16": {"enabled": True}}, world_size=1)
+    assert cfg.bf16.enabled
+
+
+def test_unknown_key_in_section_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {"staage": 3}}, world_size=1)
+
+
+def test_optimizer_scheduler_sections():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+        "gradient_clipping": 1.0,
+    }, world_size=1)
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.scheduler.params["warmup_num_steps"] == 100
+    assert cfg.gradient_clipping == 1.0
+
+
+def test_mesh_section():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "mesh": {"tensor_parallel_size": 2}}, world_size=8)
+    assert cfg.mesh.tensor_parallel_size == 2
+    assert cfg.mesh.data_parallel_size == 4
