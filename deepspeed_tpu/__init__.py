@@ -1,0 +1,85 @@
+"""deepspeed_tpu — TPU-native large-model training & inference framework.
+
+Brand-new JAX/XLA/pjit/Pallas framework with the capability set of DeepSpeed
+(reference ``deepspeed/__init__.py``: ``initialize`` :58, ``init_inference``
+:260, ``init_distributed`` :32, ``add_config_arguments`` :237).
+"""
+
+from . import comm  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401
+from .comm.comm import init_distributed  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .runtime.engine import DeepSpeedEngine  # noqa: F401
+from .runtime.lr_schedules import (WarmupLR, WarmupDecayLR, WarmupCosineLR, OneCycle, LRRangeTest)  # noqa: F401
+from .utils.logging import logger, log_dist  # noqa: F401
+from .version import __version__  # noqa: F401
+
+__git_hash__ = None
+__git_branch__ = None
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               **kwargs):
+    """Initialize the training engine (reference ``deepspeed.initialize``).
+
+    Returns the reference 4-tuple ``(engine, optimizer, dataloader,
+    lr_scheduler)``. The optimizer slot carries the engine itself (the optax
+    transformation lives inside the compiled step); the lr_scheduler slot
+    carries the stateful schedule facade.
+    """
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        if hasattr(args, "deepspeed_config") and args.deepspeed_config is not None:
+            config = args.deepspeed_config
+    if config is None:
+        raise ValueError("DeepSpeed requires --deepspeed_config to specify configuration file")
+
+    init_distributed()
+
+    engine = DeepSpeedEngine(model=model,
+                             config=config,
+                             optimizer=optimizer,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler,
+                             mpu=mpu,
+                             dist_init_required=dist_init_required,
+                             collate_fn=collate_fn,
+                             **kwargs)
+    return engine, engine, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Initialize the inference engine (reference ``deepspeed.init_inference``)."""
+    from .inference.engine import InferenceEngine
+    from .inference.config import DeepSpeedInferenceConfig
+    if isinstance(config, DeepSpeedInferenceConfig):
+        ds_inference_config = config
+    else:
+        config_dict = dict(config or {})
+        config_dict.update(kwargs)
+        ds_inference_config = DeepSpeedInferenceConfig(config_dict)
+    return InferenceEngine(model, config=ds_inference_config)
+
+
+def add_config_arguments(parser):
+    """Add reference CLI args (``deepspeed/__init__.py:237``)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag, parity)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="DeepSpeed json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true")
+    group.add_argument("--deepscale_config", default=None, type=str)
+    return parser

@@ -90,13 +90,29 @@ def init_distributed(dist_backend="xla",
     coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS")
     n_proc = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("WORLD_SIZE")
     proc_id = os.environ.get("JAX_PROCESS_ID") or os.environ.get("RANK")
-    if coord and n_proc and int(n_proc) > 1:
+    if coord is None and os.environ.get("MASTER_ADDR"):
+        # torch/DeepSpeed-launcher style rendezvous env
+        coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', distributed_port)}"
+    any_set = coord is not None or n_proc is not None or proc_id is not None
+    if any_set and n_proc is None:
+        raise RuntimeError(
+            "Partial distributed env: found a coordinator address or process id but no process count. "
+            "Set JAX_NUM_PROCESSES (or WORLD_SIZE) alongside COORDINATOR_ADDRESS/MASTER_ADDR and "
+            "JAX_PROCESS_ID (or RANK).")
+    if n_proc is not None and int(n_proc) > 1:
         if verbose:
             logger.info(f"Initializing jax.distributed: coordinator={coord} "
                         f"num_processes={n_proc} process_id={proc_id}")
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=int(n_proc),
-                                   process_id=int(proc_id) if proc_id is not None else None)
+        # argless path: on Cloud TPU pods jax auto-detects from TPU metadata
+        if coord is None:
+            jax.distributed.initialize()
+        else:
+            jax.distributed.initialize(coordinator_address=coord,
+                                       num_processes=int(n_proc),
+                                       process_id=int(proc_id) if proc_id is not None else None)
+        if jax.process_count() != int(n_proc):
+            raise RuntimeError(f"distributed init came up with {jax.process_count()} processes, "
+                               f"expected {n_proc}")
     _state["initialized"] = True
 
 

@@ -1,0 +1,143 @@
+"""Checkpoint save/load.
+
+Analogue of reference ``deepspeed/runtime/checkpoint_engine/`` (pluggable
+``CheckpointEngine`` ABC, torch + async Nebula backends) and of the
+save/load paths in ``engine.py:2802/:2497``. Backend is Orbax: arrays are
+written as *global logical tensors* regardless of mesh layout, which gives
+the universal-checkpoint property (reference ``deepspeed/checkpoint/``
+offline 3D reshape machinery) by construction — restoring onto a different
+mesh/ZeRO stage is just restoring with different target shardings.
+
+Layout per checkpoint dir (DeepSpeed-compatible shape):
+    <save_dir>/<tag>/state/        orbax pytree (sharded arrays)
+    <save_dir>/<tag>/client_sd.json
+    <save_dir>/latest              text file holding the newest tag
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class CheckpointEngine:
+    """Pluggable backend ABC (reference ``checkpoint_engine.py:9``)."""
+
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        pass
+
+    def save(self, state_dict, path):
+        raise NotImplementedError
+
+    def load(self, path, map_location=None):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        return True
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+
+    def __init__(self, config_params=None, use_async=False):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.use_async = use_async
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler()) if use_async \
+            else ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+
+    def save(self, state, path):
+        self._ckptr.save(os.path.abspath(path), state, force=True)
+
+    def load(self, path, abstract_target=None):
+        import orbax.checkpoint as ocp
+        restore_args = None
+        if abstract_target is not None:
+            restore_args = ocp.checkpoint_utils.construct_restore_args(abstract_target)
+            return self._ckptr.restore(os.path.abspath(path),
+                                       args=ocp.args.PyTreeRestore(
+                                           item=abstract_target,
+                                           restore_args=restore_args))
+        return self._ckptr.restore(os.path.abspath(path))
+
+    def commit(self, tag):
+        if self.use_async:
+            self._ckptr.wait_until_finished()
+        return True
+
+
+def _latest_path(save_dir):
+    return os.path.join(save_dir, "latest")
+
+
+def get_latest_tag(load_dir):
+    p = _latest_path(load_dir)
+    if os.path.isfile(p):
+        with open(p) as f:
+            return f.read().strip()
+    return None
+
+
+def save_checkpoint(save_dir, tag, state, client_sd, save_latest=True, use_async=False):
+    ckpt_dir = os.path.join(os.path.abspath(save_dir), str(tag))
+    os.makedirs(ckpt_dir, exist_ok=True)
+    engine = OrbaxCheckpointEngine(use_async=use_async)
+    engine.save(state, os.path.join(ckpt_dir, "state"))
+    if jax.process_index() == 0:
+        with open(os.path.join(ckpt_dir, "client_sd.json"), "w") as f:
+            json.dump(_jsonable(client_sd), f, indent=2)
+        if save_latest:
+            with open(_latest_path(save_dir), "w") as f:
+                f.write(str(tag))
+    engine.commit(tag)
+
+
+def load_checkpoint(load_dir, tag, state_shardings, mesh, template, load_optimizer_states=True,
+                    load_module_only=False):
+    load_dir = os.path.abspath(load_dir)
+    if tag is None:
+        tag = get_latest_tag(load_dir)
+        if tag is None:
+            logger.warning(f"no 'latest' file found in {load_dir}; cannot auto-resume")
+            return None, None
+    ckpt_dir = os.path.join(load_dir, str(tag))
+    state_path = os.path.join(ckpt_dir, "state")
+    if not os.path.isdir(state_path):
+        logger.warning(f"checkpoint {state_path} does not exist")
+        return None, None
+
+    # abstract target: shapes/dtypes from the live state, shardings from plan —
+    # this is what makes the checkpoint mesh-layout-independent
+    abstract = jax.tree_util.tree_map(
+        lambda x, s: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s), template, state_shardings)
+    engine = OrbaxCheckpointEngine()
+    state = engine.load(state_path, abstract_target=abstract)
+
+    client_sd = {}
+    sd_path = os.path.join(ckpt_dir, "client_sd.json")
+    if os.path.isfile(sd_path):
+        with open(sd_path) as f:
+            client_sd = json.load(f)
+    if load_module_only or not load_optimizer_states:
+        state = template._replace(params=state.params, step=state.step)
+    return state, client_sd
+
+
+def _jsonable(obj):
+    if isinstance(obj, dict):
+        return {k: _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer, )):
+        return int(obj)
+    if isinstance(obj, (np.floating, )):
+        return float(obj)
+    if hasattr(obj, "item") and getattr(obj, "ndim", None) == 0:
+        return obj.item()
+    return obj

@@ -11,7 +11,6 @@ import json
 import os
 
 from .config_utils import DeepSpeedConfigModel, ConfigField, dict_raise_error_on_duplicate_keys
-from .constants import *  # noqa: F401,F403
 from .zero.config import DeepSpeedZeroConfig, ZeroStageEnum
 from ..utils.logging import logger
 

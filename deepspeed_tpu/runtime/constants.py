@@ -1,34 +1,25 @@
-"""Config key names + defaults.
+"""Config key names.
 
-Condensed analogue of the reference ``deepspeed/runtime/constants.py`` (417
-LoC of key constants). Key *names* match the reference so user configs are
-drop-in; values the TPU build does not support raise clearly at parse time.
+Condensed analogue of the reference ``deepspeed/runtime/constants.py``. Key
+*names* match the reference so user configs are drop-in. Defaults live in ONE
+place — the ``ConfigField`` declarations in ``config.py`` — not here.
 """
 
 #############################################
 # Batch size and accumulation
 #############################################
 TRAIN_BATCH_SIZE = "train_batch_size"
-TRAIN_BATCH_SIZE_DEFAULT = None
-
 TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
-TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT = None
-
 GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
-GRADIENT_ACCUMULATION_STEPS_DEFAULT = None
 
 #############################################
-# Optimizer / scheduler
+# Optimizer / scheduler sections
 #############################################
 OPTIMIZER = "optimizer"
-OPTIMIZER_TYPE_DEFAULT = None
 OPTIMIZER_PARAMS = "params"
 TYPE = "type"
 LEGACY_FUSION = "legacy_fusion"
-LEGACY_FUSION_DEFAULT = False
-
 SCHEDULER = "scheduler"
-SCHEDULER_TYPE_DEFAULT = None
 SCHEDULER_PARAMS = "params"
 MAX_GRAD_NORM = "max_grad_norm"
 
@@ -50,102 +41,48 @@ DEEPSPEED_OPTIMIZERS = [
 ]
 
 #############################################
-# Precision
+# Precision / gradients
 #############################################
 FP32_ALLREDUCE = "fp32_allreduce"
-FP32_ALLREDUCE_DEFAULT = False
-
 PREC_SCALE = "prescale_gradients"
-PREC_SCALE_DEFAULT = False
-
 GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
-GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
-
 SPARSE_GRADIENTS = "sparse_gradients"
-SPARSE_GRADIENTS_DEFAULT = False
-
 FP16 = "fp16"
 FP16_ENABLED = "enabled"
-FP16_ENABLED_DEFAULT = False
 FP16_LOSS_SCALE = "loss_scale"
-FP16_LOSS_SCALE_DEFAULT = 0
 FP16_AUTO_CAST = "auto_cast"
-FP16_AUTO_CAST_DEFAULT = False
 FP16_INITIAL_SCALE_POWER = "initial_scale_power"
-FP16_INITIAL_SCALE_POWER_DEFAULT = 16
 FP16_LOSS_SCALE_WINDOW = "loss_scale_window"
-FP16_LOSS_SCALE_WINDOW_DEFAULT = 1000
 FP16_HYSTERESIS = "hysteresis"
-FP16_HYSTERESIS_DEFAULT = 2
 FP16_MIN_LOSS_SCALE = "min_loss_scale"
-FP16_MIN_LOSS_SCALE_DEFAULT = 1
 FP16_MASTER_WEIGHTS_AND_GRADS = "fp16_master_weights_and_grads"
-FP16_MASTER_WEIGHTS_AND_GRADS_DEFAULT = False
-
 BFLOAT16 = "bf16"
 BFLOAT16_OLD = "bfloat16"  # deprecated alias kept by the reference
 BFLOAT16_ENABLED = "enabled"
-BFLOAT16_ENABLED_DEFAULT = False
-
 AMP = "amp"
 AMP_ENABLED = "enabled"
-AMP_ENABLED_DEFAULT = False
-
 GRADIENT_CLIPPING = "gradient_clipping"
-GRADIENT_CLIPPING_DEFAULT = 0.0
-
 COMMUNICATION_DATA_TYPE = "communication_data_type"
-COMMUNICATION_DATA_TYPE_DEFAULT = None
+GRAD_ACCUM_DTYPE = "grad_accum_dtype"
 
 #############################################
-# ZeRO
+# Sections
 #############################################
 ZERO_OPTIMIZATION = "zero_optimization"
-
-#############################################
-# Logging / monitoring
-#############################################
 STEPS_PER_PRINT = "steps_per_print"
-STEPS_PER_PRINT_DEFAULT = 10
-
 WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
-WALL_CLOCK_BREAKDOWN_DEFAULT = False
-
 DUMP_STATE = "dump_state"
-DUMP_STATE_DEFAULT = False
-
 MEMORY_BREAKDOWN = "memory_breakdown"
-MEMORY_BREAKDOWN_DEFAULT = False
-
 TENSORBOARD = "tensorboard"
 CSV_MONITOR = "csv_monitor"
 WANDB = "wandb"
 MONITOR_ENABLED = "enabled"
-
-#############################################
-# Checkpoint / data
-#############################################
 CHECKPOINT = "checkpoint"
 LOAD_UNIVERSAL_CHECKPOINT = "load_universal"
-LOAD_UNIVERSAL_CHECKPOINT_DEFAULT = False
 USE_NODE_LOCAL_STORAGE_CHECKPOINT = "use_node_local_storage"
-USE_NODE_LOCAL_STORAGE_CHECKPOINT_DEFAULT = False
-
 DATA_TYPES = "data_types"
-GRAD_ACCUM_DTYPE = "grad_accum_dtype"
-GRAD_ACCUM_DTYPE_DEFAULT = None
-
 DATALOADER_DROP_LAST = "dataloader_drop_last"
-DATALOADER_DROP_LAST_DEFAULT = False
-
-#############################################
-# Activation checkpointing
-#############################################
 ACTIVATION_CHECKPOINTING = "activation_checkpointing"
-
-#############################################
-# Sparse attention
-#############################################
 SPARSE_ATTENTION = "sparse_attention"
 SPARSE_DENSE_MODE = "dense"
 SPARSE_FIXED_MODE = "fixed"
@@ -153,23 +90,17 @@ SPARSE_VARIABLE_MODE = "variable"
 SPARSE_BIGBIRD_MODE = "bigbird"
 SPARSE_BSLONGFORMER_MODE = "bslongformer"
 SPARSE_MODE = "mode"
-SPARSE_MODE_DEFAULT = SPARSE_FIXED_MODE
-
-#############################################
-# Gradient/elasticity misc
-#############################################
 PLD = "progressive_layer_drop"
 PLD_ENABLED = "enabled"
-PLD_ENABLED_DEFAULT = False
 PLD_THETA = "theta"
-PLD_THETA_DEFAULT = 1.0
 PLD_GAMMA = "gamma"
-PLD_GAMMA_DEFAULT = 0.001
-
 CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
 DATA_EFFICIENCY = "data_efficiency"
-
 ELASTICITY = "elasticity"
+COMPRESSION_TRAINING = "compression_training"
+FLOPS_PROFILER = "flops_profiler"
+AUTOTUNING = "autotuning"
+COMMS_LOGGER = "comms_logger"
 
 #############################################
 # Parallelism axes (TPU mesh; extension over the reference which delegates
@@ -180,12 +111,3 @@ TENSOR_PARALLEL_SIZE = "tensor_parallel_size"
 PIPELINE_PARALLEL_SIZE = "pipeline_parallel_size"
 SEQUENCE_PARALLEL_SIZE = "sequence_parallel_size"
 EXPERT_PARALLEL_SIZE = "expert_parallel_size"
-
-#############################################
-# Routing keys held by top-level config but consumed by subsystems
-#############################################
-COMPRESSION_TRAINING = "compression_training"
-FLOPS_PROFILER = "flops_profiler"
-AUTOTUNING = "autotuning"
-MONITOR_CONFIG = "monitor_config"
-COMMS_LOGGER = "comms_logger"

@@ -1,0 +1,100 @@
+"""Data loading.
+
+Analogue of reference ``deepspeed/runtime/dataloader.py``
+(``DeepSpeedDataLoader``, ``RepeatingLoader``). Produces numpy microbatches
+for the engine; accepts map-style datasets (``__len__``/``__getitem__``,
+including torch Datasets), iterables of samples, or iterables that already
+yield batches. Distributed sampling note: the engine places the *global*
+batch onto the mesh itself, so on a single host the loader yields global
+batches; multi-host feeding uses per-process shards assembled by
+``jax.make_array_from_process_local_data``.
+"""
+
+import numpy as np
+
+
+def default_collate(samples):
+    """Stack a list of samples (dicts / tuples / arrays) into numpy batches."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples]) for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+
+    def __init__(self,
+                 dataset,
+                 batch_size,
+                 collate_fn=None,
+                 drop_last=True,
+                 seed=0,
+                 shuffle=True,
+                 data_sampler=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.data_sampler = data_sampler
+        self.epoch = 0
+        self._rng = np.random.default_rng(seed)
+        self.len = None
+        if hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
+            n = len(dataset)
+            self.len = n // batch_size if drop_last else (n + batch_size - 1) // batch_size
+
+    def __len__(self):
+        if self.len is None:
+            raise TypeError("underlying dataset has no length")
+        return self.len
+
+    def _iter_map_style(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.data_sampler is not None:
+            order = np.asarray(list(iter(self.data_sampler)))
+        elif self.shuffle:
+            self._rng.shuffle(order)
+        for start in range(0, n, self.batch_size):
+            idx = order[start:start + self.batch_size]
+            if len(idx) < self.batch_size and self.drop_last:
+                break
+            yield self.collate_fn([self.dataset[int(i)] for i in idx])
+
+    def _iter_iterable(self):
+        buf = []
+        for sample in self.dataset:
+            buf.append(sample)
+            if len(buf) == self.batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self.drop_last:
+            yield self.collate_fn(buf)
+
+    def __iter__(self):
+        self.epoch += 1
+        if hasattr(self.dataset, "__len__") and hasattr(self.dataset, "__getitem__"):
+            return self._iter_map_style()
+        return self._iter_iterable()
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference
+    ``dataloader.py`` RepeatingLoader)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

@@ -1,0 +1,123 @@
+"""Loss scaling.
+
+Analogue of reference ``deepspeed/runtime/fp16/loss_scaler.py``
+(``LossScaler``/``DynamicLossScaler``). Functional: scaler state is a small
+pytree carried inside the compiled train step so scale adjustment and
+overflow-skip happen on-device with no host sync.
+
+TPU note: bf16 is the native dtype and needs no loss scaling; this exists for
+fp16 parity mode.
+"""
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+CONSECUTIVE_HYSTERESIS = "consecutive_hysteresis"
+MIN_LOSS_SCALE = "min_scale"
+
+
+class LossScaleState(NamedTuple):
+    cur_scale: jnp.ndarray  # f32 scalar
+    cur_hysteresis: jnp.ndarray  # i32 scalar
+    last_overflow_iter: jnp.ndarray  # i32 scalar
+    iteration: jnp.ndarray  # i32 scalar
+
+
+class LossScalerBase:
+    """Static loss scaler (reference ``LossScaler``)."""
+
+    dynamic = False
+
+    def __init__(self, scale=1.0):
+        self.loss_scale = float(scale)
+
+    def init_state(self):
+        return LossScaleState(
+            cur_scale=jnp.asarray(self.loss_scale, jnp.float32),
+            cur_hysteresis=jnp.asarray(0, jnp.int32),
+            last_overflow_iter=jnp.asarray(-1, jnp.int32),
+            iteration=jnp.asarray(0, jnp.int32),
+        )
+
+    def update(self, state, has_overflow):
+        return state._replace(iteration=state.iteration + 1)
+
+    def backward(self, loss):
+        return loss * self.loss_scale
+
+
+LossScaler = LossScalerBase
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic scaler (reference ``DynamicLossScaler``): halve on overflow
+    (with hysteresis), double after ``scale_window`` clean steps."""
+
+    dynamic = True
+
+    def __init__(self,
+                 init_scale=2**32,
+                 scale_factor=2.0,
+                 scale_window=1000,
+                 min_scale=1.0,
+                 delayed_shift=1,
+                 consecutive_hysteresis=False,
+                 raise_error_at_min_scale=False,
+                 dtype=jnp.float16):
+        super().__init__(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init_state(self):
+        return LossScaleState(
+            cur_scale=jnp.asarray(self.loss_scale, jnp.float32),
+            cur_hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+            last_overflow_iter=jnp.asarray(-1, jnp.int32),
+            iteration=jnp.asarray(0, jnp.int32),
+        )
+
+    def update(self, state, has_overflow):
+        """Pure update; ``has_overflow`` is a traced bool scalar."""
+        it = state.iteration
+
+        # overflow branch
+        depleted = state.cur_hysteresis <= 1
+        ovf_scale = jnp.where(depleted,
+                              jnp.maximum(state.cur_scale / self.scale_factor, self.min_scale),
+                              state.cur_scale)
+        ovf_hyst = jnp.where(depleted, state.cur_hysteresis, state.cur_hysteresis - 1)
+
+        # clean branch
+        window_full = (it - state.last_overflow_iter) % self.scale_window == (self.scale_window - 1)
+        ok_scale = jnp.where(window_full, state.cur_scale * self.scale_factor, state.cur_scale)
+        ok_hyst = jnp.where(self.consecutive_hysteresis, jnp.asarray(self.delayed_shift, jnp.int32),
+                            state.cur_hysteresis)
+
+        return LossScaleState(
+            cur_scale=jnp.where(has_overflow, ovf_scale, ok_scale),
+            cur_hysteresis=jnp.where(has_overflow, ovf_hyst, ok_hyst),
+            last_overflow_iter=jnp.where(has_overflow, it, state.last_overflow_iter),
+            iteration=it + 1,
+        )
+
+
+def create_loss_scaler(fp16_config=None, dtype=jnp.float16):
+    """Build scaler from the ``fp16`` config section (reference
+    ``CreateLossScaler``)."""
+    if fp16_config is None or not fp16_config.enabled:
+        return LossScalerBase(1.0)
+    if fp16_config.loss_scale and fp16_config.loss_scale > 0:
+        return LossScalerBase(fp16_config.loss_scale)
+    return DynamicLossScaler(
+        init_scale=2**fp16_config.initial_scale_power,
+        scale_window=fp16_config.loss_scale_window,
+        min_scale=fp16_config.min_loss_scale,
+        delayed_shift=fp16_config.hysteresis,
+    )

@@ -1,0 +1,241 @@
+"""ZeRO as sharding rules.
+
+This module is the TPU-native replacement for the reference's hook-driven
+ZeRO machinery (``runtime/zero/partition_parameters.py`` :601/:874/:940,
+``partitioned_param_coordinator.py`` :43, ``parameter_offload.py`` :201 —
+~2.6k LoC of monkey-patching and prefetch scheduling). Here the same
+semantics are *declared* as ``jax.sharding`` placements and XLA's SPMD
+partitioner + latency-hiding scheduler perform the all-gather/reduce-scatter
+scheduling that DeepSpeed drives by hand (SURVEY §7 design translation):
+
+- stage 0: params, grads, optimizer state replicated over DP.
+- stage 1: optimizer state (and fp32 master params) sharded over DP.
+- stage 2: + gradients reduce-scattered into the same sharding.
+- stage 3: + model params sharded over DP; XLA all-gathers just-in-time
+  per layer and frees after use (the fetch/release/prefetch coordinator
+  becomes the compiler's scheduling problem).
+
+DeepSpeed concepts that survive as rules:
+- ``stage3_param_persistence_threshold`` → small params stay replicated.
+- MoE-aware groups (``moe/utils.py``) → expert params shard over the
+  ``data`` axis only; dense params over ``('expert','data')``.
+- TP (Megatron-style, reference delegates to user mpu) → per-param
+  PartitionSpec rules matched by path regex, applied before DP sharding.
+"""
+
+import re
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...comm import comm as dist
+from ...utils.logging import logger
+from .config import ZeroStageEnum
+
+
+def _path_str(path):
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _spec_axes(spec):
+    """Flatten axis names used in a PartitionSpec."""
+    used = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.extend(entry)
+        else:
+            used.append(entry)
+    return used
+
+
+class TensorParallelRules:
+    """Ordered (regex, PartitionSpec) rules; first match wins.
+
+    The TPU-native form of inference AutoTP's row/col parser
+    (``module_inject/auto_tp.py:84``) generalized to training: rules name
+    which dims of which params split over the ``tensor`` (and ``expert``)
+    axes.
+    """
+
+    def __init__(self, rules=()):
+        self.rules = [(re.compile(pat), P(*spec) if not isinstance(spec, P) else spec) for pat, spec in rules]
+
+    def match(self, path_str, ndim):
+        for pat, spec in self.rules:
+            if pat.search(path_str):
+                if len(spec) > ndim:
+                    raise ValueError(f"TP rule {pat.pattern} spec {spec} has more dims than param "
+                                     f"{path_str} (ndim={ndim})")
+                return P(*(tuple(spec) + (None, ) * (ndim - len(spec))))
+        return None
+
+    def __bool__(self):
+        return bool(self.rules)
+
+
+def best_shardable_dim(shape, size, taken):
+    """Largest dim divisible by ``size`` and not already sharded; None if none.
+
+    Replaces DeepSpeed's flat-buffer padding (``partition_parameters.py:1091``
+    pads 1-D partitions): XLA shards a real tensor dim instead, so no padding
+    or flattening is needed.
+    """
+    best = None
+    for d, extent in enumerate(shape):
+        if d in taken:
+            continue
+        if extent % size == 0 and extent >= size:
+            if best is None or extent > shape[best]:
+                best = d
+    return best
+
+
+class ShardingPlanner:
+    """Plans NamedShardings for params / grads / optimizer state.
+
+    ``fsdp_axes``: mesh axes forming the ZeRO data-parallel group
+    (``('expert','data')`` for dense params; expert params drop ``'expert'``).
+    """
+
+    def __init__(self, mesh, zero_config=None, tp_rules=None, expert_pattern=None):
+        self.mesh = mesh
+        self.zero = zero_config
+        self.stage = zero_config.stage if zero_config is not None else 0
+        self.tp_rules = tp_rules if isinstance(tp_rules, TensorParallelRules) else TensorParallelRules(tp_rules or ())
+        self.expert_pattern = re.compile(expert_pattern) if expert_pattern else None
+        self.persistence_threshold = (zero_config.stage3_param_persistence_threshold
+                                      if zero_config is not None else int(1e5))
+
+    # -- single-leaf planning ------------------------------------------------
+    def _dp_axes_for(self, path_str):
+        if self.expert_pattern is not None and self.expert_pattern.search(path_str):
+            return (dist.DATA_AXIS, )
+        return (dist.EXPERT_AXIS, dist.DATA_AXIS)
+
+    def _dp_size(self, axes):
+        return int(np.prod([self.mesh.shape[a] for a in axes]))
+
+    def _apply_dp(self, spec, shape, path_str):
+        """Append the ZeRO dp axes to the largest free divisible dim."""
+        axes = [a for a in self._dp_axes_for(path_str) if self.mesh.shape[a] > 1]
+        if not axes:
+            return spec
+        size = self._dp_size(axes)
+        taken = {d for d, e in enumerate(spec) if e is not None}
+        dim = best_shardable_dim(shape, size, taken)
+        if dim is None:
+            logger.debug(f"param {path_str} shape {shape} not divisible by dp={size}; replicating")
+            return spec
+        entries = list(spec)
+        entries[dim] = tuple(axes) if len(axes) > 1 else axes[0]
+        return P(*entries)
+
+    def param_spec(self, path_str, shape):
+        """PartitionSpec for a *model* (compute) parameter."""
+        ndim = len(shape)
+        spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
+        if self.stage >= ZeroStageEnum.weights:
+            n_elem = int(np.prod(shape)) if shape else 1
+            if n_elem > self.persistence_threshold:
+                spec = self._apply_dp(spec, shape, path_str)
+        return spec
+
+    def master_spec(self, path_str, shape):
+        """PartitionSpec for fp32 master params + optimizer moments."""
+        ndim = len(shape)
+        spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
+        if self.stage >= ZeroStageEnum.optimizer_states:
+            spec = self._apply_dp(spec, shape, path_str)
+        return spec
+
+    def grad_spec(self, path_str, shape):
+        """PartitionSpec for gradients/accumulators: stage >= 2 scatters."""
+        ndim = len(shape)
+        spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
+        if self.stage >= ZeroStageEnum.gradients:
+            spec = self._apply_dp(spec, shape, path_str)
+        return spec
+
+    # -- pytree planning -----------------------------------------------------
+    def _tree_specs(self, params, leaf_fn):
+        def plan(path, leaf):
+            shape = np.shape(leaf) if not hasattr(leaf, "shape") else tuple(leaf.shape)
+            return leaf_fn(_path_str(path), shape)
+
+        return jax.tree_util.tree_map_with_path(plan, params)
+
+    def param_specs(self, params):
+        return self._tree_specs(params, self.param_spec)
+
+    def master_specs(self, params):
+        return self._tree_specs(params, self.master_spec)
+
+    def grad_specs(self, params):
+        return self._tree_specs(params, self.grad_spec)
+
+    def shardings(self, specs):
+        return jax.tree_util.tree_map(lambda s: NamedSharding(self.mesh, s),
+                                      specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+
+    def param_shardings(self, params):
+        return self.shardings(self.param_specs(params))
+
+    def master_shardings(self, params):
+        return self.shardings(self.master_specs(params))
+
+    def opt_state_shardings(self, opt_state, params):
+        """Optimizer state leaves that mirror a param get the master sharding;
+        scalars (step counts) replicate."""
+        master = self.master_specs(params)
+        flat_master, _ = jax.tree_util.tree_flatten(master)
+        by_shape = {}
+        for p_leaf, spec in zip(jax.tree_util.tree_leaves(params), flat_master):
+            by_shape.setdefault(tuple(p_leaf.shape), spec)
+
+        def plan(leaf):
+            shape = tuple(np.shape(leaf))
+            spec = by_shape.get(shape)
+            if spec is None:
+                spec = P()
+            return NamedSharding(self.mesh, spec)
+
+        return jax.tree_util.tree_map(plan, opt_state)
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, extra_leading_dims=0):
+        """Batch dim sharded over the full DP group (and seq axis over the
+        sequence dim when sequence parallelism is on)."""
+        dp = [a for a in (dist.EXPERT_AXIS, dist.DATA_AXIS) if self.mesh.shape[a] > 1]
+        entries = [None] * extra_leading_dims + [tuple(dp) if dp else None]
+        if self.mesh.shape[dist.SEQ_AXIS] > 1:
+            entries = entries + [dist.SEQ_AXIS]
+        return NamedSharding(self.mesh, P(*entries))
+
+    def describe(self, params):
+        """Human-readable plan dump (ds_report-style aid)."""
+        lines = []
+
+        def show(path, leaf):
+            ps = _path_str(path)
+            lines.append(f"{ps:60s} {str(tuple(leaf.shape)):20s} param={self.param_spec(ps, tuple(leaf.shape))} "
+                         f"master={self.master_spec(ps, tuple(leaf.shape))}")
+            return leaf
+
+        jax.tree_util.tree_map_with_path(show, params)
+        return "\n".join(lines)

@@ -93,8 +93,23 @@ def get_pipeline_parallel_world_size():
 
 
 def get_data_parallel_rank():
-    # host-context: meaningful per-chip only inside shard_map
-    return 0
+    """DP-group coordinate of this *process*, derived from where its first
+    local device sits in the mesh (host context; per-chip rank exists only
+    inside shard_map). Used e.g. to shard a dataset per DP rank."""
+    import jax
+    import numpy as np
+    if not dist.has_mesh():
+        return 0
+    mesh = dist.get_mesh()
+    dev = jax.local_devices()[0]
+    hits = np.argwhere(mesh.devices == dev)
+    if len(hits) == 0:
+        return 0
+    coords = hits[0]
+    axis_pos = {name: i for i, name in enumerate(mesh.axis_names)}
+    expert_c = int(coords[axis_pos[dist.EXPERT_AXIS]])
+    data_c = int(coords[axis_pos[dist.DATA_AXIS]])
+    return expert_c * mesh.shape[dist.DATA_AXIS] + data_c
 
 
 def get_world_size():

@@ -24,3 +24,4 @@ def _reset_global_mesh():
     yield
     from deepspeed_tpu.comm import comm
     comm._state["mesh"] = None
+    comm._state["comms_logger"] = None

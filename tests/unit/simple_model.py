@@ -1,0 +1,54 @@
+"""Model fixtures (analogue of reference ``tests/unit/simple_model.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SimpleModel:
+    """Small MLP regression model as a pure loss function holder."""
+
+    def __init__(self, hidden_dim=64, nlayers=2):
+        self.hidden_dim = hidden_dim
+        self.nlayers = nlayers
+
+    def init_params(self, rng):
+        params = {}
+        keys = jax.random.split(rng, self.nlayers + 1)
+        for i in range(self.nlayers):
+            params[f"linear_{i}"] = {
+                "kernel": jax.random.normal(keys[i], (self.hidden_dim, self.hidden_dim)) * 0.02,
+                "bias": jnp.zeros((self.hidden_dim, )),
+            }
+        params["head"] = {
+            "kernel": jax.random.normal(keys[-1], (self.hidden_dim, 1)) * 0.02,
+            "bias": jnp.zeros((1, )),
+        }
+        return params
+
+    def forward(self, params, x):
+        h = x
+        for i in range(self.nlayers):
+            layer = params[f"linear_{i}"]
+            h = jnp.tanh(h @ layer["kernel"] + layer["bias"])
+        return h @ params["head"]["kernel"] + params["head"]["bias"]
+
+    def loss(self, params, batch, rng):
+        pred = self.forward(params, batch["x"])
+        return jnp.mean((pred - batch["y"])**2)
+
+
+def random_dataset(n, hidden_dim, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, hidden_dim)).astype(np.float32)
+    w = rng.normal(size=(hidden_dim, 1)).astype(np.float32) * 0.1
+    y = np.tanh(x) @ w
+    return [{"x": x[i], "y": y[i]} for i in range(n)]
+
+
+def random_batch(batch_size, hidden_dim, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch_size, hidden_dim)).astype(np.float32)
+    w = rng.normal(size=(hidden_dim, 1)).astype(np.float32) * 0.1
+    y = np.tanh(x) @ w
+    return {"x": x, "y": y}
