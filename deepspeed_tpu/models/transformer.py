@@ -1,0 +1,368 @@
+"""Decoder-only transformer family.
+
+The reference ships no trainable model zoo of its own (it wraps user torch
+modules; its model surface is the inference injection containers,
+``module_inject/containers/*`` — bert/bloom/gpt2/gptj/gptneox/megatron/opt).
+A standalone TPU framework needs first-party models, so this module provides
+one configurable causal-LM covering the reference's model families:
+
+- GPT-2 / OPT style: learned positions, LayerNorm, gelu/relu MLP
+- Llama style: RoPE, RMSNorm, SwiGLU, grouped-query attention
+- Mixtral style: + top-k routed MoE MLP (see ``deepspeed_tpu.moe``)
+
+TPU-first choices: layers are stacked with ``nn.scan`` (one compiled block,
+weights get a leading layer dim — compile time stays flat in depth);
+activations default bf16 with fp32 LayerNorm/softmax accumulations; remat via
+``jax.checkpoint`` policies; attention pluggable between a pure-XLA einsum
+path and the Pallas flash kernel (``ops.pallas.flash_attention``).
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..comm import comm as dist
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None  # default 4x (or 8/3 x for swiglu)
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # GQA; None = MHA
+    head_dim: Optional[int] = None
+    max_seq_len: int = 1024
+    # family switches
+    pos_embedding: str = "rope"  # "rope" | "learned" | "none"
+    norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
+    activation: str = "swiglu"  # "swiglu" | "gelu" | "relu" | "geglu"
+    tie_embeddings: bool = True
+    rope_theta: float = 10000.0
+    layernorm_epsilon: float = 1e-5
+    dropout: float = 0.0
+    # MoE (0 experts = dense)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
+    # systems
+    dtype: Any = jnp.bfloat16
+    scan_layers: bool = True
+    remat_policy: Optional[str] = None  # None | "nothing_saveable" | "dots_saveable" | ...
+    attention_impl: str = "xla"  # "xla" | "flash"
+    attention_block_q: int = 512
+    attention_block_kv: int = 512
+
+    def __post_init__(self):
+        if self.attention_impl not in ("xla", "flash"):
+            raise ValueError(f"attention_impl must be 'xla' or 'flash', got {self.attention_impl!r}")
+        if self.attention_impl == "flash":
+            import importlib.util
+            if importlib.util.find_spec("deepspeed_tpu.ops.pallas.flash_attention") is None:
+                raise NotImplementedError(
+                    "attention_impl='flash' requires the Pallas kernel "
+                    "(deepspeed_tpu.ops.pallas.flash_attention); use attention_impl='xla'")
+
+    @property
+    def kv_heads(self):
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_size(self):
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        if self.activation in ("swiglu", "geglu"):
+            # llama convention: 8/3 * hidden rounded to multiple of 256
+            d = int(8 * self.hidden_size / 3)
+            return (d + 255) // 256 * 256
+        return 4 * self.hidden_size
+
+    def num_params(self):
+        """Approximate parameter count (for MFU math)."""
+        h, v, L = self.hidden_size, self.vocab_size, self.num_layers
+        attn = h * self.head_size * (self.num_heads + 2 * self.kv_heads) + self.num_heads * self.head_size * h
+        if self.activation in ("swiglu", "geglu"):
+            mlp = 3 * h * self.ffn_size
+        else:
+            mlp = 2 * h * self.ffn_size
+        if self.num_experts > 0:
+            mlp *= self.num_experts
+        emb = v * h * (1 if self.tie_embeddings else 2)
+        pos = self.max_seq_len * h if self.pos_embedding == "learned" else 0
+        return L * (attn + mlp + 2 * h) + emb + pos + h
+
+
+class RMSNorm(nn.Module):
+    epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1], ), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + self.epsilon) * scale
+        return y.astype(self.dtype)
+
+
+def make_norm(cfg, name=None):
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype, name=name)
+    return nn.LayerNorm(epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+
+
+def rope_table(head_size, max_len, theta):
+    freq = 1.0 / (theta**(jnp.arange(0, head_size, 2, dtype=jnp.float32) / head_size))
+    pos = jnp.arange(max_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, freq)  # (T, hd/2)
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x, sin, cos):
+    """x: (B, T, H, hd); tables (T, hd/2). Citation: the reference's CUDA
+    ``apply_rotary_pos_emb`` (csrc/transformer/inference/csrc/pt_binding.cpp:1765)."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    sin = sin[None, :, None, :]
+    cos = cos[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _sdpa_xla(q, k, v, mask_bias, dtype):
+    """Pure-XLA attention: softmax in fp32, big-negative causal bias."""
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(hd)
+    scores = scores + mask_bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, sin, cos, attn_mask=None, kv_cache=None, cache_index=None):
+        cfg = self.cfg
+        B, T, H = x.shape
+        nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_size
+        dense = partial(nn.DenseGeneral, use_bias=cfg.norm == "layernorm", dtype=cfg.dtype,
+                        param_dtype=jnp.float32,
+                        kernel_init=nn.initializers.normal(0.02))
+        q = dense(features=(nh, hd), name="q_proj")(x)
+        k = dense(features=(nkv, hd), name="k_proj")(x)
+        v = dense(features=(nkv, hd), name="v_proj")(x)
+
+        if cfg.pos_embedding == "rope":
+            if cache_index is not None:
+                pos_sin = jax.lax.dynamic_slice_in_dim(sin, cache_index, T, axis=0)
+                pos_cos = jax.lax.dynamic_slice_in_dim(cos, cache_index, T, axis=0)
+            else:
+                pos_sin, pos_cos = sin[:T], cos[:T]
+            q = apply_rope(q, pos_sin, pos_cos)
+            k = apply_rope(k, pos_sin, pos_cos)
+
+        new_cache = None
+        if kv_cache is not None:
+            ck, cv = kv_cache
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_index, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_index, axis=1)
+            k, v = ck, cv
+            new_cache = (ck, cv)
+
+        # GQA: repeat kv heads
+        if nkv != nh:
+            rep = nh // nkv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+
+        S = k.shape[1]
+        if kv_cache is not None:
+            # decode: mask positions beyond the cache write head
+            kpos = jnp.arange(S)[None, None, None, :]
+            qpos = cache_index + jnp.arange(T)[None, None, :, None]
+            bias = jnp.where(kpos <= qpos, 0.0, -1e30).astype(jnp.float32)
+            out = _sdpa_xla(q, k, v, bias, cfg.dtype)
+        elif cfg.attention_impl == "flash" and T >= 128 and attn_mask is None:
+            from ..ops.pallas.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True,
+                                  block_q=cfg.attention_block_q, block_kv=cfg.attention_block_kv)
+        else:
+            causal = jnp.where(jnp.tril(jnp.ones((T, S), dtype=bool)), 0.0, -1e30)[None, None]
+            bias = causal
+            if attn_mask is not None:
+                bias = bias + jnp.where(attn_mask, 0.0, -1e30)[:, None, None, :].astype(jnp.float32)
+            out = _sdpa_xla(q, k, v, bias, cfg.dtype)
+
+        out = nn.DenseGeneral(features=H, axis=(-2, -1), use_bias=cfg.norm == "layernorm",
+                              dtype=cfg.dtype, param_dtype=jnp.float32,
+                              kernel_init=nn.initializers.normal(0.02), name="o_proj")(out)
+        return (out, new_cache) if kv_cache is not None else out
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=cfg.norm == "layernorm", dtype=cfg.dtype,
+                        param_dtype=jnp.float32, kernel_init=nn.initializers.normal(0.02))
+        if cfg.activation in ("swiglu", "geglu"):
+            gate = dense(cfg.ffn_size, name="gate_proj")(x)
+            up = dense(cfg.ffn_size, name="up_proj")(x)
+            act = nn.silu(gate) if cfg.activation == "swiglu" else nn.gelu(gate)
+            h = act * up
+        else:
+            h = dense(cfg.ffn_size, name="up_proj")(x)
+            h = nn.gelu(h) if cfg.activation == "gelu" else nn.relu(h)
+        return dense(cfg.hidden_size, name="down_proj")(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, sin, cos, attn_mask=None):
+        cfg = self.cfg
+        h = make_norm(cfg, name="attn_norm")(x)
+        x = x + Attention(cfg, name="attn")(h, sin, cos, attn_mask)
+        h = make_norm(cfg, name="mlp_norm")(x)
+        if cfg.num_experts > 0:
+            from ..moe.layer import MoE
+            ff, aux = MoE(cfg, name="moe")(h)
+            x = x + ff
+            self.sow("intermediates", "moe_aux_loss", aux)
+        else:
+            x = x + MLP(cfg, name="mlp")(h)
+        return x
+
+
+class CausalLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attn_mask=None):
+        cfg = self.cfg
+        B, T = input_ids.shape
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       embedding_init=nn.initializers.normal(0.02), name="embed")
+        x = emb(input_ids)
+        if cfg.pos_embedding == "learned":
+            pos_emb = self.param("pos_embed", nn.initializers.normal(0.02),
+                                 (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+            x = x + jax.lax.dynamic_slice_in_dim(pos_emb, 0, T, axis=0).astype(cfg.dtype)
+        sin, cos = (rope_table(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+                    if cfg.pos_embedding == "rope" else (None, None))
+
+        block = Block
+        if cfg.remat_policy:
+            policy = (None if cfg.remat_policy == "nothing_saveable" else getattr(
+                jax.checkpoint_policies, cfg.remat_policy, None))
+            block = nn.remat(Block, policy=policy, prevent_cse=not cfg.scan_layers,
+                             static_argnums=())
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (mdl(carry, sin, cos, attn_mask), None),
+                variable_axes={"params": 0, "intermediates": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                metadata_params={"partition_name": "layers"},
+            )(block(cfg, name="layers"), x, None)
+        else:
+            for i in range(cfg.num_layers):
+                x = block(cfg, name=f"layer_{i}")(x, sin, cos, attn_mask)
+
+        x = make_norm(cfg, name="final_norm")(x)
+        # logits matmul runs in compute dtype (MXU rate); CE upcasts to fp32
+        if cfg.tie_embeddings:
+            logits = emb.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=jnp.float32, name="lm_head")(x)
+        return logits
+
+
+class CausalLMModel:
+    """Engine-facing wrapper: init_params / loss / tp_rules / expert_pattern."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+        self.module = CausalLM(cfg)
+
+    def init_params(self, rng):
+        B, T = 2, min(self.cfg.max_seq_len, 128)
+        ids = jnp.zeros((B, T), jnp.int32)
+        return self.module.init({"params": rng}, ids)["params"]
+
+    def apply(self, params, input_ids, attn_mask=None):
+        return self.module.apply({"params": params}, input_ids, attn_mask)
+
+    def loss(self, params, batch, rng):
+        """Next-token cross entropy. batch: input_ids (B,T), optional labels
+        (B,T; -100 = ignore), optional attention_mask (B,T)."""
+        input_ids = batch["input_ids"]
+        attn_mask = batch.get("attention_mask")
+        out = self.module.apply({"params": params}, input_ids, attn_mask,
+                                mutable=["intermediates"] if self.cfg.num_experts > 0 else False)
+        logits, mutated = out if isinstance(out, tuple) else (out, {})
+
+        if "labels" in batch:
+            labels = batch["labels"]
+            logits_t = logits
+        else:
+            labels = input_ids[:, 1:]
+            logits_t = logits[:, :-1]
+        valid = (labels >= 0)
+        labels_c = jnp.maximum(labels, 0)
+        import optax
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits_t.astype(jnp.float32), labels_c)
+        loss = jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
+        if self.cfg.num_experts > 0:
+            aux = mutated.get("intermediates", {})
+            aux_losses = jax.tree_util.tree_leaves(aux)
+            if aux_losses:
+                loss = loss + self.cfg.moe_aux_loss_coef * sum(jnp.sum(a) for a in aux_losses)
+        return loss
+
+    # ---- sharding rules ---------------------------------------------------
+    def tp_rules(self):
+        """Megatron row/col sharding over the ``tensor`` axis (the training
+        analogue of inference AutoTP, reference ``module_inject/auto_tp.py:84``).
+        Note scanned layers carry a leading layer dim.
+        """
+        t = dist.TENSOR_AXIS
+        e = dist.EXPERT_AXIS
+        if self.cfg.scan_layers:
+            # scanned layers carry a leading L dim on every block param
+            return [
+                (r"experts/(gate|up)_proj$", (None, e, None, t)),  # (L, E, H, F)
+                (r"experts/down_proj$", (None, e, t, None)),  # (L, E, F, H)
+                (r"attn/(q|k|v)_proj/kernel", (None, None, t, None)),  # (L, H, heads, hd)
+                (r"attn/o_proj/kernel", (None, t, None, None)),  # (L, heads, hd, H)
+                (r"mlp/(gate|up)_proj/kernel", (None, None, t)),  # col
+                (r"mlp/down_proj/kernel", (None, t, None)),  # row
+                (r"embed/embedding", (t, None)),
+                (r"lm_head/kernel", (None, t)),
+            ]
+        return [
+            (r"experts/(gate|up)_proj$", (e, None, t)),
+            (r"experts/down_proj$", (e, t, None)),
+            (r"attn/(q|k|v)_proj/kernel", (None, t, None)),
+            (r"attn/o_proj/kernel", (t, None, None)),
+            (r"mlp/(gate|up)_proj/kernel", (None, t)),
+            (r"mlp/down_proj/kernel", (t, None)),
+            (r"embed/embedding", (t, None)),
+            (r"lm_head/kernel", (None, t)),
+        ]
+
+    def expert_pattern(self):
+        return r"moe/experts/" if self.cfg.num_experts > 0 else None

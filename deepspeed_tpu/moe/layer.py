@@ -1,0 +1,75 @@
+"""MoE layer.
+
+Analogue of reference ``deepspeed/moe/layer.py`` (``MoE`` :16) +
+``experts.py`` (``Experts`` :10). Experts are one batched weight with a
+leading expert dim sharded over the ``expert`` mesh axis; dispatch/combine
+einsums against expert-sharded intermediates make XLA insert the token
+all-to-alls that the reference issues by hand (``_AllToAll``,
+sharded_moe.py:90).
+"""
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..comm import comm as dist
+from .sharded_moe import top_k_gating
+
+
+def _expert_constraint(x, spec):
+    """Pin an (E, ...) intermediate to the expert axis when a mesh is live."""
+    if dist.has_mesh() and dist.get_mesh().shape[dist.EXPERT_AXIS] > 1:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(dist.get_mesh(), spec))
+    return x
+
+
+class Experts(nn.Module):
+    """Batched expert FFNs: weights (E, H, F)/(E, F, H)."""
+    num_experts: int
+    hidden: int
+    ffn: int
+    activation: str
+    dtype: any
+
+    @nn.compact
+    def __call__(self, x):  # x: (E, C, H)
+        init = nn.initializers.normal(0.02)
+        E, H, F = self.num_experts, self.hidden, self.ffn
+        gate_k = self.param("gate_proj", init, (E, H, F), jnp.float32)
+        up_k = self.param("up_proj", init, (E, H, F), jnp.float32)
+        down_k = self.param("down_proj", init, (E, F, H), jnp.float32)
+        x = x.astype(self.dtype)
+        gk, uk, dk = (k.astype(self.dtype) for k in (gate_k, up_k, down_k))
+        if self.activation in ("swiglu", "geglu"):
+            g = jnp.einsum("ech,ehf->ecf", x, gk)
+            u = jnp.einsum("ech,ehf->ecf", x, uk)
+            act = nn.silu(g) if self.activation == "swiglu" else nn.gelu(g)
+            h = act * u
+        else:
+            h = jnp.einsum("ech,ehf->ecf", x, uk)
+            h = nn.gelu(h) if self.activation == "gelu" else nn.relu(h)
+        return jnp.einsum("ecf,efh->ech", h, dk)
+
+
+class MoE(nn.Module):
+    """Top-k routed MoE FFN; returns (output, aux_loss)."""
+    cfg: any  # TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):  # x: (B, T, H)
+        cfg = self.cfg
+        B, T, H = x.shape
+        N, E = B * T, cfg.num_experts
+        tokens = x.reshape(N, H)
+
+        gate_w = self.param("gate", nn.initializers.normal(0.02), (H, E), jnp.float32)
+        logits = tokens.astype(jnp.float32) @ gate_w
+        dispatch, combine, aux_loss, _ = top_k_gating(logits, cfg.moe_top_k, cfg.moe_capacity_factor)
+
+        expert_in = jnp.einsum("nec,nh->ech", dispatch.astype(cfg.dtype), tokens)
+        expert_in = _expert_constraint(expert_in, P(dist.EXPERT_AXIS, None, None))
+        expert_out = Experts(E, H, cfg.ffn_size, cfg.activation, cfg.dtype, name="experts")(expert_in)
+        expert_out = _expert_constraint(expert_out, P(dist.EXPERT_AXIS, None, None))
+        out = jnp.einsum("nec,ech->nh", combine.astype(cfg.dtype), expert_out)
+        return out.reshape(B, T, H), aux_loss

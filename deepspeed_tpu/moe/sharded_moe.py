@@ -1,0 +1,80 @@
+"""MoE gating + dispatch math.
+
+Analogue of reference ``deepspeed/moe/sharded_moe.py`` (``TopKGate`` :343,
+``top1gating`` :179, ``top2gating`` :277, ``_capacity`` :157, ``MOELayer``
+:420 einsum dispatch, ``_AllToAll`` :90). The einsum dispatch/combine
+formulation ports naturally to XLA; the explicit ``_AllToAll`` autograd shim
+disappears — expert-sharding constraints make the SPMD partitioner insert
+(differentiable) all-to-alls over the ``expert`` mesh axis.
+
+All shapes are static (capacity-factor padding identical to ``_capacity``),
+as required for XLA compilation (SURVEY §7 hard-parts).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def capacity(num_tokens, num_experts, capacity_factor, min_capacity=4):
+    """Tokens per expert (reference ``_capacity``, sharded_moe.py:157)."""
+    cap = int(num_tokens * capacity_factor / num_experts)
+    return max(cap, min_capacity)
+
+
+def top_k_gating(logits, k, capacity_factor, min_capacity=4, rng=None, noise_std=0.0):
+    """Top-k gating with per-expert capacity.
+
+    Args:
+      logits: (N, E) router logits (fp32).
+    Returns:
+      dispatch: (N, E, C) one-hot dispatch mask.
+      combine: (N, E, C) combine weights.
+      aux_loss: load-balancing loss (reference l_aux, sharded_moe.py:217).
+      drop_frac: fraction of routed slots dropped by capacity.
+    """
+    N, E = logits.shape
+    C = capacity(N * k, E, capacity_factor, min_capacity)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    if rng is not None and noise_std > 0:
+        logits = logits + noise_std * jax.random.normal(rng, logits.shape)
+
+    # iterative top-k selection
+    masked = logits.astype(jnp.float32)
+    sel_masks = []
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # (N, E)
+        sel_masks.append(m)
+        masked = jnp.where(m > 0, -jnp.inf, masked)
+
+    # aux loss from the top-1 assignment (reference top1gating l_aux)
+    me = jnp.mean(probs, axis=0)  # (E,)
+    ce = jnp.mean(sel_masks[0], axis=0)  # (E,)
+    aux_loss = jnp.sum(me * ce) * E
+
+    # positions within expert buffers, k rounds share the capacity
+    dispatch = jnp.zeros((N, E, C), dtype=jnp.float32)
+    combine = jnp.zeros((N, E, C), dtype=jnp.float32)
+    prior_count = jnp.zeros((E, ), dtype=jnp.int32)
+    kept = jnp.zeros((), dtype=jnp.float32)
+    for m in sel_masks:
+        pos = jnp.cumsum(m, axis=0) - 1 + prior_count[None, :]  # (N, E)
+        keep = (pos < C) & (m > 0)
+        kept = kept + jnp.sum(keep)
+        loc = jnp.where(keep, pos, 0).astype(jnp.int32)
+        oh = jax.nn.one_hot(jnp.sum(loc * m.astype(jnp.int32), axis=-1), C,
+                            dtype=jnp.float32)  # (N, C) position one-hot
+        d = (m * keep)[:, :, None] * oh[:, None, :]  # (N, E, C)
+        gate_p = jnp.sum(probs * m, axis=-1, keepdims=True)  # (N, 1)
+        dispatch = dispatch + d
+        combine = combine + d * gate_p[:, :, None]
+        prior_count = prior_count + jnp.sum(m, axis=0).astype(jnp.int32)
+
+    # renormalize combine weights over selected experts (top-2 norm, ref :303)
+    if k > 1:
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+
+    drop_frac = 1.0 - kept / (N * k)
+    return dispatch, combine, aux_loss, drop_frac

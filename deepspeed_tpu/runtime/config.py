@@ -190,7 +190,11 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
         self.mpu = mpu
         if mpu is not None and self.mesh.data_parallel_size is None:
             try:
-                self.mesh.data_parallel_size = mpu.get_data_parallel_world_size()
+                # mpu reports the combined DP group (DeepSpeed convention,
+                # includes expert ranks); our data axis excludes expert
+                mpu_dp = mpu.get_data_parallel_world_size()
+                if mpu_dp % self.mesh.expert_parallel_size == 0:
+                    self.mesh.data_parallel_size = mpu_dp // self.mesh.expert_parallel_size
             except Exception:
                 pass
         if self.gradient_checkpointing is not None:
@@ -202,24 +206,32 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
 
     # -- batch size arithmetic (reference config.py:738-760) ---------------
     def _resolve_data_parallel_size(self):
+        """The ZeRO data-parallel group spans expert×data; data is what's
+        left of the world after tp/pp/sp/ep are laid out."""
         m = self.mesh
         non_dp = m.tensor_parallel_size * m.pipeline_parallel_size * m.sequence_parallel_size
         if self.world_size % non_dp != 0:
             raise DeepSpeedConfigError(
                 f"world size {self.world_size} not divisible by tp*pp*sp = {non_dp}")
-        inferred_dp = self.world_size // non_dp
+        combined_dp = self.world_size // non_dp  # expert * data
+        if combined_dp % m.expert_parallel_size != 0:
+            raise DeepSpeedConfigError(
+                f"dp group size {combined_dp} not divisible by expert_parallel_size "
+                f"{m.expert_parallel_size}")
+        inferred_data = combined_dp // m.expert_parallel_size
         if m.data_parallel_size is None:
-            m.data_parallel_size = inferred_dp
-        elif m.data_parallel_size != inferred_dp and self.world_size > 1:
+            m.data_parallel_size = inferred_data
+        elif m.data_parallel_size != inferred_data and self.world_size > 1:
             raise DeepSpeedConfigError(
                 f"data_parallel_size {m.data_parallel_size} inconsistent with world size "
-                f"{self.world_size} / (tp*pp*sp) {non_dp}")
+                f"{self.world_size} / (tp*pp*sp*ep) = {inferred_data}")
 
     def _configure_train_batch_size(self):
         train_batch = self.train_batch_size
         micro_batch = self.train_micro_batch_size_per_gpu
         grad_acc = self.gradient_accumulation_steps
-        dp = self.mesh.data_parallel_size
+        # batch replicas span the full ZeRO dp group: expert × data
+        dp = self.mesh.data_parallel_size * self.mesh.expert_parallel_size
 
         if train_batch is not None and micro_batch is not None and grad_acc is not None:
             pass

@@ -510,12 +510,12 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps()
         if batch is not None:
             leading = {np.shape(x)[0] for x in jax.tree_util.tree_leaves(batch)}
-            bad = [n for n in leading if n % gas != 0]
-            if bad:
+            if leading != {self.train_batch_size()}:
                 raise ValueError(
-                    f"train_batch(batch=...) leaves have leading dim {sorted(leading)} which must be "
-                    f"divisible by gradient_accumulation_steps={gas} (expected the full train batch "
-                    f"of {self.train_batch_size()} samples)")
+                    f"train_batch(batch=...) leaves have leading dim {sorted(leading)}; expected the "
+                    f"full train batch of {self.train_batch_size()} samples "
+                    f"(= micro {self.train_micro_batch_size_per_gpu()} x gas {gas} x "
+                    f"dp {self.dp_world_size()})")
             stacked = jax.tree_util.tree_map(
                 lambda x: np.asarray(x).reshape((gas, -1) + np.shape(x)[1:]), batch)
         else:

@@ -120,6 +120,24 @@ class ShardingPlanner:
                                       if zero_config is not None else int(1e5))
 
     # -- single-leaf planning ------------------------------------------------
+    def _validate(self, spec, shape, path_str):
+        """Drop sharding entries whose dim extent isn't divisible by the axis
+        size (e.g. 2 kv-heads under tensor=4 fall back to replication)."""
+        entries = list(spec)
+        changed = False
+        for d, entry in enumerate(entries):
+            if entry is None:
+                continue
+            axes = entry if isinstance(entry, (tuple, list)) else (entry, )
+            size = int(np.prod([self.mesh.shape[a] for a in axes]))
+            if d >= len(shape) or shape[d] % size != 0:
+                entries[d] = None
+                changed = True
+        if changed:
+            logger.debug(f"{path_str}: shape {shape} not divisible by rule {spec}; "
+                         f"relaxed to {P(*entries)}")
+        return P(*entries)
+
     def _dp_axes_for(self, path_str):
         if self.expert_pattern is not None and self.expert_pattern.search(path_str):
             return (dist.DATA_AXIS, )
@@ -147,6 +165,7 @@ class ShardingPlanner:
         """PartitionSpec for a *model* (compute) parameter."""
         ndim = len(shape)
         spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
+        spec = self._validate(spec, shape, path_str)
         if self.stage >= ZeroStageEnum.weights:
             n_elem = int(np.prod(shape)) if shape else 1
             if n_elem > self.persistence_threshold:
@@ -157,6 +176,7 @@ class ShardingPlanner:
         """PartitionSpec for fp32 master params + optimizer moments."""
         ndim = len(shape)
         spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
+        spec = self._validate(spec, shape, path_str)
         if self.stage >= ZeroStageEnum.optimizer_states:
             spec = self._apply_dp(spec, shape, path_str)
         return spec
@@ -165,6 +185,7 @@ class ShardingPlanner:
         """PartitionSpec for gradients/accumulators: stage >= 2 scatters."""
         ndim = len(shape)
         spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
+        spec = self._validate(spec, shape, path_str)
         if self.stage >= ZeroStageEnum.gradients:
             spec = self._apply_dp(spec, shape, path_str)
         return spec
@@ -217,15 +238,6 @@ class ShardingPlanner:
 
     def replicated(self):
         return NamedSharding(self.mesh, P())
-
-    def batch_sharding(self, extra_leading_dims=0):
-        """Batch dim sharded over the full DP group (and seq axis over the
-        sequence dim when sequence parallelism is on)."""
-        dp = [a for a in (dist.EXPERT_AXIS, dist.DATA_AXIS) if self.mesh.shape[a] > 1]
-        entries = [None] * extra_leading_dims + [tuple(dp) if dp else None]
-        if self.mesh.shape[dist.SEQ_AXIS] > 1:
-            entries = entries + [dist.SEQ_AXIS]
-        return NamedSharding(self.mesh, P(*entries))
 
     def describe(self, params):
         """Human-readable plan dump (ds_report-style aid)."""
