@@ -29,7 +29,7 @@ def main():
     seq = 1024
     micro_bs = 16
     model_name = "gpt2-125m"
-    model = get_model(model_name, remat_policy="dots_saveable", attention_impl="xla")
+    model = get_model(model_name, remat_policy="dots_saveable", attention_impl="flash")
     cfg = _PRESETS[model_name]()
 
     n_chips = len(jax.devices())

@@ -231,26 +231,31 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, sin, cos, attn_mask=None):
+    def __call__(self, x, sin, cos, attn_mask=None, deterministic=True):
         cfg = self.cfg
+        drop = nn.Dropout(rate=cfg.dropout) if cfg.dropout > 0 else None
         h = make_norm(cfg, name="attn_norm")(x)
-        x = x + Attention(cfg, name="attn")(h, sin, cos, attn_mask)
+        h = Attention(cfg, name="attn")(h, sin, cos, attn_mask)
+        if drop is not None:
+            h = drop(h, deterministic=deterministic)
+        x = x + h
         h = make_norm(cfg, name="mlp_norm")(x)
         if cfg.num_experts > 0:
             from ..moe.layer import MoE
             ff, aux = MoE(cfg, name="moe")(h)
-            x = x + ff
             self.sow("intermediates", "moe_aux_loss", aux)
         else:
-            x = x + MLP(cfg, name="mlp")(h)
-        return x
+            ff = MLP(cfg, name="mlp")(h)
+        if drop is not None:
+            ff = drop(ff, deterministic=deterministic)
+        return x + ff
 
 
 class CausalLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, attn_mask=None):
+    def __call__(self, input_ids, attn_mask=None, deterministic=True):
         cfg = self.cfg
         B, T = input_ids.shape
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -271,7 +276,7 @@ class CausalLM(nn.Module):
                              static_argnums=())
         if cfg.scan_layers:
             x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, sin, cos, attn_mask), None),
+                lambda mdl, carry, _: (mdl(carry, sin, cos, attn_mask, deterministic), None),
                 variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
@@ -279,7 +284,7 @@ class CausalLM(nn.Module):
             )(block(cfg, name="layers"), x, None)
         else:
             for i in range(cfg.num_layers):
-                x = block(cfg, name=f"layer_{i}")(x, sin, cos, attn_mask)
+                x = block(cfg, name=f"layer_{i}")(x, sin, cos, attn_mask, deterministic)
 
         x = make_norm(cfg, name="final_norm")(x)
         # logits matmul runs in compute dtype (MXU rate); CE upcasts to fp32
@@ -306,13 +311,21 @@ class CausalLMModel:
     def apply(self, params, input_ids, attn_mask=None):
         return self.module.apply({"params": params}, input_ids, attn_mask)
 
+    def _apply_kwargs(self, rng):
+        """Dropout is active iff a step rng is provided and rate > 0."""
+        if rng is not None and self.cfg.dropout > 0:
+            return {"rngs": {"dropout": rng}, "deterministic": False}
+        return {"deterministic": True}
+
     def loss(self, params, batch, rng):
         """Next-token cross entropy. batch: input_ids (B,T), optional labels
         (B,T; -100 = ignore), optional attention_mask (B,T)."""
         input_ids = batch["input_ids"]
         attn_mask = batch.get("attention_mask")
-        out = self.module.apply({"params": params}, input_ids, attn_mask,
-                                mutable=["intermediates"] if self.cfg.num_experts > 0 else False)
+        kw = self._apply_kwargs(rng)
+        det = kw.pop("deterministic")
+        out = self.module.apply({"params": params}, input_ids, attn_mask, det,
+                                mutable=["intermediates"] if self.cfg.num_experts > 0 else False, **kw)
         logits, mutated = out if isinstance(out, tuple) else (out, {})
 
         if "labels" in batch:

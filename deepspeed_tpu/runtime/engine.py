@@ -551,7 +551,8 @@ class DeepSpeedEngine:
             self.state, loss = fn(self.state, batch)
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).stop()
-        self._pending_batches.append(float(loss))
+        # keep the device array: no host sync per micro-step
+        self._pending_batches.append(loss)
         return loss
 
     def backward(self, loss=None, allreduce_gradients=True, retain_graph=False):
@@ -573,8 +574,9 @@ class DeepSpeedEngine:
             return  # not at boundary yet
         if self.wall_clock_breakdown:
             self.timers(STEP_GLOBAL_TIMER).start()
-        loss_mean = jnp.asarray(np.mean(self._pending_batches[-self.gradient_accumulation_steps():] or [0.0]),
-                                jnp.float32)
+        pending = self._pending_batches[-self.gradient_accumulation_steps():]
+        loss_mean = (jnp.mean(jnp.stack([jnp.asarray(p, jnp.float32) for p in pending]))
+                     if pending else jnp.zeros((), jnp.float32))
         fn = self._get("apply", self._build_apply_fn)
         with self.mesh:
             self.state, metrics = fn(self.state, loss_mean)

@@ -94,11 +94,12 @@ class DynamicLossScaler(LossScalerBase):
                               state.cur_scale)
         ovf_hyst = jnp.where(depleted, state.cur_hysteresis, state.cur_hysteresis - 1)
 
-        # clean branch
+        # clean branch (reference loss_scaler.py:195: consecutive_hysteresis
+        # re-arms every clean step; otherwise re-arm on each full clean window)
         window_full = (it - state.last_overflow_iter) % self.scale_window == (self.scale_window - 1)
         ok_scale = jnp.where(window_full, state.cur_scale * self.scale_factor, state.cur_scale)
-        ok_hyst = jnp.where(self.consecutive_hysteresis, jnp.asarray(self.delayed_shift, jnp.int32),
-                            state.cur_hysteresis)
+        rearm = jnp.logical_or(jnp.asarray(self.consecutive_hysteresis), window_full)
+        ok_hyst = jnp.where(rearm, jnp.asarray(self.delayed_shift, jnp.int32), state.cur_hysteresis)
 
         return LossScaleState(
             cur_scale=jnp.where(has_overflow, ovf_scale, ok_scale),
