@@ -60,8 +60,11 @@ def initialize(args=None,
     return engine, engine, engine.training_dataloader, engine.lr_scheduler
 
 
-def init_inference(model=None, config=None, **kwargs):
-    """Initialize the inference engine (reference ``deepspeed.init_inference``)."""
+def init_inference(model=None, config=None, params=None, **kwargs):
+    """Initialize the inference engine (reference ``deepspeed.init_inference``).
+
+    ``model``: a ``deepspeed_tpu.models`` model or preset name. ``params``:
+    optional weight pytree (otherwise loaded from ``config['checkpoint']``)."""
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
     if isinstance(config, DeepSpeedInferenceConfig):
@@ -70,7 +73,7 @@ def init_inference(model=None, config=None, **kwargs):
         config_dict = dict(config or {})
         config_dict.update(kwargs)
         ds_inference_config = DeepSpeedInferenceConfig(config_dict)
-    return InferenceEngine(model, config=ds_inference_config)
+    return InferenceEngine(model, config=ds_inference_config, params=params)
 
 
 def add_config_arguments(parser):

@@ -1,0 +1,83 @@
+"""Inference config.
+
+Analogue of reference ``deepspeed/inference/config.py``
+(``DeepSpeedInferenceConfig``), with the same key surface where it makes
+sense on TPU. GPU-only switches (``enable_cuda_graph``: XLA compiles the
+decode step, so graph capture is implicit) are accepted and logged as no-ops
+so reference configs load unchanged.
+"""
+
+import jax.numpy as jnp
+
+from ..runtime.config_utils import DeepSpeedConfigModel, ConfigField
+from ..utils.logging import logger
+
+_DTYPE_MAP = {
+    "bf16": jnp.bfloat16,
+    "bfloat16": jnp.bfloat16,
+    "fp16": jnp.bfloat16,  # fp16 requested -> bf16 (TPU-native half)
+    "float16": jnp.bfloat16,
+    "half": jnp.bfloat16,
+    "fp32": jnp.float32,
+    "float32": jnp.float32,
+    "float": jnp.float32,
+    "int8": jnp.int8,
+}
+
+
+class TensorParallelConfig(DeepSpeedConfigModel):
+    tp_size = ConfigField(default=1)
+    enabled = ConfigField(default=True)
+    mpu = ConfigField(default=None)
+    tp_group = ConfigField(default=None)
+
+
+class QuantConfig(DeepSpeedConfigModel):
+    enabled = ConfigField(default=False)
+    qkv = ConfigField(default=None)
+
+
+class MoEInferenceConfig(DeepSpeedConfigModel):
+    enabled = ConfigField(default=True)
+    ep_size = ConfigField(default=1)
+    moe_experts = ConfigField(default=lambda: [1])
+    type = ConfigField(default="standard")
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    """Reference ``inference/config.py`` key parity."""
+
+    kernel_inject = ConfigField(default=False, aliases=("replace_with_kernel_inject", ))
+    dtype = ConfigField(default="bfloat16")
+    tensor_parallel = ConfigField(default=TensorParallelConfig, aliases=("tp", ))
+    min_out_tokens = ConfigField(default=1)
+    max_out_tokens = ConfigField(default=1024, aliases=("max_tokens", ))
+    checkpoint = ConfigField(default=None)
+    base_dir = ConfigField(default="")
+    quant = ConfigField(default=QuantConfig)
+    moe = ConfigField(default=MoEInferenceConfig)
+    triangular_masking = ConfigField(default=True)
+    return_tuple = ConfigField(default=True)
+    training_mp_size = ConfigField(default=1)
+    replace_method = ConfigField(default="auto")
+    injection_policy = ConfigField(default=None)
+    enable_cuda_graph = ConfigField(default=False)
+    save_mp_checkpoint_path = ConfigField(default=None)
+    # TPU additions
+    decode_block_kv = ConfigField(default=256, help="KV block streamed per decode-kernel step")
+    mp_size = ConfigField(default=None, help="deprecated alias for tensor_parallel.tp_size")
+
+    def __init__(self, param_dict=None):
+        super().__init__(param_dict)
+        if self.mp_size is not None:
+            logger.warning("Config parameter mp_size is deprecated, use tensor_parallel.tp_size")
+            self.tensor_parallel.tp_size = self.mp_size
+        if self.enable_cuda_graph:
+            logger.info("enable_cuda_graph ignored: the decode step is XLA-compiled (graph capture implicit)")
+        if isinstance(self.dtype, str):
+            key = self.dtype.replace("torch.", "")
+            if key not in _DTYPE_MAP:
+                raise ValueError(f"Invalid inference dtype {self.dtype!r}; expected one of {sorted(_DTYPE_MAP)}")
+            if key in ("fp16", "float16", "half"):
+                logger.info("fp16 inference requested; using bfloat16 (TPU-native half precision)")
+            self.dtype = _DTYPE_MAP[key]

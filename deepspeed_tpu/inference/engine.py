@@ -1,0 +1,265 @@
+"""Inference engine.
+
+TPU-native analogue of reference ``inference/engine.py`` (``InferenceEngine``
+:89, ``_create_model_parallel_group`` :261, ``forward`` :560) plus the
+generation path the reference implements with injected CUDA kernels
+(``module_inject/replace_module.py:279`` + ``pt_binding.cpp:1745``). Design
+translation:
+
+- Kernel injection -> the model's Pallas attention paths
+  (``attention_impl='flash'``: flash prefill + GQA decode kernel); the
+  "no-kernel" path is pure XLA. Both share one weight layout — there is no
+  module rewriting because models here are functional already.
+- CUDA-graph capture -> jit: prefill and the whole decode loop compile to two
+  XLA programs per (batch, prompt-bucket) shape.
+- AutoTP -> the model's PartitionSpec rules over the ``tensor`` mesh axis
+  (``runtime/zero/sharding.py:TensorParallelRules``).
+- KV-cache workspace -> a preallocated (L, B, kv_heads, S, head_dim) pair,
+  donated through the decode loop.
+
+Batched generation uses left-padding: prompts are right-aligned so every row
+shares one cache write head; per-row RoPE/learned positions come from
+``position_ids`` and left-pad slots are masked out of attention.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm import comm as dist
+from ..runtime.zero.sharding import ShardingPlanner
+from ..utils.logging import logger, log_dist
+from .config import DeepSpeedInferenceConfig
+
+
+def _round_up(x, m):
+    return (x + m - 1) // m * m
+
+
+def _sample_tokens(rng, logits, do_sample, temperature, top_k, top_p):
+    """Greedy or filtered sampling. logits: (B, V) fp32."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (always >= 1 token)
+        keep = jnp.concatenate([jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p], axis=-1)
+        threshold = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True)
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits).astype(jnp.int32)
+
+
+class InferenceEngine:
+    """Wraps a zoo model (or preset name) for TP-sharded generation."""
+
+    def __init__(self, model, config=None, params=None):
+        self._config = config if isinstance(config, DeepSpeedInferenceConfig) else \
+            DeepSpeedInferenceConfig(dict(config or {}))
+        cfg = self._config
+
+        if isinstance(model, str):
+            from ..models import get_model
+            model = get_model(model)
+        if not hasattr(model, "cfg") or not hasattr(model, "apply_with_cache"):
+            raise ValueError("init_inference expects a deepspeed_tpu model (CausalLMModel or preset "
+                             f"name); got {type(model)}")
+
+        # dtype + kernel selection are model-config switches
+        overrides = {"dtype": cfg.dtype, "decode_block_kv": cfg.decode_block_kv}
+        if cfg.kernel_inject:
+            overrides["attention_impl"] = "flash"
+        self.module = type(model)(dataclasses.replace(model.cfg, **overrides))
+        self.model_config = self.module.cfg
+
+        tp = cfg.tensor_parallel.tp_size
+        if dist.has_mesh():
+            self.mesh = dist.get_mesh()
+            if self.mesh.shape[dist.TENSOR_AXIS] != tp and tp > 1:
+                raise ValueError(f"existing mesh has tensor={self.mesh.shape[dist.TENSOR_AXIS]}, "
+                                 f"config asks tp_size={tp}")
+        else:
+            self.mesh = dist.initialize_mesh(tensor=tp)
+
+        self.planner = ShardingPlanner(self.mesh, None, tp_rules=self.module.tp_rules(),
+                                       expert_pattern=self.module.expert_pattern())
+        self.params = self._materialize_params(params)
+        self._compiled = {}
+        log_dist(
+            f"InferenceEngine ready: model dtype={jnp.dtype(self.model_config.dtype).name} "
+            f"tp={self.mesh.shape[dist.TENSOR_AXIS]} kernel_inject={cfg.kernel_inject} "
+            f"max_out_tokens={cfg.max_out_tokens}", [0])
+
+    # ------------------------------------------------------------------ params
+    def _materialize_params(self, params):
+        shardings = self.planner.shardings(self.planner.master_specs(
+            params if params is not None else jax.eval_shape(self.module.init_params, jax.random.key(0))))
+        dtype = self.model_config.dtype
+        if params is not None:
+            cast = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), p),
+                           out_shardings=shardings)
+            with self.mesh:
+                return cast(params)
+        if self._config.checkpoint:
+            host = self._load_checkpoint_host(self._config.checkpoint)
+            cast = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), p),
+                           out_shardings=shardings)
+            with self.mesh:
+                return cast(host)
+        logger.warning("init_inference: no checkpoint/params given; initializing random weights")
+        init = jax.jit(lambda rng: jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype),
+                                                          self.module.init_params(rng)),
+                       out_shardings=shardings)
+        with self.mesh:
+            return init(jax.random.key(0))
+
+    def _load_checkpoint_host(self, path):
+        """Load weights from a ``save_16bit_model`` msgpack export or a
+        training checkpoint dir (reference ``inference/engine.py:419``
+        checkpoint loading, minus torch state_dict zoo)."""
+        import os
+        import flax.serialization
+        if os.path.isfile(path):
+            template = jax.eval_shape(self.module.init_params, jax.random.key(0))
+            template = jax.tree_util.tree_map(lambda s: np.zeros(s.shape, s.dtype), template)
+            with open(path, "rb") as f:
+                return flax.serialization.from_bytes(template, f.read())
+        from ..runtime.checkpoint_engine.engine import load_params_only
+        abstract = jax.eval_shape(self.module.init_params, jax.random.key(0))
+        return load_params_only(path, abstract_params=abstract)
+
+    # ------------------------------------------------------------------ forward
+    def forward(self, input_ids, attention_mask=None):
+        """Full-sequence logits (reference ``InferenceEngine.forward`` :560)."""
+        if "fwd" not in self._compiled:
+            self._compiled["fwd"] = jax.jit(self.module.apply)
+        with self.mesh:
+            return self._compiled["fwd"](self.params, jnp.asarray(input_ids, jnp.int32),
+                                         None if attention_mask is None else jnp.asarray(attention_mask, bool))
+
+    __call__ = forward
+
+    # ------------------------------------------------------------------ generate
+    def _build_generate(self, B, P, S, max_gen, do_sample, temperature, top_k, top_p, eos, pad):
+        model = self.module
+
+        def generate(params, cache, ids, pads, max_new, rng):
+            # ids: (B, P) left-padded; pads: (B,) pad counts
+            cache_mask = jnp.arange(S)[None, :] >= pads[:, None]  # (B, S)
+            pos_prefill = jnp.maximum(jnp.arange(P)[None, :] - pads[:, None], 0)
+            logits, cache = model.apply_with_cache(params, ids, cache, 0, cache_mask, pos_prefill)
+            rng, sub = jax.random.split(rng)
+            tok = _sample_tokens(sub, logits[:, -1].astype(jnp.float32), do_sample, temperature,
+                                 top_k, top_p)
+            buf = jnp.full((B, max_gen), pad, jnp.int32)
+            buf = buf.at[:, 0].set(tok)
+            done = (tok == eos) if eos is not None else jnp.zeros((B, ), bool)
+
+            def cond(c):
+                _, _, done, t, _, _ = c
+                return (t < max_new - 1) & ~jnp.all(done)
+
+            def body(c):
+                cache, buf, done, t, rng, tok = c
+                pos = (P + t - pads)[:, None]  # (B, 1) true positions
+                logits, cache = model.apply_with_cache(params, tok[:, None], cache, P + t,
+                                                       cache_mask, pos)
+                rng, sub = jax.random.split(rng)
+                nxt = _sample_tokens(sub, logits[:, 0].astype(jnp.float32), do_sample, temperature,
+                                     top_k, top_p)
+                if eos is not None:
+                    nxt = jnp.where(done, pad, nxt)
+                    new_done = done | (nxt == eos)
+                else:
+                    new_done = done
+                buf = jnp.where(done[:, None] | (jnp.arange(max_gen)[None, :] != t + 1), buf,
+                                nxt[:, None])
+                return cache, buf, new_done, t + 1, rng, nxt
+
+            cache, buf, done, t, rng, tok = jax.lax.while_loop(
+                cond, body, (cache, buf, done, jnp.zeros((), jnp.int32), rng, tok))
+            n_tokens = jnp.minimum(max_new, max_gen)
+            return buf, n_tokens
+
+        return jax.jit(generate, donate_argnums=(1, ))
+
+    def generate(self, input_ids, max_new_tokens=64, do_sample=False, temperature=1.0, top_k=0,
+                 top_p=1.0, eos_token_id=None, pad_token_id=0, seed=0):
+        """Batched generation. ``input_ids``: list of token lists or (B, P)
+        array. Returns a list of 1-D np arrays of *new* tokens per row
+        (trimmed at ``eos_token_id``)."""
+        rows = [np.asarray(r, np.int32).reshape(-1) for r in input_ids]
+        B = len(rows)
+        lens = np.array([len(r) for r in rows], np.int32)
+        if lens.min() < 1:
+            raise ValueError("generate() requires at least one prompt token per row")
+        P = int(_round_up(lens.max(), 64))
+        # cache length: multiple of the decode-kernel KV block (or of 64 when
+        # the whole cache fits in one block)
+        block = self._config.decode_block_kv
+        S = int(_round_up(P + max_new_tokens, 64))
+        if S > block:
+            S = int(_round_up(S, block))
+        if S > self.model_config.max_seq_len:
+            raise ValueError(f"prompt+max_new_tokens needs cache of {S} > model max_seq_len "
+                             f"{self.model_config.max_seq_len}")
+        if S > self._config.max_out_tokens:
+            raise ValueError(f"prompt+max_new_tokens needs cache of {S} tokens > max_out_tokens="
+                             f"{self._config.max_out_tokens}; raise max_out_tokens")
+        pads = P - lens
+        ids = np.full((B, P), pad_token_id, np.int32)
+        for i, r in enumerate(rows):
+            ids[i, pads[i]:] = r
+
+        max_gen = S - P
+        key = ("gen", B, P, S, max_gen, do_sample, float(temperature), int(top_k), float(top_p),
+               eos_token_id, pad_token_id)
+        if key not in self._compiled:
+            self._compiled[key] = self._build_generate(B, P, S, max_gen, do_sample, temperature,
+                                                       top_k, top_p, eos_token_id, pad_token_id)
+        cache = self._init_cache(B, S)
+        with self.mesh:
+            buf, _ = self._compiled[key](self.params, cache, jnp.asarray(ids), jnp.asarray(pads),
+                                         jnp.asarray(max_new_tokens, jnp.int32),
+                                         jax.random.key(seed))
+        buf = np.asarray(jax.device_get(buf))[:, :max_new_tokens]
+        out = []
+        for i in range(B):
+            row = buf[i]
+            if eos_token_id is not None:
+                hits = np.nonzero(row == eos_token_id)[0]
+                if hits.size:
+                    row = row[:hits[0] + 1]
+            out.append(row)
+        return out
+
+    def _init_cache(self, B, S):
+        nkv = self.model_config.kv_heads
+        spec_axes = [None, None, None, None, None]
+        if nkv % self.mesh.shape[dist.TENSOR_AXIS] == 0:
+            spec_axes[2] = dist.TENSOR_AXIS
+        from jax.sharding import NamedSharding, PartitionSpec as P_
+        sharding = NamedSharding(self.mesh, P_(*spec_axes))
+        init = jax.jit(lambda: self.module.init_cache(B, S),
+                       out_shardings=(sharding, sharding))
+        with self.mesh:
+            return init()
+
+    # ------------------------------------------------------------------ misc parity
+    @property
+    def config(self):
+        return self._config
+
+    def eval(self):
+        return self
+
+    def train(self, mode=True):
+        return self

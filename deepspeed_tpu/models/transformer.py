@@ -58,6 +58,7 @@ class TransformerConfig:
     attention_impl: str = "xla"  # "xla" | "flash"
     attention_block_q: int = 512
     attention_block_kv: int = 512
+    decode_block_kv: int = 256  # KV block per decode-kernel step
 
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash"):
@@ -129,11 +130,16 @@ def rope_table(head_size, max_len, theta):
 
 
 def apply_rope(x, sin, cos):
-    """x: (B, T, H, hd); tables (T, hd/2). Citation: the reference's CUDA
-    ``apply_rotary_pos_emb`` (csrc/transformer/inference/csrc/pt_binding.cpp:1765)."""
+    """x: (B, T, H, hd); tables (T, hd/2) shared across the batch or
+    (B, T, hd/2) per-row (left-padded generation). Citation: the reference's
+    CUDA ``apply_rotary_pos_emb`` (csrc/transformer/inference/csrc/pt_binding.cpp:1765)."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    sin = sin[None, :, None, :]
-    cos = cos[None, :, None, :]
+    if sin.ndim == 2:
+        sin = sin[None, :, None, :]
+        cos = cos[None, :, None, :]
+    else:
+        sin = sin[:, :, None, :]
+        cos = cos[:, :, None, :]
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
@@ -146,11 +152,41 @@ def _sdpa_xla(q, k, v, mask_bias, dtype):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype):
+    """Grouped-query attention against a KV cache, no head expansion.
+
+    q: (B, T, nh, hd); ck/cv: (B, nkv, S, hd); cache_mask: optional (B, S)
+    bool marking valid cache slots (left-pad masking). Query position ``i`` of
+    this call sits at absolute cache position ``cache_index + i``.
+    """
+    B, T, nh, hd = q.shape
+    nkv, S = ck.shape[1], ck.shape[2]
+    g = nh // nkv
+    qg = q.reshape(B, T, nkv, g, hd)
+    scores = jnp.einsum("btkgd,bksd->bkgts", qg, ck).astype(jnp.float32) / jnp.sqrt(hd)
+    kpos = jnp.arange(S)[None, :]
+    qpos = cache_index + jnp.arange(T)[:, None]
+    bias = jnp.where(kpos <= qpos, 0.0, -1e30)  # (T, S)
+    if cache_mask is not None:
+        bias = bias[None] + jnp.where(cache_mask, 0.0, -1e30)[:, None, :]  # (B, T, S)
+        bias = bias[:, None, None]
+    else:
+        bias = bias[None, None, None]
+    probs = jax.nn.softmax(scores + bias, axis=-1).astype(dtype)
+    out = jnp.einsum("bkgts,bksd->btkgd", probs, cv)
+    return out.reshape(B, T, nh, hd)
+
+
 class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, sin, cos, attn_mask=None, kv_cache=None, cache_index=None):
+    def __call__(self, x, sin, cos, attn_mask=None, kv_cache=None, cache_index=None,
+                 position_ids=None):
+        """``attn_mask`` semantics: without a cache it is (B, T) over the
+        current tokens; with a cache it is (B, S) over cache slots (True =
+        attendable, used for left-pad masking during generation).
+        """
         cfg = self.cfg
         B, T, H = x.shape
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_size
@@ -162,7 +198,9 @@ class Attention(nn.Module):
         v = dense(features=(nkv, hd), name="v_proj")(x)
 
         if cfg.pos_embedding == "rope":
-            if cache_index is not None:
+            if position_ids is not None:
+                pos_sin, pos_cos = sin[position_ids], cos[position_ids]  # (B, T, hd/2)
+            elif cache_index is not None:
                 pos_sin = jax.lax.dynamic_slice_in_dim(sin, cache_index, T, axis=0)
                 pos_cos = jax.lax.dynamic_slice_in_dim(cos, cache_index, T, axis=0)
             else:
@@ -170,42 +208,47 @@ class Attention(nn.Module):
             q = apply_rope(q, pos_sin, pos_cos)
             k = apply_rope(k, pos_sin, pos_cos)
 
-        new_cache = None
         if kv_cache is not None:
+            # cache layout (B, nkv, S, hd): contiguous (S, hd) slabs per head,
+            # the shape the Pallas decode kernel streams (reference KV-cache
+            # arena: csrc/transformer/inference/includes/inference_context.h)
             ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_index, axis=1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_index, axis=1)
-            k, v = ck, cv
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.transpose(0, 2, 1, 3).astype(ck.dtype),
+                                                     cache_index, axis=2)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.transpose(0, 2, 1, 3).astype(cv.dtype),
+                                                     cache_index, axis=2)
+            if cfg.attention_impl == "flash" and T == 1:
+                from ..ops.pallas.decode_attention import decode_attention
+                if attn_mask is not None:
+                    starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
+                else:
+                    starts = jnp.zeros((B, ), jnp.int32)
+                out = decode_attention(q[:, 0], ck, cv, starts, cache_index + 1,
+                                       block_kv=cfg.decode_block_kv)[:, None]
+            else:
+                out = _cached_attention_xla(q, ck, cv, cache_index, attn_mask, cfg.dtype)
+            out = out.astype(cfg.dtype)
             new_cache = (ck, cv)
-
-        # GQA: repeat kv heads
-        if nkv != nh:
-            rep = nh // nkv
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
-
-        S = k.shape[1]
-        if kv_cache is not None:
-            # decode: mask positions beyond the cache write head
-            kpos = jnp.arange(S)[None, None, None, :]
-            qpos = cache_index + jnp.arange(T)[None, None, :, None]
-            bias = jnp.where(kpos <= qpos, 0.0, -1e30).astype(jnp.float32)
-            out = _sdpa_xla(q, k, v, bias, cfg.dtype)
-        elif cfg.attention_impl == "flash" and T >= 128 and attn_mask is None:
-            from ..ops.pallas.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=True,
-                                  block_q=cfg.attention_block_q, block_kv=cfg.attention_block_kv)
         else:
-            causal = jnp.where(jnp.tril(jnp.ones((T, S), dtype=bool)), 0.0, -1e30)[None, None]
-            bias = causal
-            if attn_mask is not None:
-                bias = bias + jnp.where(attn_mask, 0.0, -1e30)[:, None, None, :].astype(jnp.float32)
-            out = _sdpa_xla(q, k, v, bias, cfg.dtype)
+            new_cache = None
+            if nkv != nh:  # GQA expansion for the non-cache paths
+                k = jnp.repeat(k, nh // nkv, axis=2)
+                v = jnp.repeat(v, nh // nkv, axis=2)
+            S = k.shape[1]
+            if cfg.attention_impl == "flash" and T >= 128 and attn_mask is None:
+                from ..ops.pallas.flash_attention import flash_attention
+                out = flash_attention(q, k, v, causal=True,
+                                      block_q=cfg.attention_block_q, block_kv=cfg.attention_block_kv)
+            else:
+                bias = jnp.where(jnp.tril(jnp.ones((T, S), dtype=bool)), 0.0, -1e30)[None, None]
+                if attn_mask is not None:
+                    bias = bias + jnp.where(attn_mask, 0.0, -1e30)[:, None, None, :].astype(jnp.float32)
+                out = _sdpa_xla(q, k, v, bias, cfg.dtype)
 
         out = nn.DenseGeneral(features=H, axis=(-2, -1), use_bias=cfg.norm == "layernorm",
                               dtype=cfg.dtype, param_dtype=jnp.float32,
                               kernel_init=nn.initializers.normal(0.02), name="o_proj")(out)
-        return (out, new_cache) if kv_cache is not None else out
+        return out, new_cache
 
 
 class MLP(nn.Module):
@@ -231,11 +274,13 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, sin, cos, attn_mask=None, deterministic=True):
+    def __call__(self, x, sin, cos, attn_mask=None, deterministic=True, kv_cache=None,
+                 cache_index=None, position_ids=None):
         cfg = self.cfg
         drop = nn.Dropout(rate=cfg.dropout) if cfg.dropout > 0 else None
         h = make_norm(cfg, name="attn_norm")(x)
-        h = Attention(cfg, name="attn")(h, sin, cos, attn_mask)
+        h, new_cache = Attention(cfg, name="attn")(h, sin, cos, attn_mask, kv_cache,
+                                                   cache_index, position_ids)
         if drop is not None:
             h = drop(h, deterministic=deterministic)
         x = x + h
@@ -248,14 +293,18 @@ class Block(nn.Module):
             ff = MLP(cfg, name="mlp")(h)
         if drop is not None:
             ff = drop(ff, deterministic=deterministic)
-        return x + ff
+        return x + ff, new_cache
 
 
 class CausalLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, attn_mask=None, deterministic=True):
+    def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
+                 cache_index=None, position_ids=None):
+        """``kv_cache``: optional per-layer (k, v) with leading layer dim —
+        shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
+        stack. Returns logits, or (logits, new_kv_cache) when caching."""
         cfg = self.cfg
         B, T = input_ids.shape
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -264,7 +313,12 @@ class CausalLM(nn.Module):
         if cfg.pos_embedding == "learned":
             pos_emb = self.param("pos_embed", nn.initializers.normal(0.02),
                                  (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
-            x = x + jax.lax.dynamic_slice_in_dim(pos_emb, 0, T, axis=0).astype(cfg.dtype)
+            if position_ids is not None:
+                x = x + pos_emb[position_ids].astype(cfg.dtype)
+            elif cache_index is not None:
+                x = x + jax.lax.dynamic_slice_in_dim(pos_emb, cache_index, T, axis=0).astype(cfg.dtype)
+            else:
+                x = x + jax.lax.dynamic_slice_in_dim(pos_emb, 0, T, axis=0).astype(cfg.dtype)
         sin, cos = (rope_table(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
                     if cfg.pos_embedding == "rope" else (None, None))
 
@@ -274,17 +328,25 @@ class CausalLM(nn.Module):
                 jax.checkpoint_policies, cfg.remat_policy, None))
             block = nn.remat(Block, policy=policy, prevent_cse=not cfg.scan_layers,
                              static_argnums=())
+        new_cache = None
         if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (mdl(carry, sin, cos, attn_mask, deterministic), None),
+            x, new_cache = nn.scan(
+                lambda mdl, carry, layer_cache: mdl(carry, sin, cos, attn_mask, deterministic,
+                                                    layer_cache, cache_index, position_ids),
                 variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={"partition_name": "layers"},
-            )(block(cfg, name="layers"), x, None)
+            )(block(cfg, name="layers"), x, kv_cache)
         else:
+            caches = []
             for i in range(cfg.num_layers):
-                x = block(cfg, name=f"layer_{i}")(x, sin, cos, attn_mask, deterministic)
+                layer_cache = None if kv_cache is None else jax.tree_util.tree_map(lambda c: c[i], kv_cache)
+                x, c = block(cfg, name=f"layer_{i}")(x, sin, cos, attn_mask, deterministic,
+                                                     layer_cache, cache_index, position_ids)
+                caches.append(c)
+            if kv_cache is not None:
+                new_cache = jax.tree_util.tree_map(lambda *cs: jnp.stack(cs), *caches)
 
         x = make_norm(cfg, name="final_norm")(x)
         # logits matmul runs in compute dtype (MXU rate); CE upcasts to fp32
@@ -293,6 +355,8 @@ class CausalLM(nn.Module):
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
+        if kv_cache is not None:
+            return logits, new_cache
         return logits
 
 
@@ -310,6 +374,29 @@ class CausalLMModel:
 
     def apply(self, params, input_ids, attn_mask=None):
         return self.module.apply({"params": params}, input_ids, attn_mask)
+
+    # ---- generation (KV cache) -------------------------------------------
+    def init_cache(self, batch_size, max_len, dtype=None):
+        """Preallocated KV cache, (L, B, kv_heads, S, head_dim) per k and v —
+        the analogue of the reference's inference workspace KV arena
+        (``csrc/transformer/inference/includes/inference_context.h``)."""
+        cfg = self.cfg
+        shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len, cfg.head_size)
+        dt = dtype or cfg.dtype
+        return (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+
+    def apply_with_cache(self, params, input_ids, kv_cache, cache_index, cache_mask=None,
+                         position_ids=None):
+        """Forward writing into (and attending over) the KV cache. Returns
+        (logits, new_cache). ``cache_mask``: (B, S) attendable cache slots."""
+        mutable = ["intermediates"] if self.cfg.num_experts > 0 else False
+        out = self.module.apply({"params": params}, input_ids, cache_mask, True, kv_cache,
+                                cache_index, position_ids, mutable=mutable)
+        if mutable:
+            (logits, new_cache), _ = out
+        else:
+            logits, new_cache = out
+        return logits, new_cache
 
     def _apply_kwargs(self, rng):
         """Dropout is active iff a step rng is provided and rate > 0."""

@@ -1,0 +1,106 @@
+"""Pallas single-token decode attention (TPU).
+
+TPU-native equivalent of the reference's fused KV-cache decode attention
+(``softmax_context_*`` ops, csrc/transformer/inference/csrc/pt_binding.cpp:1745
+-1805, and the softmax/attention kernels behind them): one query token per
+sequence attends over a preallocated contiguous KV cache.
+
+GQA-native: the cache keeps ``kv_heads`` heads and each program computes the
+whole group of query heads sharing one KV head — no ``jnp.repeat`` expansion
+of the cache. Grid is (B, kv_heads); K/V arrive as contiguous (S, D) slabs
+per program (cache layout (B, kv_heads, S, D)), and an online-softmax
+``fori_loop`` walks KV blocks, stopping at the cache write head (``end``) so
+compute scales with the live context length.
+
+Per-row window [start_i, end): ``start`` masks left-padding slots of batched
+generation; ``end`` is the shared write head (prompts are left-aligned to a
+common end by the inference engine).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _interpret():
+    return jax.default_backend() == "cpu"
+
+
+def _decode_kernel(start_ref, end_ref, q_ref, k_ref, v_ref, o_ref, *, scale, block_kv):
+    b = pl.program_id(0)
+    start = start_ref[b]
+    end = end_ref[0]
+
+    g = q_ref.shape[2]
+    d = q_ref.shape[-1]
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (G, D)
+
+    m = jnp.full((g, 1), -jnp.inf, jnp.float32)
+    l = jnp.zeros((g, 1), jnp.float32)
+    acc = jnp.zeros((g, d), jnp.float32)
+
+    num_blocks = pl.cdiv(end, block_kv)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kv_start = j * block_kv
+        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)  # (bkv, D)
+        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32)  # (G, bkv)
+        kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (g, block_kv), 1)
+        mask = (kv_pos >= start) & (kv_pos < end)
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(p, v, (((1, ), (0, )), ((), ())),
+                                                preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m, l, acc))
+    l_safe = jnp.where(l == 0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=None):
+    """q: (B, H, D) one query token per sequence; k_cache/v_cache:
+    (B, kv_heads, S, D); start: (B,) int32 first attendable cache slot per
+    row; end: scalar int32, one past the last written slot (shared).
+    Returns (B, H, D)."""
+    B, H, D = q.shape
+    nkv, S = k_cache.shape[1], k_cache.shape[2]
+    g = H // nkv
+    scale = scale if scale is not None else 1.0 / (D**0.5)
+    block_kv = min(block_kv, S)
+    if S % block_kv:
+        raise ValueError(f"cache length {S} must be a multiple of block_kv={block_kv}")
+
+    qg = q.reshape(B, nkv, g, D)
+    start = start.astype(jnp.int32)
+    end = jnp.full((1, ), end, jnp.int32)
+
+    kernel = functools.partial(_decode_kernel, scale=scale, block_kv=block_kv)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, nkv),
+            in_specs=[
+                pl.BlockSpec((1, 1, g, D), lambda b, h, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, S, D), lambda b, h, *_: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, S, D), lambda b, h, *_: (b, h, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, g, D), lambda b, h, *_: (b, h, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, g, D), q.dtype),
+        interpret=_interpret(),
+    )(start, end, qg, k_cache, v_cache)
+    return out.reshape(B, H, D)

@@ -129,6 +129,35 @@ def load_checkpoint(load_dir, tag, state_shardings, mesh, template, load_optimiz
     return state, client_sd
 
 
+def load_params_only(load_dir, tag=None, abstract_params=None):
+    """Restore just the model params from a training checkpoint, as host
+    arrays (inference-engine weight loading; reference
+    ``inference/engine.py:419``). With ``abstract_params`` (a
+    ``jax.eval_shape`` pytree) only the params subtree is read from disk —
+    optimizer moments and accumulators are never materialized."""
+    load_dir = os.path.abspath(load_dir)
+    if tag is None:
+        tag = get_latest_tag(load_dir)
+        if tag is None:
+            raise FileNotFoundError(f"no 'latest' file in {load_dir}; pass an explicit tag")
+    state_path = os.path.join(load_dir, str(tag), "state")
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(f"checkpoint {state_path} does not exist")
+    engine = OrbaxCheckpointEngine()
+    if abstract_params is not None:
+        import orbax.checkpoint as ocp
+        target = {"params": jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), abstract_params)}
+        restored = engine._ckptr.restore(os.path.abspath(state_path),
+                                         args=ocp.args.PyTreeRestore(item=target,
+                                                                     partial_restore=True))
+        params = restored["params"]
+    else:
+        state = engine.load(state_path)
+        params = state["params"] if isinstance(state, dict) and "params" in state else state[1]
+    return jax.tree_util.tree_map(np.asarray, params)
+
+
 def _jsonable(obj):
     if isinstance(obj, dict):
         return {k: _jsonable(v) for k, v in obj.items()}

@@ -1,0 +1,179 @@
+"""Inference engine tests.
+
+The TPU analogue of reference ``tests/unit/inference/test_inference.py``
+(parameterized model × dtype × kernel-inject sweep): generation must be
+identical across batch composition, kernel injection, and TP layout, and the
+cached decode path must match uncached full forwards exactly.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+
+PROMPTS = [[5, 6, 7, 8, 9], [10, 11, 12]]
+
+
+def make_engine(model="tiny", params=None, **cfg):
+    comm._state["mesh"] = None
+    config = {"dtype": "float32"}
+    config.update(cfg)
+    return deepspeed_tpu.init_inference(model, config=config, params=params)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    eng = make_engine()
+    params = jax.device_get(eng.params)
+    out = eng.generate(PROMPTS, max_new_tokens=8)
+    return params, out
+
+
+def test_generate_greedy_deterministic(baseline):
+    params, out = baseline
+    eng = make_engine(params=params)
+    again = eng.generate(PROMPTS, max_new_tokens=8)
+    assert all((a == b).all() for a, b in zip(out, again))
+
+
+def test_cached_decode_matches_uncached_forward(baseline):
+    """Greedy generate (KV cache) == token-by-token full forwards."""
+    params, out = baseline
+    eng = make_engine(params=params)
+    cur = np.asarray(PROMPTS[0], np.int32)[None]
+    for _ in range(8):
+        logits = eng.forward(cur)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        cur = np.concatenate([cur, [[nxt]]], axis=1)
+    assert (cur[0, len(PROMPTS[0]):] == out[0]).all()
+
+
+def test_batched_matches_single_row(baseline):
+    """Left-padding must not change any row's continuation."""
+    params, out = baseline
+    eng = make_engine(params=params)
+    for i, prompt in enumerate(PROMPTS):
+        solo = eng.generate([prompt], max_new_tokens=8)
+        assert (solo[0] == out[i]).all(), f"row {i} differs solo vs batched"
+
+
+def test_kernel_inject_matches_xla(baseline):
+    """Pallas decode kernel path == XLA path (reference kernel-inject
+    numerics tests)."""
+    params, out = baseline
+    eng = make_engine(params=params, replace_with_kernel_inject=True)
+    assert eng.model_config.attention_impl == "flash"
+    got = eng.generate(PROMPTS, max_new_tokens=8)
+    assert all((a == b).all() for a, b in zip(out, got))
+
+
+def test_tp2_matches_tp1(baseline):
+    params, out = baseline
+    eng = make_engine(params=params, tensor_parallel={"tp_size": 2})
+    assert eng.mesh.shape["tensor"] == 2
+    got = eng.generate(PROMPTS, max_new_tokens=8)
+    assert all((a == b).all() for a, b in zip(out, got))
+
+
+def test_eos_stops_row(baseline):
+    params, out = baseline
+    eng = make_engine(params=params)
+    eos = int(out[0][0])
+    got = eng.generate(PROMPTS, max_new_tokens=8, eos_token_id=eos)
+    assert got[0][-1] == eos and len(got[0]) < 8
+
+
+def test_sampling_seeded(baseline):
+    params, _ = baseline
+    eng = make_engine(params=params)
+    a = eng.generate(PROMPTS, max_new_tokens=6, do_sample=True, temperature=0.7, top_k=20,
+                     top_p=0.9, seed=11)
+    b = eng.generate(PROMPTS, max_new_tokens=6, do_sample=True, temperature=0.7, top_k=20,
+                     top_p=0.9, seed=11)
+    c = eng.generate(PROMPTS, max_new_tokens=6, do_sample=True, temperature=0.7, top_k=20,
+                     top_p=0.9, seed=12)
+    assert all((x == y).all() for x, y in zip(a, b))
+    assert any((x != y).any() for x, y in zip(a, c)) or True  # different seed may coincide
+
+
+def test_moe_model_generates():
+    eng = make_engine(model="tiny-moe")
+    out = eng.generate(PROMPTS, max_new_tokens=4)
+    assert len(out) == 2 and all(len(o) == 4 for o in out)
+
+
+def test_checkpoint_roundtrip_into_inference(tmp_path, baseline):
+    """Train -> save_16bit_model -> init_inference(checkpoint=...) serves the
+    trained weights (reference inference checkpoint loading)."""
+    params, _ = baseline
+    comm._state["mesh"] = None
+    from deepspeed_tpu.models import get_model
+    model = get_model("tiny", dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config={"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                             "steps_per_print": 1000})
+    path = engine.save_16bit_model(str(tmp_path), "model.msgpack")
+    trained = jax.device_get(engine.state.params)
+
+    eng = make_engine(checkpoint=path)
+    got = jax.device_get(eng.params)
+    for a, b in zip(jax.tree_util.tree_leaves(trained), jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_nondefault_decode_block_kv(baseline):
+    """decode_block_kv config must plumb through to the decode kernel."""
+    params, out = baseline
+    eng = make_engine(params=params, replace_with_kernel_inject=True, decode_block_kv=64)
+    assert eng.model_config.decode_block_kv == 64
+    got = eng.generate(PROMPTS, max_new_tokens=8)
+    assert all((a == b).all() for a, b in zip(out, got))
+
+
+def test_training_checkpoint_dir_into_inference(tmp_path):
+    """init_inference(checkpoint=<training ckpt dir>) restores only the
+    params subtree (partial orbax restore)."""
+    comm._state["mesh"] = None
+    from deepspeed_tpu.models import get_model
+    model = get_model("tiny", dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config={"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                             "steps_per_print": 1000})
+    engine.save_checkpoint(str(tmp_path), tag="tag0")
+    trained = jax.device_get(engine.state.params)
+
+    eng = make_engine(checkpoint=str(tmp_path))
+    got = jax.device_get(eng.params)
+    for a, b in zip(jax.tree_util.tree_leaves(trained), jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32), rtol=1e-6)
+
+
+def test_init_inference_rejects_bad_dtype():
+    with pytest.raises(ValueError, match="dtype"):
+        make_engine(dtype="float8000")
+
+
+def test_decode_kernel_vs_reference():
+    """Pallas decode kernel numerics vs dense XLA reference (GQA + per-row
+    start masking)."""
+    from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+    B, H, nkv, S, D = 2, 8, 2, 64, 64
+    ks = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
+    kc = jax.random.normal(ks[1], (B, nkv, S, D), jnp.float32)
+    vc = jax.random.normal(ks[2], (B, nkv, S, D), jnp.float32)
+    start = jnp.asarray([0, 5], jnp.int32)
+    end = 40
+    out = decode_attention(q, kc, vc, start, end, block_kv=16)
+
+    g = H // nkv
+    qg = q.reshape(B, nkv, g, D)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg, kc) / jnp.sqrt(D)
+    kpos = jnp.arange(S)
+    mask = (kpos[None, :] >= start[:, None]) & (kpos[None, :] < end)
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    ref = jnp.einsum("bkgs,bksd->bkgd", jax.nn.softmax(s, -1), vc).reshape(B, H, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
