@@ -62,7 +62,25 @@ _state = {
     "initialized": False,
     "mesh": None,
     "comms_logger": None,
+    # axes currently under manual (shard_map) partitioning — sharding
+    # constraints over the full mesh are illegal inside such a region
+    "manual_axes": frozenset(),
 }
+
+
+@contextmanager
+def manual_axes(axes):
+    """Mark ``axes`` as manually partitioned while tracing a shard_map body."""
+    prev = _state["manual_axes"]
+    _state["manual_axes"] = prev | frozenset(axes)
+    try:
+        yield
+    finally:
+        _state["manual_axes"] = prev
+
+
+def in_manual_region():
+    return bool(_state["manual_axes"])
 
 
 # ---------------------------------------------------------------------------

@@ -100,6 +100,8 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ params
     def _materialize_params(self, params):
+        if params is None and self._config.checkpoint:
+            params = self._load_checkpoint_host(self._config.checkpoint)
         shardings = self.planner.shardings(self.planner.master_specs(
             params if params is not None else jax.eval_shape(self.module.init_params, jax.random.key(0))))
         dtype = self.model_config.dtype
@@ -108,12 +110,6 @@ class InferenceEngine:
                            out_shardings=shardings)
             with self.mesh:
                 return cast(params)
-        if self._config.checkpoint:
-            host = self._load_checkpoint_host(self._config.checkpoint)
-            cast = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), p),
-                           out_shardings=shardings)
-            with self.mesh:
-                return cast(host)
         logger.warning("init_inference: no checkpoint/params given; initializing random weights")
         init = jax.jit(lambda rng: jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype),
                                                           self.module.init_params(rng)),
@@ -148,16 +144,21 @@ class InferenceEngine:
     __call__ = forward
 
     # ------------------------------------------------------------------ generate
-    def _build_generate(self, B, P, S, max_gen, do_sample, temperature, top_k, top_p, eos, pad):
+    def _build_generate(self, B, P, S, W, max_gen, do_sample, temperature, top_k, top_p, eos, pad,
+                        padded):
+        """``W``: cache write head after prefill (static). Uniform-length
+        batches are right-padded to the P bucket with W = true length — no
+        cache masking, which enables the flash prefill kernel; ragged batches
+        are left-padded with W = P and per-row mask/positions."""
         model = self.module
 
         def generate(params, cache, ids, pads, max_new, rng):
-            # ids: (B, P) left-padded; pads: (B,) pad counts
-            cache_mask = jnp.arange(S)[None, :] >= pads[:, None]  # (B, S)
-            pos_prefill = jnp.maximum(jnp.arange(P)[None, :] - pads[:, None], 0)
+            # ids: (B, P); pads: (B,) left-pad counts (zeros when uniform)
+            cache_mask = (jnp.arange(S)[None, :] >= pads[:, None]) if padded else None
+            pos_prefill = jnp.maximum(jnp.arange(P)[None, :] - pads[:, None], 0) if padded else None
             logits, cache = model.apply_with_cache(params, ids, cache, 0, cache_mask, pos_prefill)
             rng, sub = jax.random.split(rng)
-            tok = _sample_tokens(sub, logits[:, -1].astype(jnp.float32), do_sample, temperature,
+            tok = _sample_tokens(sub, logits[:, W - 1].astype(jnp.float32), do_sample, temperature,
                                  top_k, top_p)
             buf = jnp.full((B, max_gen), pad, jnp.int32)
             buf = buf.at[:, 0].set(tok)
@@ -169,8 +170,8 @@ class InferenceEngine:
 
             def body(c):
                 cache, buf, done, t, rng, tok = c
-                pos = (P + t - pads)[:, None]  # (B, 1) true positions
-                logits, cache = model.apply_with_cache(params, tok[:, None], cache, P + t,
+                pos = (W + t - pads)[:, None]  # (B, 1) true positions
+                logits, cache = model.apply_with_cache(params, tok[:, None], cache, W + t,
                                                        cache_mask, pos)
                 rng, sub = jax.random.split(rng)
                 nxt = _sample_tokens(sub, logits[:, 0].astype(jnp.float32), do_sample, temperature,
@@ -214,17 +215,26 @@ class InferenceEngine:
         if S > self._config.max_out_tokens:
             raise ValueError(f"prompt+max_new_tokens needs cache of {S} tokens > max_out_tokens="
                              f"{self._config.max_out_tokens}; raise max_out_tokens")
-        pads = P - lens
+        padded = bool((lens != lens[0]).any())
         ids = np.full((B, P), pad_token_id, np.int32)
-        for i, r in enumerate(rows):
-            ids[i, pads[i]:] = r
+        if padded:  # ragged: left-pad so all rows share one write head
+            pads = P - lens
+            for i, r in enumerate(rows):
+                ids[i, pads[i]:] = r
+            W = P
+        else:  # uniform: right-pad the bucket; decode starts at the true length
+            pads = np.zeros(B, np.int32)
+            for i, r in enumerate(rows):
+                ids[i, :lens[i]] = r
+            W = int(lens[0])
 
-        max_gen = S - P
-        key = ("gen", B, P, S, max_gen, do_sample, float(temperature), int(top_k), float(top_p),
-               eos_token_id, pad_token_id)
+        max_gen = S - W
+        key = ("gen", B, P, S, W, max_gen, do_sample, float(temperature), int(top_k), float(top_p),
+               eos_token_id, pad_token_id, padded)
         if key not in self._compiled:
-            self._compiled[key] = self._build_generate(B, P, S, max_gen, do_sample, temperature,
-                                                       top_k, top_p, eos_token_id, pad_token_id)
+            self._compiled[key] = self._build_generate(B, P, S, W, max_gen, do_sample, temperature,
+                                                       top_k, top_p, eos_token_id, pad_token_id,
+                                                       padded)
         cache = self._init_cache(B, S)
         with self.mesh:
             buf, _ = self._compiled[key](self.params, cache, jnp.asarray(ids), jnp.asarray(pads),

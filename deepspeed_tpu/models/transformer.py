@@ -225,6 +225,17 @@ class Attention(nn.Module):
                     starts = jnp.zeros((B, ), jnp.int32)
                 out = decode_attention(q[:, 0], ck, cv, starts, cache_index + 1,
                                        block_kv=cfg.decode_block_kv)[:, None]
+            elif (cfg.attention_impl == "flash" and attn_mask is None and T >= 128
+                  and isinstance(cache_index, int) and cache_index == 0):
+                # unpadded prefill: nothing earlier in the cache, so attention
+                # over the current tokens only — the flash kernel path
+                from ..ops.pallas.flash_attention import flash_attention
+                kx, vx = k, v
+                if nkv != nh:
+                    kx = jnp.repeat(kx, nh // nkv, axis=2)
+                    vx = jnp.repeat(vx, nh // nkv, axis=2)
+                out = flash_attention(q, kx, vx, causal=True,
+                                      block_q=cfg.attention_block_q, block_kv=cfg.attention_block_kv)
             else:
                 out = _cached_attention_xla(q, ck, cv, cache_index, attn_mask, cfg.dtype)
             out = out.astype(cfg.dtype)
@@ -432,6 +443,81 @@ class CausalLMModel:
             if aux_losses:
                 loss = loss + self.cfg.moe_aux_loss_coef * sum(jnp.sum(a) for a in aux_losses)
         return loss
+
+    # ---- pipeline parallelism --------------------------------------------
+    def pipeline_loss(self, params, batch, rng, mesh=None):
+        """Mean next-token CE over a stream of microbatches, computed through
+        the SPMD pipeline (``runtime/pipe/schedule.py``): embed and head run
+        replicated over ``pipe`` (tied-embedding grads accumulate without the
+        reference's ReduceTiedGrads step, ``pipe/engine.py:223``); the block
+        stack is stage-partitioned. ``batch['input_ids']``: (M, b, T)."""
+        from ..runtime.pipe.schedule import spmd_pipeline
+        cfg = self.cfg
+        if not cfg.scan_layers:
+            raise ValueError("pipeline parallelism requires scan_layers=True (stacked layer params)")
+        ids = batch["input_ids"]
+        attn_mask = batch.get("attention_mask")
+        M, b, T = ids.shape
+
+        table = params["embed"]["embedding"].astype(cfg.dtype)
+        x = table[ids]  # (M, b, T, H)
+        if cfg.pos_embedding == "learned":
+            x = x + params["pos_embed"][:T].astype(cfg.dtype)
+        sin, cos = (rope_table(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+                    if cfg.pos_embedding == "rope" else (None, None))
+
+        block_mod = Block(cfg)
+        dropout_on = rng is not None and cfg.dropout > 0
+
+        def stage_fn(local_layers, h_in, t):
+            # h_in: activation, or (activation, mask) when the batch is padded
+            h, mask = h_in if isinstance(h_in, tuple) else (h_in, None)
+            n_layers = jax.tree_util.tree_leaves(local_layers)[0].shape[0]
+
+            def body(h, layer):
+                lp, li = layer
+                kw = {"deterministic": True}
+                if dropout_on:
+                    # decorrelate dropout per (pipeline step, global layer)
+                    kw = {"deterministic": False,
+                          "rngs": {"dropout": jax.random.fold_in(jax.random.fold_in(rng, t), li)}}
+                y, _ = block_mod.apply({"params": lp}, h, sin, cos, mask, **kw)
+                return y, None
+
+            stage = jax.lax.axis_index(dist.PIPE_AXIS) if dist.in_manual_region() else 0
+            global_idx = stage * n_layers + jnp.arange(n_layers)
+            h, _ = jax.lax.scan(body, h, (local_layers, global_idx))
+            return (h, mask) if mask is not None else h
+
+        x_stream = (x, attn_mask) if attn_mask is not None else x
+        stream = spmd_pipeline(stage_fn, params["layers"], x_stream, mesh=mesh,
+                               remat=bool(cfg.remat_policy))
+        if attn_mask is not None:
+            stream = stream[0]
+
+        norm_mod = make_norm(cfg)
+        stream = norm_mod.apply({"params": params["final_norm"]}, stream)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("mbth,vh->mbtv", stream, table)
+        else:
+            logits = jnp.einsum("mbth,hv->mbtv", stream,
+                                params["lm_head"]["kernel"].astype(cfg.dtype))
+
+        if "labels" in batch:
+            labels = batch["labels"]
+            logits_t = logits
+        else:
+            labels = ids[:, :, 1:]
+            logits_t = logits[:, :, :-1]
+        valid = labels >= 0
+        labels_c = jnp.maximum(labels, 0)
+        import optax
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits_t.astype(jnp.float32), labels_c)
+        return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+    def pipeline_pattern(self):
+        """Regex of params whose leading (layer) dim shards over ``pipe``."""
+        return r"^layers/" if self.cfg.scan_layers else None
 
     # ---- sharding rules ---------------------------------------------------
     def tp_rules(self):

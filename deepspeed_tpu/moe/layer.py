@@ -18,7 +18,11 @@ from .sharded_moe import top_k_gating
 
 
 def _expert_constraint(x, spec):
-    """Pin an (E, ...) intermediate to the expert axis when a mesh is live."""
+    """Pin an (E, ...) intermediate to the expert axis when a mesh is live.
+    Inside a manual (shard_map) region full-mesh constraints are illegal —
+    the auto partitioner still places the dispatch from the param shardings."""
+    if dist.in_manual_region():
+        return x
     if dist.has_mesh() and dist.get_mesh().shape[dist.EXPERT_AXIS] > 1:
         return jax.lax.with_sharding_constraint(x, NamedSharding(dist.get_mesh(), spec))
     return x

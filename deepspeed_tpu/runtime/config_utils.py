@@ -55,6 +55,9 @@ class DeepSpeedConfigModel:
                         logger.warning(f"Config parameter {alias} is deprecated, use {name} instead")
                         break
             default = field.default
+            if not isinstance(default, type) and callable(default) and value is _MISSING:
+                # factory default (lambda producing a fresh mutable value)
+                value = default()
             if isinstance(default, type) and not issubclass(default, DeepSpeedConfigModel):
                 # factory default (dict/list/…): instantiate when absent
                 if value is _MISSING:

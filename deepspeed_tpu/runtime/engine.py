@@ -123,10 +123,20 @@ class DeepSpeedEngine:
             tp_rules = model.tp_rules()
         if expert_pattern is None and hasattr(model, "expert_pattern"):
             expert_pattern = model.expert_pattern()
+        pipe_pattern = model.pipeline_pattern() if hasattr(model, "pipeline_pattern") else None
+        if self.mesh.shape[dist.PIPE_AXIS] > 1:
+            if not (hasattr(model, "pipeline_loss") and pipe_pattern):
+                raise ValueError(
+                    "pipeline_parallel_size > 1 requires a model exposing pipeline_loss() and "
+                    "pipeline_pattern() (all deepspeed_tpu.models with scan_layers=True do)")
+            if getattr(getattr(model, "cfg", None), "num_experts", 0) > 0:
+                logger.warning("pipeline parallelism: MoE load-balancing aux loss is not "
+                               "collected through the pipelined path and will be dropped")
         self.planner = ShardingPlanner(self.mesh,
                                        self._config.zero_optimization,
                                        tp_rules=tp_rules,
-                                       expert_pattern=expert_pattern)
+                                       expert_pattern=expert_pattern,
+                                       pipe_pattern=pipe_pattern)
 
         # ---- params ------------------------------------------------------
         if model_parameters is None and hasattr(model, "init_params"):
@@ -399,8 +409,35 @@ class DeepSpeedEngine:
         }
         return new_state, metrics
 
+    def _build_pp_train_fn(self):
+        """Pipeline-parallel fused step: the whole microbatch stream runs
+        through the SPMD pipeline (reference ``PipelineEngine.train_batch``,
+        pipe/engine.py:285) inside one pjit; jax.grad through the
+        ppermute/scan pipeline is the backward schedule."""
+        gas = self._config.gradient_accumulation_steps
+
+        def train_step(state, batch):
+            rng = jax.random.fold_in(self._base_rng, state.step)
+
+            def scaled_loss(p):
+                p_c = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype), p)
+                p_c = jax.lax.with_sharding_constraint(p_c, self.planner.param_shardings(p_c))
+                loss = self.module.pipeline_loss(p_c, batch, rng, mesh=self.mesh)
+                # x gas: _apply_grads divides by scale*gas (sum convention)
+                return loss.astype(jnp.float32) * state.loss_scale.cur_scale * gas, loss
+
+            grads, loss = jax.grad(scaled_loss, has_aux=True)(state.params)
+            return self._apply_grads(state, grads, loss)
+
+        return jax.jit(train_step,
+                       donate_argnums=(0, ),
+                       in_shardings=(self.state_shardings, self._batch_shardings_cache()),
+                       out_shardings=(self.state_shardings, NamedSharding(self.mesh, P())))
+
     def _build_train_batch_fn(self):
         """Fused step: scan over gas microbatches, then update. ONE pjit."""
+        if self.mesh.shape[dist.PIPE_AXIS] > 1:
+            return self._build_pp_train_fn()
 
         def train_step(state, batch):
             rng = jax.random.fold_in(self._base_rng, state.step)
@@ -455,6 +492,9 @@ class DeepSpeedEngine:
 
         def eval_step(state, batch):
             p_c = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype), state.params)
+            if self.mesh.shape[dist.PIPE_AXIS] > 1:
+                batch_mb = jax.tree_util.tree_map(lambda x: x[None], batch)
+                return self.module.pipeline_loss(p_c, batch_mb, None, mesh=self.mesh)
             out = self.loss_fn(p_c, batch, None)
             loss, aux = (out if isinstance(out, tuple) else (out, None))
             return loss
@@ -543,6 +583,11 @@ class DeepSpeedEngine:
         (Forward/backward fuse under XLA; splitting them would double
         compute, so `forward` does both and `backward` is the accumulation
         boundary bookkeeping — semantics match the reference 3-call API.)"""
+        if self.mesh.shape[dist.PIPE_AXIS] > 1:
+            raise RuntimeError(
+                "the forward/backward/step facade is not supported under pipeline parallelism; "
+                "use train_batch() (the reference PipelineEngine likewise only supports "
+                "train_batch, pipe/engine.py:285)")
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._shard_batch(batch)

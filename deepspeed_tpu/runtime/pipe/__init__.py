@@ -1,0 +1,2 @@
+from .schedule import spmd_pipeline  # noqa: F401
+from .module import LayerSpec, TiedLayerSpec, PipelineModule, partition_balanced  # noqa: F401

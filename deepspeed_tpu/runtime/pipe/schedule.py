@@ -1,0 +1,102 @@
+"""SPMD pipeline schedule.
+
+TPU-native replacement for the reference's pipeline instruction interpreter
+(``runtime/pipe/engine.py:40`` ``PipelineEngine``, ``schedule.py:189``
+``TrainSchedule`` 1F1B, ``p2p.py`` wire). Design translation (SURVEY §7):
+instead of N processes interpreting per-rank instruction streams and
+exchanging tensors over NCCL P2P, ONE compiled program runs a circular
+pipeline inside ``jax.shard_map`` that is *manual only over the* ``pipe``
+*axis* — activations move between stages with ``lax.ppermute`` over ICI
+neighbors while the other mesh axes (data/tensor/expert/seq) stay under the
+automatic SPMD partitioner. Backward is just ``jax.grad`` through the scan:
+``ppermute`` differentiates to the reverse permute, which reproduces the
+backward P2P exchange of the reference schedule without an interpreter.
+
+Schedule shape: with M microbatches and S stages, the scan runs M+S-1 steps;
+stage s works on microbatch t-s at step t (classic fill/drain pipeline).
+The reference's 1F1B ordering is an eager-mode *memory* optimization; under
+XLA the whole program is compiled and activation liveness is bounded by
+rematerialization instead (pass ``remat_policy``).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...comm import comm as dist
+
+
+def num_pipeline_steps(num_microbatches, num_stages):
+    return num_microbatches + num_stages - 1
+
+
+def spmd_pipeline(stage_fn, stage_params, x_stream, mesh=None, remat=False):
+    """Run ``x_stream`` through a ``pipe``-partitioned layer stack.
+
+    ``stage_fn(local_params, x, t) -> y``: applies one stage's layer slice at
+    pipeline step ``t`` (an i32 scalar; use it to decorrelate per-step rngs);
+    ``x``/``y`` may be pytrees — non-activation leaves (e.g. an attention
+    mask) ride along with their microbatch through every stage;
+    ``stage_params``: pytree whose leaves have leading layer dim divisible by
+    the ``pipe`` axis size (sharded dim 0 across stages);
+    ``x_stream``: pytree of (M, ...) microbatch streams entering stage 0.
+    Returns the stream leaving the last stage, replicated over pipe.
+    """
+    mesh = mesh or dist.get_mesh()
+    n_stages = mesh.shape[dist.PIPE_AXIS]
+    if n_stages == 1:
+        return _single_stage(stage_fn, stage_params, x_stream, remat)
+    M = jax.tree_util.tree_leaves(x_stream)[0].shape[0]
+    steps = num_pipeline_steps(M, n_stages)
+    fn = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
+
+    def tmap(f, *trees):
+        return jax.tree_util.tree_map(f, *trees)
+
+    def run(local_params, xs):
+        stage = jax.lax.axis_index(dist.PIPE_AXIS)
+        # carries become stage-varying inside the loop; mark them so upfront
+        pvary = lambda v: jax.lax.pvary(v, (dist.PIPE_AXIS, ))
+        state = tmap(lambda x: pvary(jnp.zeros_like(x[0])), xs)
+        out_stream = tmap(lambda x: pvary(jnp.zeros_like(x)), xs)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def step(carry, t):
+            state, out_stream = carry
+            feed = tmap(lambda x: jax.lax.dynamic_index_in_dim(x, jnp.minimum(t, M - 1), 0,
+                                                               keepdims=False), xs)
+            cur = tmap(lambda f, s: jnp.where(stage == 0, f, s), feed, state)
+            y = fn(local_params, cur, t)
+            nxt = tmap(lambda v: jax.lax.ppermute(v, dist.PIPE_AXIS, perm), y)
+            out_idx = t - (n_stages - 1)
+            write = (stage == n_stages - 1) & (out_idx >= 0)
+            out_stream = tmap(
+                lambda os, v: jnp.where(
+                    write, jax.lax.dynamic_update_index_in_dim(os, v, jnp.maximum(out_idx, 0), 0),
+                    os), out_stream, y)
+            return (nxt, out_stream), None
+
+        (_, out_stream), _ = jax.lax.scan(step, (state, out_stream), jnp.arange(steps))
+        # deliver the last stage's stream to every stage (head/loss run replicated)
+        out_stream = tmap(
+            lambda os: jax.lax.psum(jnp.where(stage == n_stages - 1, os, jnp.zeros_like(os)),
+                                    dist.PIPE_AXIS), out_stream)
+        return out_stream
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(dist.PIPE_AXIS), stage_params),
+                jax.tree_util.tree_map(lambda _: P(), x_stream))
+    with dist.manual_axes({dist.PIPE_AXIS}):
+        return jax.shard_map(run, mesh=mesh, in_specs=in_specs,
+                             out_specs=jax.tree_util.tree_map(lambda _: P(), x_stream),
+                             axis_names={dist.PIPE_AXIS})(stage_params, x_stream)
+
+
+def _single_stage(stage_fn, stage_params, x_stream, remat):
+    fn = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
+    M = jax.tree_util.tree_leaves(x_stream)[0].shape[0]
+
+    def one(x_and_t):
+        x, t = x_and_t
+        return fn(stage_params, x, t)
+
+    return jax.lax.map(one, (x_stream, jnp.arange(M)))

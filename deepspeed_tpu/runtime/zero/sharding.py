@@ -110,12 +110,14 @@ class ShardingPlanner:
     (``('expert','data')`` for dense params; expert params drop ``'expert'``).
     """
 
-    def __init__(self, mesh, zero_config=None, tp_rules=None, expert_pattern=None):
+    def __init__(self, mesh, zero_config=None, tp_rules=None, expert_pattern=None,
+                 pipe_pattern=None):
         self.mesh = mesh
         self.zero = zero_config
         self.stage = zero_config.stage if zero_config is not None else 0
         self.tp_rules = tp_rules if isinstance(tp_rules, TensorParallelRules) else TensorParallelRules(tp_rules or ())
         self.expert_pattern = re.compile(expert_pattern) if expert_pattern else None
+        self.pipe_pattern = re.compile(pipe_pattern) if pipe_pattern else None
         self.persistence_threshold = (zero_config.stage3_param_persistence_threshold
                                       if zero_config is not None else int(1e5))
 
@@ -136,6 +138,22 @@ class ShardingPlanner:
         if changed:
             logger.debug(f"{path_str}: shape {shape} not divisible by rule {spec}; "
                          f"relaxed to {P(*entries)}")
+        return P(*entries)
+
+    def _apply_pipe(self, spec, shape, path_str):
+        """Stage-partition layer-stacked params: leading (layer) dim over
+        ``pipe`` (the sharding form of reference ``PipelineModule``'s layer
+        assignment, ``pipe/module.py:353``)."""
+        pipe = self.mesh.shape[dist.PIPE_AXIS]
+        if pipe == 1 or self.pipe_pattern is None or not self.pipe_pattern.search(path_str):
+            return spec
+        if not shape or shape[0] % pipe != 0:
+            logger.warning(f"{path_str}: leading dim {shape and shape[0]} not divisible by "
+                           f"pipe={pipe}; layer stack left unsharded over pipe")
+            return spec
+        entries = list(spec)
+        if entries[0] is None:
+            entries[0] = dist.PIPE_AXIS
         return P(*entries)
 
     def _dp_axes_for(self, path_str):
@@ -166,6 +184,7 @@ class ShardingPlanner:
         ndim = len(shape)
         spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
         spec = self._validate(spec, shape, path_str)
+        spec = self._apply_pipe(spec, shape, path_str)
         if self.stage >= ZeroStageEnum.weights:
             n_elem = int(np.prod(shape)) if shape else 1
             if n_elem > self.persistence_threshold:
@@ -177,6 +196,7 @@ class ShardingPlanner:
         ndim = len(shape)
         spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
         spec = self._validate(spec, shape, path_str)
+        spec = self._apply_pipe(spec, shape, path_str)
         if self.stage >= ZeroStageEnum.optimizer_states:
             spec = self._apply_dp(spec, shape, path_str)
         return spec
@@ -186,6 +206,7 @@ class ShardingPlanner:
         ndim = len(shape)
         spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
         spec = self._validate(spec, shape, path_str)
+        spec = self._apply_pipe(spec, shape, path_str)
         if self.stage >= ZeroStageEnum.gradients:
             spec = self._apply_dp(spec, shape, path_str)
         return spec

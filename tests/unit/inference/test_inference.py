@@ -93,10 +93,7 @@ def test_sampling_seeded(baseline):
                      top_p=0.9, seed=11)
     b = eng.generate(PROMPTS, max_new_tokens=6, do_sample=True, temperature=0.7, top_k=20,
                      top_p=0.9, seed=11)
-    c = eng.generate(PROMPTS, max_new_tokens=6, do_sample=True, temperature=0.7, top_k=20,
-                     top_p=0.9, seed=12)
     assert all((x == y).all() for x, y in zip(a, b))
-    assert any((x != y).any() for x, y in zip(a, c)) or True  # different seed may coincide
 
 
 def test_moe_model_generates():
@@ -154,6 +151,28 @@ def test_training_checkpoint_dir_into_inference(tmp_path):
 def test_init_inference_rejects_bad_dtype():
     with pytest.raises(ValueError, match="dtype"):
         make_engine(dtype="float8000")
+
+
+def test_moe_config_defaults_are_values():
+    from deepspeed_tpu.inference.config import DeepSpeedInferenceConfig
+    cfg = DeepSpeedInferenceConfig({})
+    assert cfg.moe.moe_experts == [1]
+    assert cfg.moe.moe_experts is not DeepSpeedInferenceConfig({}).moe.moe_experts
+
+
+def test_long_uniform_prompt_flash_prefill(baseline):
+    """Uniform-length prompts >=128 tokens take the flash prefill branch
+    under kernel injection; output must match the XLA engine."""
+    from deepspeed_tpu.models import get_model
+    params, _ = baseline
+    long_prompts = [list(range(1, 131)), list(range(3, 133))]
+    model = get_model("tiny", max_seq_len=512)
+    eng_x = make_engine(model=model, params=params, max_out_tokens=512)
+    eng_k = make_engine(model=model, params=params, max_out_tokens=512,
+                        replace_with_kernel_inject=True)
+    out_x = eng_x.generate(long_prompts, max_new_tokens=6)
+    out_k = eng_k.generate(long_prompts, max_new_tokens=6)
+    assert all((a == b).all() for a, b in zip(out_x, out_k))
 
 
 def test_decode_kernel_vs_reference():
