@@ -83,6 +83,22 @@ def in_manual_region():
     return bool(_state["manual_axes"])
 
 
+def attention_partition_axes(batch_size, num_heads):
+    """Mesh placement for an attention computation on (B, T, H, D) tensors:
+    batch over the data axes, heads over (seq, tensor) — the Ulysses-style
+    head-scatter layout. Returns ``(dp_axes, head_axes)``; an axis group is
+    dropped (empty tuple) when the corresponding dim is not divisible, so the
+    kernel wrapper and the model constraints always agree on placement."""
+    mesh = get_mesh()
+    dp = tuple(a for a in (EXPERT_AXIS, DATA_AXIS) if mesh.shape[a] > 1)
+    if dp and batch_size % int(np.prod([mesh.shape[a] for a in dp])) != 0:
+        dp = ()
+    head = tuple(a for a in (SEQ_AXIS, TENSOR_AXIS) if mesh.shape[a] > 1)
+    if head and num_heads % int(np.prod([mesh.shape[a] for a in head])) != 0:
+        head = ()
+    return dp, head
+
+
 # ---------------------------------------------------------------------------
 # Init / world queries
 # ---------------------------------------------------------------------------

@@ -24,6 +24,7 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import flax.linen as nn
+from jax.sharding import PartitionSpec as P
 
 from ..comm import comm as dist
 
@@ -143,6 +144,31 @@ def apply_rope(x, sin, cos):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
+def _ulysses_specs(B, nh):
+    """Ulysses-style sequence parallelism as placement (DeepSpeed-Ulysses;
+    absent in the v0.9.2 reference — SURVEY §2.3 makes SP a build
+    requirement): inside attention, re-shard from sequence-split activations
+    to head-split q/k/v — XLA inserts the all-to-alls over ICI — and back.
+    Returns (heads_spec, seq_spec) or None when the mesh cannot split this
+    shape."""
+    if not dist.has_mesh() or dist.in_manual_region():
+        return None
+    mesh = dist.get_mesh()
+    if mesh.shape[dist.SEQ_AXIS] == 1:
+        return None
+    dp_axes, head_axes = dist.attention_partition_axes(B, nh)
+    if dist.SEQ_AXIS not in head_axes:
+        return None  # heads not divisible: leave sequence-sharded (all-gather)
+    heads = P(dp_axes or None, None, head_axes, None)
+    seq = P(dp_axes or None, dist.SEQ_AXIS, None, None)
+    return heads, seq
+
+
+def _constrain(x, spec):
+    from jax.sharding import NamedSharding
+    return jax.lax.with_sharding_constraint(x, NamedSharding(dist.get_mesh(), spec))
+
+
 def _sdpa_xla(q, k, v, mask_bias, dtype):
     """Pure-XLA attention: softmax in fp32, big-negative causal bias."""
     hd = q.shape[-1]
@@ -229,13 +255,14 @@ class Attention(nn.Module):
                   and isinstance(cache_index, int) and cache_index == 0):
                 # unpadded prefill: nothing earlier in the cache, so attention
                 # over the current tokens only — the flash kernel path
-                from ..ops.pallas.flash_attention import flash_attention
+                from ..ops.pallas.flash_attention import sharded_flash_attention
                 kx, vx = k, v
                 if nkv != nh:
                     kx = jnp.repeat(kx, nh // nkv, axis=2)
                     vx = jnp.repeat(vx, nh // nkv, axis=2)
-                out = flash_attention(q, kx, vx, causal=True,
-                                      block_q=cfg.attention_block_q, block_kv=cfg.attention_block_kv)
+                out = sharded_flash_attention(q, kx, vx, causal=True,
+                                              block_q=cfg.attention_block_q,
+                                              block_kv=cfg.attention_block_kv)
             else:
                 out = _cached_attention_xla(q, ck, cv, cache_index, attn_mask, cfg.dtype)
             out = out.astype(cfg.dtype)
@@ -246,15 +273,22 @@ class Attention(nn.Module):
                 k = jnp.repeat(k, nh // nkv, axis=2)
                 v = jnp.repeat(v, nh // nkv, axis=2)
             S = k.shape[1]
+            ulysses = _ulysses_specs(B, nh)
+            if ulysses is not None:
+                heads_spec, seq_spec = ulysses
+                q, k, v = (_constrain(t, heads_spec) for t in (q, k, v))
             if cfg.attention_impl == "flash" and T >= 128 and attn_mask is None:
-                from ..ops.pallas.flash_attention import flash_attention
-                out = flash_attention(q, k, v, causal=True,
-                                      block_q=cfg.attention_block_q, block_kv=cfg.attention_block_kv)
+                from ..ops.pallas.flash_attention import sharded_flash_attention
+                out = sharded_flash_attention(q, k, v, causal=True,
+                                              block_q=cfg.attention_block_q,
+                                              block_kv=cfg.attention_block_kv)
             else:
                 bias = jnp.where(jnp.tril(jnp.ones((T, S), dtype=bool)), 0.0, -1e30)[None, None]
                 if attn_mask is not None:
                     bias = bias + jnp.where(attn_mask, 0.0, -1e30)[:, None, None, :].astype(jnp.float32)
                 out = _sdpa_xla(q, k, v, bias, cfg.dtype)
+            if ulysses is not None:
+                out = _constrain(out, seq_spec)
 
         out = nn.DenseGeneral(features=H, axis=(-2, -1), use_bias=cfg.norm == "layernorm",
                               dtype=cfg.dtype, param_dtype=jnp.float32,

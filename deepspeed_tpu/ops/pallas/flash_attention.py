@@ -21,7 +21,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
 
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
@@ -281,3 +283,36 @@ def _flash_bwd(causal, block_q, block_kv, scale, res, g):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def sharded_flash_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
+    """Mesh-aware flash attention: q/k/v (B, T, H, D) with full (or
+    head-gathered) sequence per shard.
+
+    A ``pallas_call`` cannot be split by the automatic SPMD partitioner, so on
+    a non-trivial mesh the kernel runs inside ``shard_map``: batch over the
+    data axes and heads over (seq, tensor) — the head-parallel placement
+    Ulysses-style sequence parallelism hands us (DeepSpeed-Ulysses; the
+    v0.9.2 reference's long-sequence surface is block-sparse attention,
+    ``deepspeed/ops/sparse_attention/``). Falls back to a direct call on a
+    trivial mesh or inside an enclosing manual region.
+    """
+    from ...comm import comm as dist
+
+    if not dist.has_mesh() or dist.in_manual_region():
+        return flash_attention(q, k, v, causal, block_q, block_kv, scale)
+    mesh = dist.get_mesh()
+    B, T, H, D = q.shape
+    dp_axes, head_axes = dist.attention_partition_axes(B, H)
+    if not dp_axes and not head_axes:
+        return flash_attention(q, k, v, causal, block_q, block_kv, scale)
+
+    spec = P(dp_axes or None, None, head_axes or None, None)
+
+    def fn(q, k, v):  # positional: custom_vjp rejects kwargs
+        return flash_attention(q, k, v, causal, block_q, block_kv, scale)
+
+    with dist.manual_axes(set(dp_axes) | set(head_axes)):
+        # check_vma=False: pallas_call out_shapes carry no vma annotations
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                             axis_names=set(dp_axes) | set(head_axes), check_vma=False)(q, k, v)

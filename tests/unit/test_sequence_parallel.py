@@ -1,0 +1,74 @@
+"""Sequence parallelism tests (Ulysses-style head-scatter).
+
+SURVEY §2.3/§7: SP is a first-class build requirement absent from the v0.9.2
+reference. Training with the sequence dim sharded over ``seq`` must be
+numerically identical to the dense baseline, for both the XLA and the Pallas
+flash attention paths, and compose with TP/ZeRO.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models import get_model
+
+
+def run_losses(mesh_cfg=None, zero=0, steps=3, T=64, **model_kw):
+    comm._state["mesh"] = None
+    model = get_model("tiny", dtype=jnp.float32, **model_kw)
+    cfg = {"train_batch_size": 16, "gradient_accumulation_steps": 2,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000, "zero_optimization": {"stage": zero}}
+    if mesh_cfg:
+        cfg["mesh"] = mesh_cfg
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (16, T)).astype(np.int32)}
+    return [float(engine.train_batch(batch=batch)) for _ in range(steps)]
+
+
+def test_sp2_matches_dense():
+    base = run_losses()
+    sp = run_losses({"sequence_parallel_size": 2})
+    assert np.allclose(base, sp, rtol=2e-4), f"{base} vs {sp}"
+
+
+def test_sp4_matches_dense():
+    base = run_losses()
+    sp = run_losses({"sequence_parallel_size": 4})
+    assert np.allclose(base, sp, rtol=2e-4), f"{base} vs {sp}"
+
+
+def test_sp2_tp2_matches_dense():
+    base = run_losses()
+    sp = run_losses({"sequence_parallel_size": 2, "tensor_parallel_size": 2})
+    assert np.allclose(base, sp, rtol=2e-4), f"{base} vs {sp}"
+
+
+def test_sp2_zero3_matches_dense():
+    base = run_losses()
+    sp = run_losses({"sequence_parallel_size": 2}, zero=3)
+    assert np.allclose(base, sp, rtol=2e-4), f"{base} vs {sp}"
+
+
+def test_sp2_flash_matches_dense():
+    """Flash kernel under shard_map on a seq>1 mesh (T=128 triggers the
+    kernel; interpret mode on the CPU mesh)."""
+    base = run_losses(T=128, attention_impl="flash", steps=2)
+    sp = run_losses({"sequence_parallel_size": 2}, T=128, attention_impl="flash", steps=2)
+    assert np.allclose(base, sp, rtol=2e-4), f"{base} vs {sp}"
+
+
+def test_sp2_batch_places_seq_dim():
+    """The engine shards the batch's sequence dim over seq."""
+    comm._state["mesh"] = None
+    model = get_model("tiny", dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config={"train_batch_size": 16, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                             "steps_per_print": 1000, "mesh": {"sequence_parallel_size": 2}})
+    rng = np.random.default_rng(0)
+    placed = engine._shard_batch({"input_ids": rng.integers(0, 256, (16, 64)).astype(np.int32)})
+    spec = placed["input_ids"].sharding.spec
+    assert "seq" in str(spec), f"sequence dim not sharded: {spec}"
