@@ -412,6 +412,12 @@ class CausalLMModel:
         self.cfg = cfg
         self.module = CausalLM(cfg)
 
+    def set_remat_policy(self, policy):
+        """Engine hook for the ``activation_checkpointing`` config section:
+        rebuild the module with the given ``jax.checkpoint`` policy name."""
+        self.cfg = dataclasses.replace(self.cfg, remat_policy=policy)
+        self.module = CausalLM(self.cfg)
+
     def init_params(self, rng):
         B, T = 2, min(self.cfg.max_seq_len, 128)
         ids = jnp.zeros((B, T), jnp.int32)

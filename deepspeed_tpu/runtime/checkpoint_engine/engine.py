@@ -16,6 +16,7 @@ Layout per checkpoint dir (DeepSpeed-compatible shape):
 
 import json
 import os
+import threading
 
 import jax
 import numpy as np
@@ -84,22 +85,61 @@ def get_latest_tag(load_dir):
     return None
 
 
+# one long-lived async engine (an AsyncCheckpointer owns a background
+# thread pool; creating one per save would leak threads) + the in-flight
+# finalizer thread, which writes 'latest' once the write is durable
+_async_engine = None
+_pending_commit = None
+
+
+def _get_async_engine():
+    global _async_engine
+    if _async_engine is None:
+        _async_engine = OrbaxCheckpointEngine(use_async=True)
+    return _async_engine
+
+
+def wait_pending_saves():
+    """Block until any in-flight async checkpoint is fully committed and its
+    'latest' pointer written. Call before load, exit, or dependent work."""
+    global _pending_commit
+    if _pending_commit is not None:
+        _pending_commit.join()
+        _pending_commit = None
+
+
 def save_checkpoint(save_dir, tag, state, client_sd, save_latest=True, use_async=False):
+    global _pending_commit
+    wait_pending_saves()  # serialize with a previous in-flight save
     ckpt_dir = os.path.join(os.path.abspath(save_dir), str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
-    engine = OrbaxCheckpointEngine(use_async=use_async)
+    engine = _get_async_engine() if use_async else OrbaxCheckpointEngine()
     engine.save(state, os.path.join(ckpt_dir, "state"))
     if jax.process_index() == 0:
         with open(os.path.join(ckpt_dir, "client_sd.json"), "w") as f:
             json.dump(_jsonable(client_sd), f, indent=2)
-        if save_latest:
+
+    # 'latest' moves only once the write is durable (commit blocks on the
+    # async writer), so a crash mid-save can never leave 'latest' pointing at
+    # a partial checkpoint. In async mode that finalization overlaps training
+    # on a daemon thread (the reference's Nebula tiered-commit pattern,
+    # nebula_checkpoint_engine.py:20).
+    def finalize():
+        engine.commit(tag)
+        if save_latest and jax.process_index() == 0:
             with open(_latest_path(save_dir), "w") as f:
                 f.write(str(tag))
-    engine.commit(tag)
+
+    if use_async:
+        _pending_commit = threading.Thread(target=finalize, daemon=True, name=f"ckpt-commit-{tag}")
+        _pending_commit.start()
+    else:
+        finalize()
 
 
 def load_checkpoint(load_dir, tag, state_shardings, mesh, template, load_optimizer_states=True,
                     load_module_only=False):
+    wait_pending_saves()
     load_dir = os.path.abspath(load_dir)
     if tag is None:
         tag = get_latest_tag(load_dir)
@@ -135,6 +175,7 @@ def load_params_only(load_dir, tag=None, abstract_params=None):
     ``inference/engine.py:419``). With ``abstract_params`` (a
     ``jax.eval_shape`` pytree) only the params subtree is read from disk —
     optimizer moments and accumulators are never materialized."""
+    wait_pending_saves()
     load_dir = os.path.abspath(load_dir)
     if tag is None:
         tag = get_latest_tag(load_dir)

@@ -179,6 +179,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
 
         super().__init__(config_dict)
         self.raw_config = config_dict
+        self._warn_inert_sections(config_dict)
 
         if world_size is None:
             try:
@@ -203,6 +204,29 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
         self._resolve_data_parallel_size()
         self._configure_train_batch_size()
         self._do_sanity_check()
+
+    # Config sections parsed for DeepSpeed-JSON compatibility but not (yet)
+    # backed by an implementation. Silent acceptance would be a correctness
+    # trap for users porting configs, so their presence warns loudly. Remove
+    # entries as the corresponding subsystem lands.
+    INERT_SECTIONS = frozenset({
+        "amp", "sparse_attention", "progressive_layer_drop", "data_efficiency",
+        "curriculum_learning", "compression_training", "autotuning", "elasticity",
+        "aio", "pipeline", "flops_profiler", "sparse_gradients", "communication_data_type",
+        "fp32_allreduce", "disable_allgather", "memory_breakdown", "dump_state",
+        "data_types", "zero_force_ds_cpu_optimizer", "nebula",
+    })
+
+    def _warn_inert_sections(self, config_dict):
+        for key in sorted(set(config_dict) & self.INERT_SECTIONS):
+            val = config_dict[key]
+            if val in (False, None) or val == {} or val == []:
+                continue  # explicitly disabled / empty: nothing being ignored
+            if isinstance(val, dict) and val.get("enabled", True) is False:
+                continue  # {"enabled": false, ...}: disabled section
+            logger.warning(
+                f"config section '{key}' is accepted for DeepSpeed-JSON compatibility but "
+                f"has NO effect in this build — remove it or expect different behavior")
 
     # -- batch size arithmetic (reference config.py:738-760) ---------------
     def _resolve_data_parallel_size(self):

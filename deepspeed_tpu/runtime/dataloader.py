@@ -32,18 +32,30 @@ class DeepSpeedDataLoader:
                  drop_last=True,
                  seed=0,
                  shuffle=True,
-                 data_sampler=None):
+                 data_sampler=None,
+                 num_shards=1,
+                 shard_index=0):
+        """``num_shards``/``shard_index``: DistributedSampler-style split of
+        the sample stream across feeding processes — every process must use
+        the same seed so the global shuffle agrees, then each takes its own
+        interleaved slice (no duplicated samples across hosts)."""
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        if not (0 <= shard_index < num_shards):
+            raise ValueError(f"shard_index {shard_index} out of range for {num_shards} shards")
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or default_collate
         self.drop_last = drop_last
         self.shuffle = shuffle
         self.data_sampler = data_sampler
+        self.num_shards = num_shards
+        self.shard_index = shard_index
         self.epoch = 0
         self._rng = np.random.default_rng(seed)
         self.len = None
         if hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
-            n = len(dataset)
+            n = len(dataset) // num_shards
             self.len = n // batch_size if drop_last else (n + batch_size - 1) // batch_size
 
     def __len__(self):
@@ -58,7 +70,9 @@ class DeepSpeedDataLoader:
             order = np.asarray(list(iter(self.data_sampler)))
         elif self.shuffle:
             self._rng.shuffle(order)
-        for start in range(0, n, self.batch_size):
+        if self.num_shards > 1:
+            order = order[self.shard_index::self.num_shards]
+        for start in range(0, len(order), self.batch_size):
             idx = order[start:start + self.batch_size]
             if len(idx) < self.batch_size and self.drop_last:
                 break
@@ -66,7 +80,9 @@ class DeepSpeedDataLoader:
 
     def _iter_iterable(self):
         buf = []
-        for sample in self.dataset:
+        for i, sample in enumerate(self.dataset):
+            if self.num_shards > 1 and i % self.num_shards != self.shard_index:
+                continue
             buf.append(sample)
             if len(buf) == self.batch_size:
                 yield self.collate_fn(buf)

@@ -46,7 +46,9 @@ class TrainState(NamedTuple):
     step: Any  # i32 scalar
     params: Any  # fp32 master params (ZeRO-sharded per stage)
     opt_state: Any  # optimizer moments (ZeRO-sharded at stage >= 1)
-    grad_acc: Any  # gradient accumulator (facade path; stage-2 sharded)
+    grad_acc: Any  # gradient accumulator — empty {} until the 3-call facade
+    # is used (the fused train_batch path scans its own accumulator, so no
+    # param-sized HBM buffer is carried there)
     micro_step: Any  # i32 scalar: micro-batches seen since last step()
     loss_scale: Any  # LossScaleState
     skipped_steps: Any  # i32 scalar
@@ -117,6 +119,26 @@ class DeepSpeedEngine:
         self.compute_dtype = self._config.compute_dtype
         self.loss_scaler = create_loss_scaler(self._config.fp16 if self._config.fp16.enabled else None)
         self.dynamic_loss_scale = self._config.dynamic_loss_scale
+
+        # ---- activation checkpointing (reference runtime/
+        # activation_checkpointing/checkpointing.py:708; here a remat policy
+        # applied to the model before compilation) ---------------------------
+        ac = self._config.activation_checkpointing
+        if ac.policy is not None or ac.partition_activations or ac.cpu_checkpointing:
+            policy = ac.policy or "nothing_saveable"
+            if hasattr(model, "set_remat_policy"):
+                if getattr(getattr(model, "cfg", None), "remat_policy", None) != policy:
+                    model.set_remat_policy(policy)
+                    log_dist(f"activation checkpointing: remat policy '{policy}' applied", [0])
+            else:
+                logger.warning(
+                    "activation_checkpointing configured but the model exposes no "
+                    "set_remat_policy(policy) hook — section has NO effect; apply "
+                    "jax.checkpoint in the model yourself")
+            if ac.partition_activations:
+                log_dist("activation_checkpointing.partition_activations: subsumed by the "
+                         "sharding propagation of saved residuals (XLA keeps remat residuals "
+                         "in their sharded layout; no gather/scatter pass is needed)", [0])
 
         # ---- sharding plan (ZeRO stages as placement rules) --------------
         if tp_rules is None and hasattr(model, "tp_rules"):
@@ -259,7 +281,7 @@ class DeepSpeedEngine:
             step=scalar,
             params=master_shardings,
             opt_state=opt_shardings,
-            grad_acc=grad_shardings,
+            grad_acc={},
             micro_step=scalar,
             loss_scale=jax.tree_util.tree_map(lambda _: scalar, self.loss_scaler.init_state()),
             skipped_steps=scalar,
@@ -270,7 +292,7 @@ class DeepSpeedEngine:
                 step=jnp.zeros((), jnp.int32),
                 params=p,
                 opt_state=self.tx.init(p),
-                grad_acc=jax.tree_util.tree_map(jnp.zeros_like, p),
+                grad_acc={},
                 micro_step=jnp.zeros((), jnp.int32),
                 loss_scale=self.loss_scaler.init_state(),
                 skipped_steps=jnp.zeros((), jnp.int32),
@@ -279,6 +301,30 @@ class DeepSpeedEngine:
         )
         with self.mesh:
             return init_fn(params)
+
+    def _ensure_grad_acc(self):
+        """Materialize the facade gradient-accumulation buffer on first use.
+
+        The fused ``train_batch`` path never needs it, so a param-sized HBM
+        buffer (~280 GB across the mesh at 70B fp32) is only paid when the
+        forward/backward/step facade is actually exercised."""
+        if jax.tree_util.tree_leaves(self.state.grad_acc):
+            return
+        grad_shardings = self.planner.shardings(self.planner.grad_specs(self.state.params))
+        self.state_shardings = self.state_shardings._replace(grad_acc=grad_shardings)
+        alloc = jax.jit(lambda s: s._replace(grad_acc=jax.tree_util.tree_map(jnp.zeros_like, s.params)),
+                        donate_argnums=(0, ), out_shardings=self.state_shardings)
+        with self.mesh:
+            self.state = alloc(self.state)
+        self._compiled.clear()  # compiled fns embed the old state shardings
+
+    def _drop_grad_acc(self):
+        """Return state to the canonical (no accumulator) structure."""
+        if not jax.tree_util.tree_leaves(self.state.grad_acc):
+            return
+        self.state_shardings = self.state_shardings._replace(grad_acc={})
+        self.state = self.state._replace(grad_acc={})
+        self._compiled.clear()
 
     def _configure_lr_scheduler(self, client_lr_scheduler):
         """Returns (pure step->lr fn folded into the compiled step, stateful
@@ -517,8 +563,13 @@ class DeepSpeedEngine:
         def place(x):
             x = np.asarray(x)
             entries = [None] * x.ndim
-            if x.ndim > batch_dim and dp and x.shape[batch_dim] % int(
-                    np.prod([self.mesh.shape[a] for a in dp])) == 0:
+            if x.ndim > batch_dim and dp:
+                dp_size = int(np.prod([self.mesh.shape[a] for a in dp]))
+                if x.shape[batch_dim] % dp_size != 0:
+                    raise ValueError(
+                        f"batch dim {x.shape[batch_dim]} not divisible by the data-parallel "
+                        f"degree {dp_size} (mesh axes {dp}); pad or resize the batch — "
+                        f"silent replication would drop data parallelism")
                 entries[batch_dim] = tuple(dp) if len(dp) > 1 else dp[0]
             if seq_on and x.ndim > batch_dim + 1 and x.shape[batch_dim + 1] % self.mesh.shape[dist.SEQ_AXIS] == 0:
                 entries[batch_dim + 1] = dist.SEQ_AXIS
@@ -590,6 +641,7 @@ class DeepSpeedEngine:
                 "train_batch, pipe/engine.py:285)")
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
+        self._ensure_grad_acc()
         batch = self._shard_batch(batch)
         fn = self._get("micro", self._build_micro_fn)
         with self.mesh:
@@ -681,11 +733,24 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ data
     def deepspeed_io(self, dataset, batch_size=None, route=None, data_sampler=None, collate_fn=None, num_local_io_workers=None):
         from .dataloader import DeepSpeedDataLoader
+        # one JAX process feeds every device it controls (single-controller
+        # model), so the loader yields the process-local share of the global
+        # microbatch — micro_bs × dp ÷ processes — not the per-device size,
+        # and each process reads a disjoint interleaved shard of the dataset
+        if batch_size is None:
+            global_micro = self.train_micro_batch_size_per_gpu() * self.dp_world_size()
+            if global_micro % jax.process_count() != 0:
+                raise ValueError(
+                    f"global microbatch {global_micro} not divisible by process count "
+                    f"{jax.process_count()}; adjust train_micro_batch_size_per_gpu")
+            batch_size = global_micro // jax.process_count()
         return DeepSpeedDataLoader(dataset,
-                                   batch_size=batch_size or self.train_micro_batch_size_per_gpu(),
+                                   batch_size=batch_size,
                                    collate_fn=collate_fn or self.collate_fn,
                                    drop_last=self._config.dataloader_drop_last,
-                                   seed=self._seed)
+                                   seed=self._seed,
+                                   num_shards=jax.process_count(),
+                                   shard_index=jax.process_index())
 
     # ------------------------------------------------------------------ checkpoint
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
@@ -703,18 +768,31 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
             "ds_config": self._config.raw_config,
         })
-        _save(save_dir, tag, self.state, client_sd, save_latest=save_latest)
+        # grad_acc is in-flight facade scratch, not training state — always
+        # checkpoint the canonical (empty) structure so resume works from
+        # either API path (the reference likewise never checkpoints IPG
+        # buffers, engine.py:3012)
+        _save(save_dir, tag, self.state._replace(grad_acc={}), client_sd, save_latest=save_latest,
+              use_async=self._config.checkpoint.async_save)
         log_dist(f"saved checkpoint {save_dir}/{tag}", [0])
         return True
+
+    def wait_checkpoint_saves(self):
+        """Block until any in-flight async checkpoint (checkpoint.async_save)
+        is committed and its 'latest' pointer written."""
+        from .checkpoint_engine.engine import wait_pending_saves
+        wait_pending_saves()
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None):
         from .checkpoint_engine.engine import load_checkpoint as _load
-        state, client_sd = _load(load_dir, tag, self.state_shardings, self.mesh,
-                                 template=self.state, load_optimizer_states=load_optimizer_states,
+        state, client_sd = _load(load_dir, tag, self.state_shardings._replace(grad_acc={}), self.mesh,
+                                 template=self.state._replace(grad_acc={}),
+                                 load_optimizer_states=load_optimizer_states,
                                  load_module_only=load_module_only)
         if state is None:
             return None, None
+        self._drop_grad_acc()
         self.state = state
         self.global_steps = client_sd.get("global_steps", int(self.state.step))
         self.global_samples = client_sd.get("global_samples", 0)
@@ -729,11 +807,18 @@ class DeepSpeedEngine:
         ``save_16bit_model`` / ``_zero3_consolidated_16bit_state_dict``)."""
         import flax.serialization
         os.makedirs(save_dir, exist_ok=True)
-        gather = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype), p),
-                         out_shardings=jax.tree_util.tree_map(lambda _: NamedSharding(self.mesh, P()),
-                                                              self.state.params))
+        # stream one leaf at a time: gather → host fetch → free, so peak HBM
+        # overhead is one tensor, not the whole model replicated per device
+        # (the reference's stage-3 consolidation likewise walks params in
+        # groups, engine.py:3156)
+        replicated = NamedSharding(self.mesh, P())
+        cast_one = jax.jit(lambda x: jnp.asarray(x, self.compute_dtype), out_shardings=replicated)
+        leaves, treedef = jax.tree_util.tree_flatten(self.state.params)
+        host_leaves = []
         with self.mesh:
-            full = jax.device_get(gather(self.state.params))
+            for leaf in leaves:
+                host_leaves.append(jax.device_get(cast_one(leaf)))
+        full = jax.tree_util.tree_unflatten(treedef, host_leaves)
         path = os.path.join(save_dir, save_filename)
         if jax.process_index() == 0:
             with open(path, "wb") as f:

@@ -95,8 +95,11 @@ class DynamicLossScaler(LossScalerBase):
         ovf_hyst = jnp.where(depleted, state.cur_hysteresis, state.cur_hysteresis - 1)
 
         # clean branch (reference loss_scaler.py:195: consecutive_hysteresis
-        # re-arms every clean step; otherwise re-arm on each full clean window)
-        window_full = (it - state.last_overflow_iter) % self.scale_window == (self.scale_window - 1)
+        # re-arms every clean step; otherwise re-arm on each full clean window).
+        # With last_overflow_iter=-1 and window W the first doubling lands on
+        # iteration W-1, i.e. after exactly W clean updates — matching the
+        # reference's (cur_iter - last_overflow_iter) % window == 0 check.
+        window_full = (it - state.last_overflow_iter) % self.scale_window == 0
         ok_scale = jnp.where(window_full, state.cur_scale * self.scale_factor, state.cur_scale)
         rearm = jnp.logical_or(jnp.asarray(self.consecutive_hysteresis), window_full)
         ok_hyst = jnp.where(rearm, jnp.asarray(self.delayed_shift, jnp.int32), state.cur_hysteresis)

@@ -166,6 +166,146 @@ def test_dataloader_and_train_with_iter():
     assert np.isfinite([l0, l1]).all()
 
 
+def test_fused_path_carries_no_grad_acc_buffer():
+    """The fused train_batch path must not allocate a param-sized grad
+    accumulator (at 70B fp32 that's ~280 GB of dead HBM); only the 3-call
+    facade materializes it."""
+    import jax
+    engine = make_engine(base_config())
+    train_losses(engine, steps=2)
+    assert jax.tree_util.tree_leaves(engine.state.grad_acc) == []
+    # facade allocates lazily
+    batch = random_batch(engine.train_batch_size() // 2, HIDDEN)
+    engine.forward(batch)
+    assert len(jax.tree_util.tree_leaves(engine.state.grad_acc)) > 0
+
+
+def test_checkpoint_roundtrip_after_facade_use(tmp_path):
+    """grad_acc is never checkpointed: save after facade use, resume fused."""
+    engine = make_engine(base_config())
+    gas = engine.gradient_accumulation_steps()
+    micro = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size()
+    batch = random_batch(engine.train_batch_size(), HIDDEN, seed=100)
+    for g in range(gas):
+        mb = {k: v[g * micro:(g + 1) * micro] for k, v in batch.items()}
+        engine.backward(engine.forward(mb))
+    engine.step()
+    engine.save_checkpoint(str(tmp_path))
+    cont_a = train_losses(engine, steps=2)
+
+    comm._state["mesh"] = None
+    engine2 = make_engine(base_config(), seed=1)
+    engine2.load_checkpoint(str(tmp_path))
+    cont_b = train_losses(engine2, steps=2)
+    np.testing.assert_allclose(cont_a, cont_b, rtol=1e-5)
+
+
+def test_shard_batch_rejects_non_divisible_batch():
+    """A batch not divisible by the DP degree must error, not silently
+    replicate (losing data parallelism)."""
+    engine = make_engine(base_config())  # dp = 8 on the virtual mesh
+    with pytest.raises(ValueError, match="not divisible"):
+        engine.eval_batch(random_batch(3, HIDDEN))
+
+
+def test_induced_fp16_overflow_skips_step():
+    """An actual inf gradient must skip the update, halve the scale, and
+    count the skipped step (reference DynamicLossScaler semantics)."""
+    cfg = base_config(fp16={"enabled": True, "initial_scale_power": 16})
+    engine = make_engine(cfg)
+    params_before = np.asarray(engine.state.params["head"]["kernel"])
+    scale_before = float(engine.state.loss_scale.cur_scale)
+    bad = random_batch(engine.train_batch_size(), HIDDEN, seed=0)
+    bad["y"] = np.full_like(bad["y"], 1e25)  # (pred - 1e25)^2 -> inf in fp32
+    engine.train_batch(batch=bad)
+    assert int(engine.state.skipped_steps) == 1
+    assert int(engine.state.step) == 0
+    assert float(engine.state.loss_scale.cur_scale) <= scale_before
+    np.testing.assert_array_equal(np.asarray(engine.state.params["head"]["kernel"]), params_before)
+    # recovery: clean batches train normally afterwards
+    losses = train_losses(engine, steps=2)
+    assert np.isfinite(losses).all()
+
+
+def test_loss_scale_window_semantics():
+    """Scale doubles after exactly `scale_window` clean updates, not one early."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler
+    scaler = DynamicLossScaler(init_scale=2.0**8, scale_window=4, delayed_shift=2)
+    state = scaler.init_state()
+    clean = jnp.asarray(False)
+    for i in range(3):
+        state = scaler.update(state, clean)
+        assert float(state.cur_scale) == 2.0**8, f"doubled early at update {i + 1}"
+    state = scaler.update(state, clean)  # 4th clean update
+    assert float(state.cur_scale) == 2.0**9
+    # overflow resets the window
+    state = scaler.update(state, jnp.asarray(True))
+    state = scaler.update(state, jnp.asarray(True))  # hysteresis spent -> halve
+    assert float(state.cur_scale) == 2.0**8
+
+
+def test_activation_checkpointing_config_applies_remat():
+    """The activation_checkpointing section must change the model (remat
+    policy), and remat must not change numerics."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import get_model
+
+    def run(cfg_over):
+        comm._state["mesh"] = None
+        model = get_model("tiny", dtype=jnp.float32)
+        cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+               "steps_per_print": 1000, **cfg_over}
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, 256, (8, 32)).astype(np.int32)}
+        return model, [float(engine.train_batch(batch=batch)) for _ in range(2)]
+
+    m_base, base = run({})
+    assert m_base.cfg.remat_policy is None
+    m_ac, ac = run({"activation_checkpointing": {"policy": "nothing_saveable"}})
+    assert m_ac.cfg.remat_policy == "nothing_saveable"
+    np.testing.assert_allclose(base, ac, rtol=2e-4)
+    # HF-style boolean alias
+    m_gc, _ = run({"gradient_checkpointing": True})
+    assert m_gc.cfg.remat_policy == "nothing_saveable"
+
+
+def test_async_checkpoint_save(tmp_path):
+    """checkpoint.async_save plumbs through; 'latest' appears only after the
+    write is durable and the checkpoint loads back identically."""
+    cfg = base_config(checkpoint={"async_save": True})
+    engine = make_engine(cfg)
+    train_losses(engine, steps=2)
+    engine.save_checkpoint(str(tmp_path), tag="async_tag")
+    cont_a = train_losses(engine, steps=2)  # overlaps the background commit
+    engine.wait_checkpoint_saves()
+    assert (tmp_path / "latest").read_text().strip() == "async_tag"
+
+    comm._state["mesh"] = None
+    engine2 = make_engine(base_config(), seed=1)
+    engine2.load_checkpoint(str(tmp_path))
+    cont_b = train_losses(engine2, steps=2)
+    np.testing.assert_allclose(cont_a, cont_b, rtol=1e-5)
+
+
+def test_inert_config_section_warns(caplog):
+    import logging
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.utils.logging import logger as ds_logger
+    ds_logger.propagate = True  # let caplog's root handler see records
+    try:
+        with caplog.at_level(logging.WARNING, logger="DeepSpeedTPU"):
+            DeepSpeedConfig({"train_batch_size": 8, "autotuning": {"enabled": True}}, world_size=1)
+        assert any("autotuning" in r.message and "NO effect" in r.message for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.WARNING, logger="DeepSpeedTPU"):
+            DeepSpeedConfig({"train_batch_size": 8, "autotuning": {}}, world_size=1)
+        assert not any("autotuning" in r.message for r in caplog.records)
+    finally:
+        ds_logger.propagate = False
+
+
 def test_client_optimizer_and_scheduler():
     import optax
     model = SimpleModel(hidden_dim=HIDDEN)
