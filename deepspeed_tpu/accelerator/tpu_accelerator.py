@@ -86,8 +86,9 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         """Peak per-chip matmul FLOP/s for MFU math (best-effort by kind)."""
         kind = self.device_kind().lower()
         table = {
-            "v5 lite": 394e12,  # v5e bf16
-            "v5litepod": 394e12,
+            # bf16 peaks (v5e's oft-quoted 394 is the int8 rate — bf16 is 197)
+            "v5 lite": 197e12,
+            "v5litepod": 197e12,
             "v4": 275e12,
             "v5p": 459e12,
             "v6": 918e12,  # trillium

@@ -56,6 +56,10 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     scan_layers: bool = True
     remat_policy: Optional[str] = None  # None | "nothing_saveable" | "dots_saveable" | ...
+    # chunked cross-entropy: None = auto (on when vocab_size >= 4096 — the
+    # fp32 (B,T,V) logits buffer only dominates HBM at real vocab sizes);
+    # 0 = always dense logits; N = chunk rows of N
+    ce_chunk_size: Optional[int] = None
     attention_impl: str = "xla"  # "xla" | "flash"
     attention_block_q: int = 512
     attention_block_kv: int = 512
@@ -104,6 +108,119 @@ class TransformerConfig:
         return L * (attn + mlp + 2 * h) + emb + pos + h
 
 
+def resolve_remat_policy(name):
+    """Map a policy name to a ``jax.checkpoint`` policy. Beyond the stock
+    ``jax.checkpoint_policies`` names: ``dots_and_attn_saveable`` also pins
+    the Pallas flash-attention outputs (tagged via ``checkpoint_name``), so
+    backward reuses the forward kernel's result instead of re-running it."""
+    if name is None or name == "nothing_saveable":
+        return None
+    cp = jax.checkpoint_policies
+    if name == "dots_and_attn_saveable":
+        return cp.save_from_both_policies(
+            cp.dots_saveable, cp.save_only_these_names("flash_out", "flash_lse"))
+    policy = getattr(cp, name, None)
+    if policy is None:
+        known = [n for n in dir(cp) if not n.startswith("_")]
+        raise ValueError(
+            f"unknown remat policy {name!r} (a typo would silently mean full "
+            f"recompute); use 'nothing_saveable', 'dots_and_attn_saveable', or one of "
+            f"jax.checkpoint_policies: {known}")
+    return policy
+
+
+def chunked_cross_entropy(hidden, w, labels, valid, chunk=128, transpose=False):
+    """Sum of next-token CE over valid positions WITHOUT materializing the
+    full fp32 ``(B, T, V)`` logits (at bs16/seq1024/vocab50k that tensor is
+    ~3.3 GB and, saved for backward, dominates HBM).
+
+    ``hidden``: (B, T, H) compute dtype; ``w``: (V, H) when ``transpose``
+    (tied-embedding ``attend``) else (H, V); ``labels``/``valid``: (B, T).
+    Scans T in chunks of ``chunk`` rows with a hand-written VJP: forward
+    keeps only the running loss sum; backward rebuilds each logits block and
+    emits d(hidden)/d(w) directly from softmax(p) - onehot, so live memory is
+    one (B, chunk, V) block in either direction and the scan is never
+    differentiated through (scan-of-matmul transposition also trips an abort
+    in the CPU XLA runtime used by the test mesh). The scan runs over the
+    (replicated) time axis while the batch axis keeps its DP sharding.
+    """
+    B, T, H = hidden.shape
+    pad = (-T) % chunk
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)))
+        valid = jnp.pad(valid, ((0, 0), (0, pad)))
+    # labels/valid enter the custom_vjp as f32 so their cotangents are plain
+    # zero arrays — float0 cotangents inside the pipeline's shard_map AD are
+    # a known sharp edge
+    return _chunked_ce(hidden, w, labels.astype(jnp.float32), valid.astype(jnp.float32),
+                       T, chunk, transpose)
+
+
+def _ce_stack(hidden, labels, valid, chunk):
+    B, Tp, H = hidden.shape
+    nch = Tp // chunk
+    xs = hidden.reshape(B, nch, chunk, H).swapaxes(0, 1)  # (nch, B, chunk, H)
+    ls = labels.reshape(B, nch, chunk).swapaxes(0, 1)
+    vs = valid.reshape(B, nch, chunk).swapaxes(0, 1)
+    return xs, ls, vs
+
+
+def _ce_logits(xc, w, transpose):
+    eq = "bch,vh->bcv" if transpose else "bch,hv->bcv"
+    return jnp.einsum(eq, xc, w.astype(xc.dtype)).astype(jnp.float32)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _chunked_ce(hidden, w, labels, valid, T, chunk, transpose):
+    total, _ = _chunked_ce_fwd(hidden, w, labels, valid, T, chunk, transpose)
+    return total
+
+
+def _chunked_ce_fwd(hidden, w, labels, valid, T, chunk, transpose):
+    # python loop, not lax.scan: the chunk count is small and static, and a
+    # while-loop here costs sequentialization XLA can't schedule around
+    # (it also trips a rare abort in the multi-device CPU runtime the
+    # test mesh uses)
+    xs, ls, vs = _ce_stack(hidden, labels, valid, chunk)
+    total = jnp.zeros((), jnp.float32)
+    for i in range(xs.shape[0]):
+        logits = _ce_logits(xs[i], w, transpose)
+        lse = jax.nn.logsumexp(logits, axis=-1)  # (B, chunk)
+        lc = ls[i].astype(jnp.int32)
+        corr = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
+        total = total + jnp.sum((lse - corr) * vs[i])
+    return total, (hidden, w, labels, valid)
+
+
+def _chunked_ce_bwd(T, chunk, transpose, res, g):
+    hidden, w, labels, valid = res
+    B, Tp, H = hidden.shape
+    xs, ls, vs = _ce_stack(hidden, labels, valid, chunk)
+    V = w.shape[0] if transpose else w.shape[1]
+
+    dw = jnp.zeros(w.shape, jnp.float32)
+    dx_chunks = []
+    for i in range(xs.shape[0]):  # python loop: see _chunked_ce_fwd
+        xc, lc, vc = xs[i], ls[i].astype(jnp.int32), vs[i]
+        logits = _ce_logits(xc, w, transpose)
+        p = jax.nn.softmax(logits, axis=-1)
+        dlogit = (p - jax.nn.one_hot(lc, V, dtype=jnp.float32)) * (vc * g)[..., None]
+        dlogit = dlogit.astype(xc.dtype)  # matmuls at MXU rate
+        if transpose:
+            dx_chunks.append(jnp.einsum("bcv,vh->bch", dlogit, w.astype(xc.dtype)))
+            dw = dw + jnp.einsum("bcv,bch->vh", dlogit, xc).astype(jnp.float32)
+        else:
+            dx_chunks.append(jnp.einsum("bcv,hv->bch", dlogit, w.astype(xc.dtype)))
+            dw = dw + jnp.einsum("bch,bcv->hv", xc, dlogit).astype(jnp.float32)
+    dx = jnp.concatenate(dx_chunks, axis=1).reshape(B, Tp, H)
+    return (dx.astype(hidden.dtype), dw.astype(w.dtype),
+            jnp.zeros_like(labels), jnp.zeros_like(valid))
+
+
+_chunked_ce.defvjp(_chunked_ce_fwd, _chunked_ce_bwd)
+
+
 class RMSNorm(nn.Module):
     epsilon: float = 1e-5
     dtype: Any = jnp.bfloat16
@@ -131,16 +248,16 @@ def rope_table(head_size, max_len, theta):
 
 
 def apply_rope(x, sin, cos):
-    """x: (B, T, H, hd); tables (T, hd/2) shared across the batch or
+    """x: (B, H, T, hd); tables (T, hd/2) shared across the batch or
     (B, T, hd/2) per-row (left-padded generation). Citation: the reference's
     CUDA ``apply_rotary_pos_emb`` (csrc/transformer/inference/csrc/pt_binding.cpp:1765)."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     if sin.ndim == 2:
-        sin = sin[None, :, None, :]
-        cos = cos[None, :, None, :]
+        sin = sin[None, None, :, :]
+        cos = cos[None, None, :, :]
     else:
-        sin = sin[:, :, None, :]
-        cos = cos[:, :, None, :]
+        sin = sin[:, None, :, :]
+        cos = cos[:, None, :, :]
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
@@ -149,8 +266,8 @@ def _ulysses_specs(B, nh):
     absent in the v0.9.2 reference — SURVEY §2.3 makes SP a build
     requirement): inside attention, re-shard from sequence-split activations
     to head-split q/k/v — XLA inserts the all-to-alls over ICI — and back.
-    Returns (heads_spec, seq_spec) or None when the mesh cannot split this
-    shape."""
+    Returns (heads_spec, seq_spec) for bhtd tensors, or None when the mesh
+    cannot split this shape."""
     if not dist.has_mesh() or dist.in_manual_region():
         return None
     mesh = dist.get_mesh()
@@ -159,8 +276,8 @@ def _ulysses_specs(B, nh):
     dp_axes, head_axes = dist.attention_partition_axes(B, nh)
     if dist.SEQ_AXIS not in head_axes:
         return None  # heads not divisible: leave sequence-sharded (all-gather)
-    heads = P(dp_axes or None, None, head_axes, None)
-    seq = P(dp_axes or None, dist.SEQ_AXIS, None, None)
+    heads = P(dp_axes or None, head_axes, None, None)
+    seq = P(dp_axes or None, None, dist.SEQ_AXIS, None)
     return heads, seq
 
 
@@ -170,26 +287,26 @@ def _constrain(x, spec):
 
 
 def _sdpa_xla(q, k, v, mask_bias, dtype):
-    """Pure-XLA attention: softmax in fp32, big-negative causal bias."""
+    """Pure-XLA attention in bhtd: softmax in fp32, big-negative causal bias."""
     hd = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(hd)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(hd)
     scores = scores + mask_bias
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
 def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype):
     """Grouped-query attention against a KV cache, no head expansion.
 
-    q: (B, T, nh, hd); ck/cv: (B, nkv, S, hd); cache_mask: optional (B, S)
+    q: (B, nh, T, hd); ck/cv: (B, nkv, S, hd); cache_mask: optional (B, S)
     bool marking valid cache slots (left-pad masking). Query position ``i`` of
     this call sits at absolute cache position ``cache_index + i``.
     """
-    B, T, nh, hd = q.shape
+    B, nh, T, hd = q.shape
     nkv, S = ck.shape[1], ck.shape[2]
     g = nh // nkv
-    qg = q.reshape(B, T, nkv, g, hd)
-    scores = jnp.einsum("btkgd,bksd->bkgts", qg, ck).astype(jnp.float32) / jnp.sqrt(hd)
+    qg = q.reshape(B, nkv, g, T, hd)
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, ck).astype(jnp.float32) / jnp.sqrt(hd)
     kpos = jnp.arange(S)[None, :]
     qpos = cache_index + jnp.arange(T)[:, None]
     bias = jnp.where(kpos <= qpos, 0.0, -1e30)  # (T, S)
@@ -199,8 +316,48 @@ def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype):
     else:
         bias = bias[None, None, None]
     probs = jax.nn.softmax(scores + bias, axis=-1).astype(dtype)
-    out = jnp.einsum("bkgts,bksd->btkgd", probs, cv)
-    return out.reshape(B, T, nh, hd)
+    out = jnp.einsum("bkgts,bksd->bkgtd", probs, cv)
+    return out.reshape(B, nh, T, hd)
+
+
+class HeadProjection(nn.Module):
+    """q/k/v projection emitting head-major ``(B, heads, T, head_dim)``
+    directly — the matmul's output layout IS the attention layout, so no
+    transpose sits between the projection and the flash kernel. Param
+    shapes/names match ``nn.DenseGeneral(features=(heads, head_dim))``."""
+    heads: int
+    head_dim: int
+    use_bias: bool
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):  # (B, T, H) -> (B, heads, T, head_dim)
+        kernel = self.param("kernel", nn.initializers.normal(0.02),
+                            (x.shape[-1], self.heads, self.head_dim), jnp.float32)
+        y = jnp.einsum("bth,hnd->bntd", x, kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.heads, self.head_dim), jnp.float32)
+            y = y + bias.astype(self.dtype)[None, :, None, :]
+        return y
+
+
+class OutProjection(nn.Module):
+    """Attention output projection consuming bhtd. Param shapes/names match
+    ``nn.DenseGeneral(features=H, axis=(-2, -1))`` on (B, T, heads, hd)."""
+    features: int
+    use_bias: bool
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x):  # (B, heads, T, hd) -> (B, T, features)
+        n, d = x.shape[1], x.shape[-1]
+        kernel = self.param("kernel", nn.initializers.normal(0.02),
+                            (n, d, self.features), jnp.float32)
+        y = jnp.einsum("bntd,ndh->bth", x, kernel.astype(self.dtype))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features, ), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
 
 
 class Attention(nn.Module):
@@ -216,12 +373,11 @@ class Attention(nn.Module):
         cfg = self.cfg
         B, T, H = x.shape
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_size
-        dense = partial(nn.DenseGeneral, use_bias=cfg.norm == "layernorm", dtype=cfg.dtype,
-                        param_dtype=jnp.float32,
-                        kernel_init=nn.initializers.normal(0.02))
-        q = dense(features=(nh, hd), name="q_proj")(x)
-        k = dense(features=(nkv, hd), name="k_proj")(x)
-        v = dense(features=(nkv, hd), name="v_proj")(x)
+        use_bias = cfg.norm == "layernorm"
+        # bhtd layout end-to-end: projections emit head-major
+        q = HeadProjection(nh, hd, use_bias, cfg.dtype, name="q_proj")(x)
+        k = HeadProjection(nkv, hd, use_bias, cfg.dtype, name="k_proj")(x)
+        v = HeadProjection(nkv, hd, use_bias, cfg.dtype, name="v_proj")(x)
 
         if cfg.pos_embedding == "rope":
             if position_ids is not None:
@@ -237,30 +393,26 @@ class Attention(nn.Module):
         if kv_cache is not None:
             # cache layout (B, nkv, S, hd): contiguous (S, hd) slabs per head,
             # the shape the Pallas decode kernel streams (reference KV-cache
-            # arena: csrc/transformer/inference/includes/inference_context.h)
+            # arena: csrc/transformer/inference/includes/inference_context.h).
+            # k/v are already bhtd, so the cache write needs no transpose.
             ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.transpose(0, 2, 1, 3).astype(ck.dtype),
-                                                     cache_index, axis=2)
-            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.transpose(0, 2, 1, 3).astype(cv.dtype),
-                                                     cache_index, axis=2)
+            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_index, axis=2)
+            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_index, axis=2)
             if cfg.attention_impl == "flash" and T == 1:
                 from ..ops.pallas.decode_attention import decode_attention
                 if attn_mask is not None:
                     starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
                 else:
                     starts = jnp.zeros((B, ), jnp.int32)
-                out = decode_attention(q[:, 0], ck, cv, starts, cache_index + 1,
-                                       block_kv=cfg.decode_block_kv)[:, None]
+                out = decode_attention(q[:, :, 0], ck, cv, starts, cache_index + 1,
+                                       block_kv=cfg.decode_block_kv)[:, :, None]
             elif (cfg.attention_impl == "flash" and attn_mask is None and T >= 128
                   and isinstance(cache_index, int) and cache_index == 0):
                 # unpadded prefill: nothing earlier in the cache, so attention
                 # over the current tokens only — the flash kernel path
+                # (GQA-native: no head expansion)
                 from ..ops.pallas.flash_attention import sharded_flash_attention
-                kx, vx = k, v
-                if nkv != nh:
-                    kx = jnp.repeat(kx, nh // nkv, axis=2)
-                    vx = jnp.repeat(vx, nh // nkv, axis=2)
-                out = sharded_flash_attention(q, kx, vx, causal=True,
+                out = sharded_flash_attention(q, k, v, causal=True,
                                               block_q=cfg.attention_block_q,
                                               block_kv=cfg.attention_block_kv)
             else:
@@ -269,15 +421,18 @@ class Attention(nn.Module):
             new_cache = (ck, cv)
         else:
             new_cache = None
-            if nkv != nh:  # GQA expansion for the non-cache paths
-                k = jnp.repeat(k, nh // nkv, axis=2)
-                v = jnp.repeat(v, nh // nkv, axis=2)
-            S = k.shape[1]
+            use_flash = cfg.attention_impl == "flash" and T >= 128 and attn_mask is None
+            if nkv != nh and not use_flash:  # the flash kernel is GQA-native
+                k = jnp.repeat(k, nh // nkv, axis=1)
+                v = jnp.repeat(v, nh // nkv, axis=1)
+            S = k.shape[2]
             ulysses = _ulysses_specs(B, nh)
             if ulysses is not None:
                 heads_spec, seq_spec = ulysses
-                q, k, v = (_constrain(t, heads_spec) for t in (q, k, v))
-            if cfg.attention_impl == "flash" and T >= 128 and attn_mask is None:
+                q = _constrain(q, heads_spec)
+                if k.shape[1] == nh:
+                    k, v = _constrain(k, heads_spec), _constrain(v, heads_spec)
+            if use_flash:
                 from ..ops.pallas.flash_attention import sharded_flash_attention
                 out = sharded_flash_attention(q, k, v, causal=True,
                                               block_q=cfg.attention_block_q,
@@ -290,9 +445,7 @@ class Attention(nn.Module):
             if ulysses is not None:
                 out = _constrain(out, seq_spec)
 
-        out = nn.DenseGeneral(features=H, axis=(-2, -1), use_bias=cfg.norm == "layernorm",
-                              dtype=cfg.dtype, param_dtype=jnp.float32,
-                              kernel_init=nn.initializers.normal(0.02), name="o_proj")(out)
+        out = OutProjection(H, use_bias, cfg.dtype, name="o_proj")(out)
         return out, new_cache
 
 
@@ -346,10 +499,12 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
-                 cache_index=None, position_ids=None):
+                 cache_index=None, position_ids=None, return_hidden=False):
         """``kv_cache``: optional per-layer (k, v) with leading layer dim —
         shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
-        stack. Returns logits, or (logits, new_kv_cache) when caching."""
+        stack. Returns logits, or (logits, new_kv_cache) when caching, or the
+        final-norm hidden states when ``return_hidden`` (the loss path fuses
+        the vocab projection into a chunked cross-entropy instead)."""
         cfg = self.cfg
         B, T = input_ids.shape
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -369,9 +524,8 @@ class CausalLM(nn.Module):
 
         block = Block
         if cfg.remat_policy:
-            policy = (None if cfg.remat_policy == "nothing_saveable" else getattr(
-                jax.checkpoint_policies, cfg.remat_policy, None))
-            block = nn.remat(Block, policy=policy, prevent_cse=not cfg.scan_layers,
+            block = nn.remat(Block, policy=resolve_remat_policy(cfg.remat_policy),
+                             prevent_cse=not cfg.scan_layers,
                              static_argnums=())
         new_cache = None
         if cfg.scan_layers:
@@ -394,6 +548,8 @@ class CausalLM(nn.Module):
                 new_cache = jax.tree_util.tree_map(lambda *cs: jnp.stack(cs), *caches)
 
         x = make_norm(cfg, name="final_norm")(x)
+        if return_hidden:
+            return x
         # logits matmul runs in compute dtype (MXU rate); CE upcasts to fp32
         if cfg.tie_embeddings:
             logits = emb.attend(x)
@@ -455,6 +611,28 @@ class CausalLMModel:
             return {"rngs": {"dropout": rng}, "deterministic": False}
         return {"deterministic": True}
 
+    def _ce_weight(self, params):
+        """(vocab-projection weight, transpose?) for chunked CE."""
+        if self.cfg.tie_embeddings:
+            return params["embed"]["embedding"], True  # (V, H)
+        return params["lm_head"]["kernel"], False  # (H, V)
+
+    def _use_chunked_ce(self):
+        """Chunked CE iterates the time axis, which must not be mesh-sharded —
+        under sequence parallelism fall back to full logits. Below ~4k vocab
+        the dense path is used too: the logits buffer is small there, and the
+        jax 0.9 multi-device *CPU* runtime (the test mesh) can rarely abort
+        when the chunked program runs many times in one process — at real
+        vocab sizes the path runs on TPU, where it is stable."""
+        if self.cfg.ce_chunk_size == 0:
+            return False
+        if self.cfg.ce_chunk_size is None and self.cfg.vocab_size < 4096:
+            return False
+        return not (dist.has_mesh() and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
+
+    def _ce_chunk(self):
+        return self.cfg.ce_chunk_size or 128
+
     def loss(self, params, batch, rng):
         """Next-token cross entropy. batch: input_ids (B,T), optional labels
         (B,T; -100 = ignore), optional attention_mask (B,T)."""
@@ -462,21 +640,30 @@ class CausalLMModel:
         attn_mask = batch.get("attention_mask")
         kw = self._apply_kwargs(rng)
         det = kw.pop("deterministic")
+        chunked = self._use_chunked_ce()
         out = self.module.apply({"params": params}, input_ids, attn_mask, det,
+                                return_hidden=chunked,
                                 mutable=["intermediates"] if self.cfg.num_experts > 0 else False, **kw)
-        logits, mutated = out if isinstance(out, tuple) else (out, {})
+        hidden_or_logits, mutated = out if isinstance(out, tuple) else (out, {})
 
         if "labels" in batch:
             labels = batch["labels"]
-            logits_t = logits
+            shift = slice(None)
         else:
             labels = input_ids[:, 1:]
-            logits_t = logits[:, :-1]
+            shift = slice(None, -1)
         valid = (labels >= 0)
         labels_c = jnp.maximum(labels, 0)
-        import optax
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits_t.astype(jnp.float32), labels_c)
-        loss = jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
+        if chunked:
+            w, transpose = self._ce_weight(params)
+            total = chunked_cross_entropy(hidden_or_logits[:, shift], w, labels_c, valid,
+                                          chunk=self._ce_chunk(), transpose=transpose)
+            loss = total / jnp.maximum(jnp.sum(valid), 1)
+        else:
+            import optax
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                hidden_or_logits[:, shift].astype(jnp.float32), labels_c)
+            loss = jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
         if self.cfg.num_experts > 0:
             aux = mutated.get("intermediates", {})
             aux_losses = jax.tree_util.tree_leaves(aux)
@@ -537,22 +724,28 @@ class CausalLMModel:
 
         norm_mod = make_norm(cfg)
         stream = norm_mod.apply({"params": params["final_norm"]}, stream)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("mbth,vh->mbtv", stream, table)
-        else:
-            logits = jnp.einsum("mbth,hv->mbtv", stream,
-                                params["lm_head"]["kernel"].astype(cfg.dtype))
 
         if "labels" in batch:
             labels = batch["labels"]
-            logits_t = logits
+            shift = slice(None)
         else:
             labels = ids[:, :, 1:]
-            logits_t = logits[:, :, :-1]
+            shift = slice(None, -1)
         valid = labels >= 0
         labels_c = jnp.maximum(labels, 0)
+        w, transpose = self._ce_weight(params)
+        if self._use_chunked_ce():
+            # microbatch stream folds into the batch dim for the chunked CE
+            H = stream.shape[-1]
+            total = chunked_cross_entropy(stream[:, :, shift].reshape(M * b, -1, H),
+                                          w, labels_c.reshape(M * b, -1),
+                                          valid.reshape(M * b, -1),
+                                          chunk=self._ce_chunk(), transpose=transpose)
+            return total / jnp.maximum(jnp.sum(valid), 1)
         import optax
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits_t.astype(jnp.float32), labels_c)
+        eq = "mbth,vh->mbtv" if transpose else "mbth,hv->mbtv"
+        logits = jnp.einsum(eq, stream[:, :, shift], w.astype(stream.dtype))
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), labels_c)
         return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
 
     def pipeline_pattern(self):

@@ -6,10 +6,20 @@ attention in ``csrc/transformer/ds_transformer_cuda.cpp``; the Triton
 block-sparse path in ``deepspeed/ops/sparse_attention/matmul.py``).
 
 FlashAttention-2-style online softmax: O(T) memory, fp32 accumulators in
-VMEM, bf16 MXU matmuls. Layout is ``(B, T, H, D)`` (the model's "bqhd").
-K/V live fully in VMEM per (batch, head) program — fine for T up to ~4k at
-D=128; longer sequences go through the ring-attention path (sequence
-parallelism) rather than a single-chip kernel.
+VMEM, bf16 MXU matmuls. Operates natively on the model's ``(B, H, T, D)``
+("bhtd") layout — blocks are carved by BlockSpec index maps over the
+sequence dim, so no transposes/copies appear around the kernel (those
+copies cost ~7% of a train step in the packed ``(B*H, T, D)`` formulation
+this replaces; the model computes attention in bhtd end-to-end).
+
+Grouped-query attention is native: K/V keep their ``kv_heads`` dimension and
+the index maps point query head ``h`` at KV head ``h // group``; nothing is
+repeated in HBM. The backward dk/dv kernel accumulates per *query* head and
+the group-sum is folded outside (a cheap reduce over the group dim).
+
+K/V for one (batch, head) program live in VMEM — ~2·T·D·2 bytes, which fits
+tens-of-k tokens at D=64..128; beyond that, sequence parallelism (ring /
+Ulysses over the ``seq`` axis) splits T across chips before the kernel runs.
 
 Backward follows the standard two-kernel split (dq; dkv) with the saved
 softmax log-sum-exp and delta = rowsum(dO * O).
@@ -33,19 +43,20 @@ def _interpret():
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal, seq_len):
-    """Grid: (B*H, num_q_blocks). Blocks: q (1, bq, D); k/v (1, Tkv, D)."""
-    block_q = q_ref.shape[1]
+    """Grid: (B, H, num_q_blocks). Blocks: q/o (1, 1, bq, D);
+    k/v (1, 1, Tkv, D) — the full (padded) KV head in VMEM; lse (1, 1, bq)."""
+    block_q = q_ref.shape[2]
     d = q_ref.shape[-1]
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
     q_start = qi * block_q
 
-    q = q_ref[0].astype(jnp.float32) * scale
+    q = q_ref[0, 0].astype(jnp.float32) * scale  # (bq, D)
 
     m = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
     acc = jnp.zeros((block_q, d), jnp.float32)
 
-    num_kv = pl.cdiv(k_ref.shape[1], block_kv)
+    num_kv = pl.cdiv(k_ref.shape[2], block_kv)
     if causal:
         num_kv_eff = jax.lax.min(num_kv, pl.cdiv(q_start + block_q, block_kv))
     else:
@@ -54,8 +65,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
     def body(j, carry):
         m, l, acc = carry
         kv_start = j * block_kv
-        k = k_ref[0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32)  # (bq, bkv)
 
@@ -77,29 +88,29 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
     m, l, acc = jax.lax.fori_loop(0, num_kv_eff, body, (m, l, acc))
 
     l_safe = jnp.where(l == 0, 1.0, l)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l_safe)  # (bq, 1)
+    o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)  # (bq, 1)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_kv, causal,
                    seq_len):
-    block_q = q_ref.shape[1]
+    block_q = q_ref.shape[2]
     d = q_ref.shape[-1]
-    qi = pl.program_id(1)
+    qi = pl.program_id(2)
     q_start = qi * block_q
 
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]  # (bq, 1)
-    delta = delta_ref[0]  # (bq, 1)
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # (bq, 1)
+    delta = delta_ref[0, 0]  # (bq, 1)
 
-    num_kv = pl.cdiv(k_ref.shape[1], block_kv)
+    num_kv = pl.cdiv(k_ref.shape[2], block_kv)
     num_kv_eff = jax.lax.min(num_kv, pl.cdiv(q_start + block_q, block_kv)) if causal else num_kv
 
     def body(j, dq):
         kv_start = j * block_kv
-        k = k_ref[0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())), preferred_element_type=jnp.float32)
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
         kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
@@ -113,30 +124,32 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
                                         preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, num_kv_eff, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q,
                     causal, seq_len):
-    """Grid: (B*H, num_kv_blocks). Blocks: k/v (1, bkv, D); q/do (1, Tq, D)."""
-    block_kv = k_ref.shape[1]
+    """Grid: (B, H, num_kv_blocks). k/v blocks (1, 1, bkv, D) come from the
+    (possibly grouped) KV head for query head h; dk/dv are written per
+    *query* head (into (B, H, Tkv, D)) and group-summed by the caller."""
+    block_kv = k_ref.shape[2]
     d = k_ref.shape[-1]
-    ki = pl.program_id(1)
+    ki = pl.program_id(2)
     kv_start = ki * block_kv
 
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
 
-    num_q = pl.cdiv(q_ref.shape[1], block_q)
+    num_q = pl.cdiv(q_ref.shape[2], block_q)
     start_q = (kv_start // block_q) if causal else 0
 
     def body(i, carry):
         dk, dv = carry
         q_start = i * block_q
-        q = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(q_start, block_q)]  # (bq, 1)
-        delta = delta_ref[0, pl.ds(q_start, block_q)]  # (bq, 1)
+        q = q_ref[0, 0, pl.ds(q_start, block_q), :].astype(jnp.float32) * scale
+        do = do_ref[0, 0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(q_start, block_q), :]  # (bq, 1)
+        delta = delta_ref[0, 0, pl.ds(q_start, block_q), :]  # (bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())), preferred_element_type=jnp.float32)
         q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
@@ -158,28 +171,31 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     dk, dv = jax.lax.fori_loop(start_q, num_q, body, (zero, zero))
     # q was pre-scaled inside the loop, so ds^T @ q_scaled already carries the
     # softmax scale — no extra factor here
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
 def _pad_seq(x, block):
-    t = x.shape[1]
+    t = x.shape[2]
     pad = (-t) % block
     if pad:
-        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
     return x
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
-    """q,k,v: (B, T, H, D) with equal head counts (GQA pre-expanded).
-    Returns (B, T, H, D)."""
+    """q: (B, H, T, D); k/v: (B, Hkv, T, D) with H divisible by Hkv (GQA
+    native — no pre-expansion). Returns (B, H, T, D)."""
     out, _ = _flash_fwd(q, k, v, causal, block_q, block_kv, scale)
     return out
 
 
 def _flash_call(q, k, v, causal, block_q, block_kv, scale):
-    B, T, H, D = q.shape
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    assert H % Hkv == 0, f"query heads {H} not a multiple of kv heads {Hkv}"
+    g = H // Hkv
     scale = scale if scale is not None else 1.0 / (D**0.5)
     block_q = min(block_q, T)
     block_kv = min(block_kv, T)
@@ -187,107 +203,111 @@ def _flash_call(q, k, v, causal, block_q, block_kv, scale):
     qp = _pad_seq(q, block_q)
     kp = _pad_seq(k, block_kv)
     vp = _pad_seq(v, block_kv)
-    Tq, Tkv = qp.shape[1], kp.shape[1]
-
-    def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, x.shape[1], D)
-
-    qb, kb, vb = to_bh(qp), to_bh(kp), to_bh(vp)
-    grid = (B * H, Tq // block_q)
+    Tq, Tkv = qp.shape[2], kp.shape[2]
+    grid = (B, H, Tq // block_q)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, block_kv=block_kv, causal=causal, seq_len=T)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tkv, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tkv, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tkv, D), lambda b, h, i: (b, h // g, 0, 0)),
+            pl.BlockSpec((1, 1, Tkv, D), lambda b, h, i: (b, h // g, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, Tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tq, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qb, kb, vb)
-    return out, lse, (qb, kb, vb, Tq, Tkv)
+    )(qp, kp, vp)
+    return out, lse, (qp, kp, vp, Tq, Tkv)
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_kv, scale):
-    B, T, H, D = q.shape
-    out_b, lse, (qb, kb, vb, Tq, Tkv) = _flash_call(q, k, v, causal, block_q, block_kv, scale)
-    out = out_b.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)[:, :T]
-    return out, (qb, kb, vb, out_b, lse, q.shape)
+    from jax.ad_checkpoint import checkpoint_name
+    T = q.shape[2]
+    out_p, lse, (qp, kp, vp, Tq, Tkv) = _flash_call(q, k, v, causal, block_q, block_kv, scale)
+    # name the kernel outputs so a remat policy can pin them: re-running the
+    # forward kernel inside backward costs ~6% of step time under plain
+    # dots_saveable (the custom-call is not a "dot"). Pair with
+    # jax.checkpoint_policies.save_only_these_names("flash_out", "flash_lse")
+    # (models.transformer exposes it as policy "dots_and_attn_saveable").
+    out_p = checkpoint_name(out_p, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out_p[:, :, :T], (qp, kp, vp, out_p, lse)
 
 
-def _flash_bwd(causal, block_q, block_kv, scale, res, g):
-    qb, kb, vb, out_b, lse, q_shape = res
-    B, T, H, D = q_shape
+def _flash_bwd(causal, block_q, block_kv, scale, res, g_out):
+    qp, kp, vp, out_p, lse = res
+    B, H, Tq, D = qp.shape
+    Hkv = kp.shape[1]
+    grp = H // Hkv
+    Tkv = kp.shape[2]
+    T = g_out.shape[2]
     scale_v = scale if scale is not None else 1.0 / (D**0.5)
     bq = min(block_q, T)
     bkv = min(block_kv, T)
-    Tq, Tkv = qb.shape[1], kb.shape[1]
 
-    gp = jnp.pad(g, ((0, 0), (0, Tq - T), (0, 0), (0, 0))) if Tq != T else g
-    dob = gp.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
+    dop = jnp.pad(g_out, ((0, 0), (0, 0), (0, Tq - T), (0, 0))) if Tq != T else g_out
 
-    delta = jnp.sum(dob.astype(jnp.float32) * out_b.astype(jnp.float32), axis=-1,
-                    keepdims=True)  # (BH, Tq, 1)
+    delta = jnp.einsum("bhtd,bhtd->bht", dop.astype(jnp.float32),
+                       out_p.astype(jnp.float32))[..., None]  # (B, H, Tq, 1)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale_v, block_kv=bkv, causal=causal, seq_len=T),
-        grid=(B * H, Tq // bq),
+        grid=(B, H, Tq // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Tkv, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Tkv, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, Tkv, D), lambda b, h, i: (b, h // grp, 0, 0)),
+            pl.BlockSpec((1, 1, Tkv, D), lambda b, h, i: (b, h // grp, 0, 0)),
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, i: (b, h, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), qb.dtype),
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, D), qp.dtype),
         interpret=_interpret(),
-    )(qb, kb, vb, dob, lse, delta)
+    )(qp, kp, vp, dop, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale_v, block_q=bq, causal=causal, seq_len=T),
-        grid=(B * H, Tkv // bkv),
+        grid=(B, H, Tkv // bkv),
         in_specs=[
-            pl.BlockSpec((1, Tq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bkv, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bkv, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, Tq, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tq, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, Tq, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j: (b, h // grp, j, 0)),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j: (b, h // grp, j, 0)),
+            pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq, 1), lambda b, h, j: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Tq, 1), lambda b, h, j: (b, h, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bkv, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bkv, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, j: (b, h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, Tkv, D), kb.dtype),
-            jax.ShapeDtypeStruct((B * H, Tkv, D), vb.dtype),
+            jax.ShapeDtypeStruct((B, H, Tkv, D), kp.dtype),
+            jax.ShapeDtypeStruct((B, H, Tkv, D), vp.dtype),
         ],
         interpret=_interpret(),
-    )(qb, kb, vb, dob, lse, delta)
+    )(qp, kp, vp, dop, lse, delta)
 
-    def from_bh(x, t_pad):
-        return x.reshape(B, H, t_pad, D).transpose(0, 2, 1, 3)[:, :T]
-
-    return from_bh(dq, Tq), from_bh(dk, Tkv), from_bh(dv, Tkv)
+    if grp > 1:  # group-sum per-query-head dk/dv back onto the shared KV head
+        dk = dk.reshape(B, Hkv, grp, Tkv, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, grp, Tkv, D).sum(axis=2)
+    return dq[:, :, :T], dk[:, :, :T], dv[:, :, :T]
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 def sharded_flash_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
-    """Mesh-aware flash attention: q/k/v (B, T, H, D) with full (or
-    head-gathered) sequence per shard.
+    """Mesh-aware flash attention: q (B, H, T, D), k/v (B, Hkv, T, D) with
+    full (or head-gathered) sequence per shard.
 
     A ``pallas_call`` cannot be split by the automatic SPMD partitioner, so on
     a non-trivial mesh the kernel runs inside ``shard_map``: batch over the
@@ -295,24 +315,33 @@ def sharded_flash_attention(q, k, v, causal=True, block_q=512, block_kv=512, sca
     Ulysses-style sequence parallelism hands us (DeepSpeed-Ulysses; the
     v0.9.2 reference's long-sequence surface is block-sparse attention,
     ``deepspeed/ops/sparse_attention/``). Falls back to a direct call on a
-    trivial mesh or inside an enclosing manual region.
+    trivial mesh or inside an enclosing manual region. When the KV head count
+    doesn't divide the head-axis degree, KV is expanded to full heads first —
+    every shard_map input must be sharded (a replicated input's cotangent
+    would need a psum that check_vma=False disables).
     """
     from ...comm import comm as dist
 
     if not dist.has_mesh() or dist.in_manual_region():
         return flash_attention(q, k, v, causal, block_q, block_kv, scale)
     mesh = dist.get_mesh()
-    B, T, H, D = q.shape
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
     dp_axes, head_axes = dist.attention_partition_axes(B, H)
     if not dp_axes and not head_axes:
         return flash_attention(q, k, v, causal, block_q, block_kv, scale)
 
-    spec = P(dp_axes or None, None, head_axes or None, None)
+    head_degree = int(np.prod([mesh.shape[a] for a in head_axes])) if head_axes else 1
+    qspec = P(dp_axes or None, head_axes or None, None, None)
+    if head_degree > 1 and Hkv % head_degree != 0:
+        k = jnp.repeat(k, H // Hkv, axis=1)
+        v = jnp.repeat(v, H // Hkv, axis=1)
+    kvspec = qspec
 
     def fn(q, k, v):  # positional: custom_vjp rejects kwargs
         return flash_attention(q, k, v, causal, block_q, block_kv, scale)
 
     with dist.manual_axes(set(dp_axes) | set(head_axes)):
         # check_vma=False: pallas_call out_shapes carry no vma annotations
-        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        return jax.shard_map(fn, mesh=mesh, in_specs=(qspec, kvspec, kvspec), out_specs=qspec,
                              axis_names=set(dp_axes) | set(head_axes), check_vma=False)(q, k, v)

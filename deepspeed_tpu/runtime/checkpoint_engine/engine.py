@@ -90,6 +90,7 @@ def get_latest_tag(load_dir):
 # finalizer thread, which writes 'latest' once the write is durable
 _async_engine = None
 _pending_commit = None
+_pending_error = None
 
 
 def _get_async_engine():
@@ -101,11 +102,16 @@ def _get_async_engine():
 
 def wait_pending_saves():
     """Block until any in-flight async checkpoint is fully committed and its
-    'latest' pointer written. Call before load, exit, or dependent work."""
-    global _pending_commit
+    'latest' pointer written. Call before load, exit, or dependent work.
+    Re-raises any failure from the background commit — a silently lost
+    checkpoint must not be discovered at restore time."""
+    global _pending_commit, _pending_error
     if _pending_commit is not None:
         _pending_commit.join()
         _pending_commit = None
+    if _pending_error is not None:
+        err, _pending_error = _pending_error, None
+        raise RuntimeError("async checkpoint save failed in the background") from err
 
 
 def save_checkpoint(save_dir, tag, state, client_sd, save_latest=True, use_async=False):
@@ -130,8 +136,17 @@ def save_checkpoint(save_dir, tag, state, client_sd, save_latest=True, use_async
             with open(_latest_path(save_dir), "w") as f:
                 f.write(str(tag))
 
+    def finalize_capturing():
+        global _pending_error
+        try:
+            finalize()
+        except BaseException as e:  # surfaced by the next wait_pending_saves()
+            _pending_error = e
+            logger.error(f"async checkpoint commit for tag {tag} failed: {e!r}")
+
     if use_async:
-        _pending_commit = threading.Thread(target=finalize, daemon=True, name=f"ckpt-commit-{tag}")
+        _pending_commit = threading.Thread(target=finalize_capturing, daemon=True,
+                                           name=f"ckpt-commit-{tag}")
         _pending_commit.start()
     else:
         finalize()

@@ -71,7 +71,11 @@ class DeepSpeedDataLoader:
         elif self.shuffle:
             self._rng.shuffle(order)
         if self.num_shards > 1:
-            order = order[self.shard_index::self.num_shards]
+            # equal shard sizes keep multi-host collectives in lockstep: drop
+            # the tail so every process sees the same number of batches
+            # (DistributedSampler-style; a ragged tail would desync epochs)
+            usable = n - n % self.num_shards
+            order = order[:usable][self.shard_index::self.num_shards]
         for start in range(0, len(order), self.batch_size):
             idx = order[start:start + self.batch_size]
             if len(idx) < self.batch_size and self.drop_last:

@@ -10,17 +10,18 @@ from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
 
 def ref_attn(q, k, v, causal=True):
+    """bhtd reference attention."""
     d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(d)
-    T, S = q.shape[1], k.shape[1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(d)
+    T, S = q.shape[2], k.shape[2]
     if causal:
         s = jnp.where(jnp.tril(jnp.ones((T, S), bool))[None, None], s, -1e30)
-    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1).astype(q.dtype), v)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1).astype(q.dtype), v)
 
 
 def make_qkv(T=256, B=2, H=4, D=64, dtype=jnp.float32, seed=0):
     rng = jax.random.PRNGKey(seed)
-    return tuple(jax.random.normal(jax.random.fold_in(rng, i), (B, T, H, D), dtype) for i in range(3))
+    return tuple(jax.random.normal(jax.random.fold_in(rng, i), (B, H, T, D), dtype) for i in range(3))
 
 
 @pytest.mark.parametrize("causal", [True, False])
@@ -38,6 +39,28 @@ def test_gradients(T):
     gr = jax.grad(lambda q, k, v: jnp.sum(ref_attn(q, k, v)**2), argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+@pytest.mark.parametrize("hkv", [1, 2])
+def test_gqa_native(hkv):
+    """K/V keep their grouped head count — fwd and grads match the expanded
+    reference."""
+    q, _, _ = make_qkv(T=256, H=4)
+    _, k, v = tuple(x[:, :hkv] for x in make_qkv(T=256, H=4, seed=1))
+    g = 4 // hkv
+    kx, vx = jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+    out = flash_attention(q, k, v, True, 128, 128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_attn(q, kx, vx, True)), atol=2e-5)
+    gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(q, k, v, True, 128, 128)**2),
+                  argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, kx, vx: jnp.sum(ref_attn(q, kx, vx)**2), argnums=(0, 1, 2))(q, kx, vx)
+    np.testing.assert_allclose(np.asarray(gf[0]), np.asarray(gr[0]), atol=2e-4)
+    # reference grads are per expanded head; group-sum to compare
+    B, _, T, D = q.shape
+    np.testing.assert_allclose(np.asarray(gf[1]),
+                               np.asarray(gr[1].reshape(B, hkv, g, T, D).sum(2)), atol=2e-4)
+    np.testing.assert_allclose(np.asarray(gf[2]),
+                               np.asarray(gr[2].reshape(B, hkv, g, T, D).sum(2)), atol=2e-4)
 
 
 def test_in_model():
