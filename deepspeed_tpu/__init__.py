@@ -47,16 +47,29 @@ def initialize(args=None,
 
     init_distributed()
 
-    engine = DeepSpeedEngine(model=model,
-                             config=config,
-                             optimizer=optimizer,
-                             model_parameters=model_parameters,
-                             training_data=training_data,
-                             lr_scheduler=lr_scheduler,
-                             mpu=mpu,
-                             dist_init_required=dist_init_required,
-                             collate_fn=collate_fn,
-                             **kwargs)
+    # dispatch on the parsed config so JSON-file configs work identically
+    import os as _os
+    if isinstance(config, (str, _os.PathLike)):
+        import json as _json
+        with open(config) as _f:
+            _sniff = _json.load(_f)
+    else:
+        _sniff = config if isinstance(config, dict) else {}
+    engine_cls = DeepSpeedEngine
+    if dict(_sniff.get("hybrid_engine", {})).get("enabled"):
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine_cls = DeepSpeedHybridEngine
+
+    engine = engine_cls(model=model,
+                        config=config,
+                        optimizer=optimizer,
+                        model_parameters=model_parameters,
+                        training_data=training_data,
+                        lr_scheduler=lr_scheduler,
+                        mpu=mpu,
+                        dist_init_required=dist_init_required,
+                        collate_fn=collate_fn,
+                        **kwargs)
     return engine, engine, engine.training_dataloader, engine.lr_scheduler
 
 

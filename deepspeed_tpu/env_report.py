@@ -1,0 +1,99 @@
+"""Environment report — the ``ds_report`` equivalent (reference
+``deepspeed/env_report.py``: op-compatibility matrix + framework versions).
+
+Run as ``python -m deepspeed_tpu.env_report`` or via the ``ds_report``
+console entry. Reports framework versions, the visible accelerator(s), and
+the native/kernel feature matrix (host cpu_adam build, Pallas kernels)."""
+
+import importlib
+import shutil
+import sys
+
+
+GREEN_OK = "[OKAY]"
+RED_NO = "[NO]"
+
+
+def _version(mod_name):
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return None
+
+
+def op_compatibility():
+    """(name, installable, status_detail) per native/kernel feature —
+    the analogue of the reference's op table (op_builder ``is_compatible``)."""
+    rows = []
+
+    cc = shutil.which("cc") or shutil.which("gcc")
+    try:
+        from .ops.adam.cpu_adam import cpu_adam_available
+        built = cpu_adam_available()
+    except Exception:
+        built = False
+    rows.append(("cpu_adam (host C, AVX via -march=native)", bool(cc), "built" if built else "not built"))
+
+    try:
+        importlib.import_module("deepspeed_tpu.ops.pallas.flash_attention")
+        rows.append(("flash_attention (Pallas)", True, "importable"))
+    except Exception as e:
+        rows.append(("flash_attention (Pallas)", False, str(e)))
+    try:
+        importlib.import_module("deepspeed_tpu.ops.pallas.decode_attention")
+        rows.append(("decode_attention (Pallas)", True, "importable"))
+    except Exception as e:
+        rows.append(("decode_attention (Pallas)", False, str(e)))
+    return rows
+
+
+def devices_summary():
+    try:
+        import jax
+        devs = jax.devices()
+        kinds = {}
+        for d in devs:
+            kinds[d.device_kind] = kinds.get(d.device_kind, 0) + 1
+        parts = [f"{n}x {k}" for k, n in kinds.items()]
+        return f"{jax.default_backend()}: " + ", ".join(parts)
+    except Exception as e:
+        return f"unavailable ({e})"
+
+
+def main(hide_operator_status=False, hide_errors_and_warnings=False):
+    lines = ["-" * 64, "DeepSpeed-TPU environment report", "-" * 64]
+    lines.append(f"python ................ {sys.version.split()[0]}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        v = _version(mod)
+        lines.append(f"{mod:<22} {v if v else RED_NO}")
+    try:
+        from .version import __version__ as ds_version
+    except Exception:
+        ds_version = "unknown"
+    lines.append(f"{'deepspeed_tpu':<22} {ds_version}")
+    lines.append(f"devices ............... {devices_summary()}")
+    try:
+        from .accelerator import get_accelerator
+        acc = get_accelerator()
+        lines.append(f"accelerator ........... {acc.device_name()} "
+                     f"(peak {acc.peak_flops() / 1e12:.0f} TFLOP/s bf16)")
+    except Exception:
+        pass
+
+    if not hide_operator_status:
+        lines.append("")
+        lines.append(f"{'op name':<44}{'compatible':<12}status")
+        for name, ok, detail in op_compatibility():
+            lines.append(f"{name:<44}{GREEN_OK if ok else RED_NO:<12}{detail}")
+    report = "\n".join(lines)
+    print(report)
+    return report
+
+
+def cli_main():
+    main()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from .runner import fetch_hostfile, parse_inclusion_exclusion, main  # noqa: F401

@@ -1,0 +1,192 @@
+"""Multi-host launcher.
+
+TPU-native analogue of the reference launcher (``launcher/runner.py:377``
+``main``, hostfile parsing :189, include/exclude filters :244, and the
+per-node ``launcher/launch.py``). Key design translation: DeepSpeed spawns
+ONE PROCESS PER GPU per node; JAX on TPU runs ONE PROCESS PER HOST and the
+runtime sees every local chip, so the launcher's job collapses to: resolve
+the host list, pick a coordinator, and start one bootstrap per host over ssh
+with ``JAX_PROCESS_ID``/``JAX_NUM_PROCESSES``/``COORDINATOR_ADDRESS`` set
+(consumed by ``deepspeed_tpu.comm.init_distributed`` →
+``jax.distributed.initialize``). GPU-style ``slots=N`` hostfile syntax is
+accepted for config compatibility; slots do not multiply processes.
+
+Single-host invocations exec the script directly (no ssh), matching the
+reference's local fast path.
+"""
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+DEFAULT_COORD_PORT = 8476
+
+
+def fetch_hostfile(hostfile_path):
+    """Parse a DeepSpeed-style hostfile: one ``hostname [slots=N]`` per line,
+    ``#`` comments. Returns an ordered {hostname: slots} dict (reference
+    ``runner.py:189``)."""
+    if not os.path.isfile(hostfile_path):
+        raise FileNotFoundError(f"hostfile {hostfile_path} not found")
+    resources = {}
+    with open(hostfile_path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for tok in parts[1:]:
+                key, _, val = tok.partition("=")
+                if key == "slots":
+                    try:
+                        slots = int(val)
+                    except ValueError:
+                        raise ValueError(f"hostfile line {lineno}: bad slots value {val!r}")
+                else:
+                    raise ValueError(f"hostfile line {lineno}: unknown token {tok!r}")
+            if host in resources:
+                raise ValueError(f"hostfile line {lineno}: duplicate host {host}")
+            resources[host] = slots
+    if not resources:
+        raise ValueError(f"hostfile {hostfile_path} is empty")
+    return resources
+
+
+def parse_inclusion_exclusion(resources, include_str="", exclude_str=""):
+    """Apply ``--include``/``--exclude`` node filters (reference
+    ``runner.py:244``). Syntax: ``node1@node2`` or ``node1:0,1`` — the
+    ``:slot`` form is accepted and restricts slot counts for parity, though
+    slots do not multiply TPU processes."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+
+    def parse_spec(spec):
+        wanted = {}
+        for node_spec in spec.split("@"):
+            node_spec = node_spec.strip()
+            if not node_spec:
+                continue
+            host, _, slot_str = node_spec.partition(":")
+            if host not in resources:
+                raise ValueError(f"filter references unknown host {host!r}")
+            wanted[host] = ([int(s) for s in slot_str.split(",")] if slot_str else None)
+        return wanted
+
+    if include_str:
+        keep = parse_spec(include_str)
+        return {h: (len(s) if s is not None else resources[h]) for h, s in keep.items()}
+    if exclude_str:
+        drop = parse_spec(exclude_str)
+        out = {}
+        for host, slots in resources.items():
+            if host not in drop:
+                out[host] = slots
+            elif drop[host] is not None:  # partial slot exclusion
+                remaining = slots - len(drop[host])
+                if remaining > 0:
+                    out[host] = remaining
+        if not out:
+            raise ValueError("exclusion filter removed every host")
+        return out
+    return dict(resources)
+
+
+def build_host_commands(hosts, coordinator, port, script, script_args, env_passthrough=()):
+    """One (host, argv, env) per process. Host 0 runs the coordinator."""
+    cmds = []
+    n = len(hosts)
+    for pid, host in enumerate(hosts):
+        env = {
+            "COORDINATOR_ADDRESS": f"{coordinator}:{port}",
+            "JAX_NUM_PROCESSES": str(n),
+            "JAX_PROCESS_ID": str(pid),
+        }
+        for key in env_passthrough:
+            if key in os.environ:
+                env[key] = os.environ[key]
+        argv = [sys.executable, "-u", script] + list(script_args)
+        cmds.append((host, argv, env))
+    return cmds
+
+
+def _ssh_wrap(host, argv, env, ssh_port=None):
+    exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
+    remote = f"cd {shlex.quote(os.getcwd())}; {exports} {' '.join(shlex.quote(a) for a in argv)}"
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    return cmd + [host, remote]
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="deepspeed-tpu",
+        description="Launch a deepspeed_tpu training script on one or many TPU hosts")
+    parser.add_argument("-H", "--hostfile", default="/job/hostfile",
+                        help="hostfile of ssh-reachable TPU-VM hosts")
+    parser.add_argument("-i", "--include", default="", help="node filter, e.g. host1@host2")
+    parser.add_argument("-e", "--exclude", default="", help="node filter, e.g. host3")
+    parser.add_argument("--num_nodes", type=int, default=-1, help="use first N hosts")
+    parser.add_argument("--master_addr", default=None, help="coordinator address override")
+    parser.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
+    parser.add_argument("--ssh_port", type=int, default=None)
+    parser.add_argument("--force_multi", action="store_true",
+                        help="use ssh launch even for one host")
+    parser.add_argument("user_script", help="training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    if os.path.isfile(args.hostfile):
+        resources = fetch_hostfile(args.hostfile)
+        resources = parse_inclusion_exclusion(resources, args.include, args.exclude)
+        hosts = list(resources)
+    else:
+        logger.info(f"no hostfile at {args.hostfile}; launching on localhost only")
+        hosts = ["localhost"]
+    if args.num_nodes > 0:
+        hosts = hosts[:args.num_nodes]
+
+    coordinator = args.master_addr or hosts[0]
+
+    if len(hosts) == 1 and not args.force_multi:
+        env = dict(os.environ)
+        env.update({"COORDINATOR_ADDRESS": f"{coordinator}:{args.master_port}",
+                    "JAX_NUM_PROCESSES": "1", "JAX_PROCESS_ID": "0"})
+        argv = [sys.executable, "-u", args.user_script] + args.user_args
+        logger.info(f"single-host launch: {' '.join(argv)}")
+        os.execvpe(argv[0], argv, env)  # replaces this process
+        return  # unreachable
+
+    cmds = build_host_commands(hosts, coordinator, args.master_port, args.user_script,
+                               args.user_args,
+                               env_passthrough=("PYTHONPATH", "JAX_PLATFORMS", "DSTPU_LOG_LEVEL"))
+    procs = []
+    for host, argv_h, env in cmds:
+        full = _ssh_wrap(host, argv_h, env, args.ssh_port)
+        logger.info(f"launching on {host}: JAX_PROCESS_ID={env['JAX_PROCESS_ID']}")
+        procs.append(subprocess.Popen(full))
+    rc = 0
+    try:
+        for p in procs:
+            rc = p.wait() or rc
+    except KeyboardInterrupt:  # propagate ctrl-c to the whole job
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait()
+        rc = 130
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

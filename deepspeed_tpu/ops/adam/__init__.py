@@ -1,0 +1,1 @@
+from .cpu_adam import DeepSpeedCPUAdam, cpu_adam_available  # noqa: F401

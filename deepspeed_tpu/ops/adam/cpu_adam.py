@@ -1,0 +1,144 @@
+"""Host (CPU) Adam/AdamW over numpy buffers.
+
+TPU-native analogue of the reference's ``DeepSpeedCPUAdam``
+(``deepspeed/ops/adam/cpu_adam.py:13`` over ``csrc/adam/cpu_adam.cpp``): the
+ZeRO-Offload optimizer step runs on the host CPU against optimizer state
+resident in host DRAM, freeing HBM for parameters/activations. The native
+kernel (``ops/csrc/cpu_adam.c``) is AOT-compiled on first use with
+``-O3 -march=native -fopenmp`` and bound via ctypes — the reference's JIT
+``OpBuilder`` machinery (op_builder/builder.py:434) collapses to one cached
+``cc`` invocation because there is no CUDA-arch matrix to probe. A pure-numpy
+fallback keeps the optimizer functional where no C compiler exists.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+
+from ...utils.logging import logger
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "csrc", "cpu_adam.c")
+_lib = None
+_build_failed = False
+
+
+def _build_lib():
+    """Compile (once, cached by source hash) and dlopen the host kernel."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    try:
+        with open(_SRC, "rb") as f:
+            src = f.read()
+        tag = hashlib.sha256(src).hexdigest()[:16]
+        cache_dir = os.environ.get("DSTPU_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "deepspeed_tpu")
+        os.makedirs(cache_dir, exist_ok=True)
+        so_path = os.path.join(cache_dir, f"cpu_adam_{tag}.so")
+        if not os.path.exists(so_path):
+            cc = os.environ.get("CC", "cc")
+            with tempfile.TemporaryDirectory() as td:
+                tmp_so = os.path.join(td, "cpu_adam.so")
+                cmd = [cc, "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+                       _SRC, "-o", tmp_so, "-lm"]
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(tmp_so, so_path)
+            logger.info(f"cpu_adam: built native host kernel -> {so_path}")
+        lib = ctypes.CDLL(so_path)
+        i64, f32, fp, u16p = ctypes.c_int64, ctypes.c_float, ctypes.POINTER(ctypes.c_float), \
+            ctypes.POINTER(ctypes.c_uint16)
+        lib.ds_adamw_step.argtypes = [fp, fp, fp, fp, i64, f32, f32, f32, f32, f32, i64, f32,
+                                      ctypes.c_int]
+        lib.ds_adamw_step_bf16g.argtypes = [fp, fp, fp, u16p, i64, f32, f32, f32, f32, f32, i64,
+                                            f32, ctypes.c_int]
+        lib.ds_f32_to_bf16.argtypes = [fp, u16p, i64]
+        lib.ds_adagrad_step.argtypes = [fp, fp, fp, i64, f32, f32, f32, f32]
+        _lib = lib
+    except Exception as e:  # no compiler / unsupported flags: numpy fallback
+        logger.warning(f"cpu_adam: native build failed ({e}); using numpy fallback")
+        _build_failed = True
+    return _lib
+
+
+def cpu_adam_available():
+    return _build_lib() is not None
+
+
+def _as_f32_ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _as_u16_ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+class DeepSpeedCPUAdam:
+    """Fused host AdamW over a flat fp32 buffer triple (param, m, v).
+
+    Reference API parity is intentionally loose: the torch version mutates
+    ``torch.nn.Parameter``s; here state lives in plain numpy arrays owned by
+    the ZeRO-Offload host optimizer (``runtime/zero/offload.py``).
+    """
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, adamw_mode=True):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self._lib = _build_lib()
+
+    def step(self, p, m, v, grad, step, lr=None, grad_coef=1.0):
+        """In-place AdamW update. ``p``/``m``/``v``: contiguous fp32 numpy
+        arrays; ``grad``: fp32 or bfloat16(uint16-viewed via ml_dtypes) numpy
+        array of the same size; ``step`` is 1-based."""
+        lr = self.lr if lr is None else lr
+        n = p.size
+        b1, b2 = self.betas
+        grad_is_bf16 = grad.dtype.itemsize == 2 and grad.dtype != np.float16  # bfloat16
+        if not grad_is_bf16 and grad.dtype != np.float32:
+            grad = grad.astype(np.float32)  # e.g. fp16 parity mode
+        if self._lib is not None:
+            if grad_is_bf16:
+                self._lib.ds_adamw_step_bf16g(
+                    _as_f32_ptr(p), _as_f32_ptr(m), _as_f32_ptr(v),
+                    _as_u16_ptr(grad.view(np.uint16)), n, lr, b1, b2, self.eps,
+                    self.weight_decay, step, grad_coef, int(self.adamw_mode))
+            else:
+                self._lib.ds_adamw_step(
+                    _as_f32_ptr(p), _as_f32_ptr(m), _as_f32_ptr(v), _as_f32_ptr(grad), n,
+                    lr, b1, b2, self.eps, self.weight_decay, step, grad_coef,
+                    int(self.adamw_mode))
+            return
+        # numpy fallback (same math)
+        g = grad.astype(np.float32) * grad_coef
+        if not self.adamw_mode and self.weight_decay:
+            g += self.weight_decay * p
+        m *= b1
+        m += (1 - b1) * g
+        v *= b2
+        v += (1 - b2) * np.square(g)
+        mhat = m / (1 - b1**step)
+        vhat = v / (1 - b2**step)
+        upd = mhat / (np.sqrt(vhat) + self.eps)
+        if self.adamw_mode and self.weight_decay:
+            upd += self.weight_decay * p
+        p -= lr * upd
+
+
+def f32_to_bf16(src, out=None):
+    """Round-to-nearest-even fp32 -> bfloat16 on the host (native when
+    available)."""
+    import ml_dtypes
+    lib = _build_lib()
+    if out is None:
+        out = np.empty(src.shape, dtype=ml_dtypes.bfloat16)
+    if lib is not None:
+        lib.ds_f32_to_bf16(_as_f32_ptr(src), _as_u16_ptr(out.view(np.uint16)), src.size)
+        return out
+    out[...] = src.astype(ml_dtypes.bfloat16)
+    return out

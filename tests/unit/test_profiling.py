@@ -1,0 +1,52 @@
+"""Flops profiler + env report tests (reference
+tests/unit/profiling/flops_profiler pattern: counted flops sanity vs the
+analytic matmul count)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models import get_model
+
+
+def test_get_model_profile_counts_matmul_flops():
+    from deepspeed_tpu.profiling import get_model_profile
+    model = get_model("tiny", dtype=jnp.float32)
+    B, T = 2, 64
+    flops, macs, params = get_model_profile(model, input_shape=(B, T), as_string=False,
+                                            print_profile=False)
+    assert macs == flops / 2
+    # at minimum the embedding->logits matmul flops must be counted
+    cfg = model.cfg
+    lower_bound = 2 * B * T * cfg.hidden_size * cfg.vocab_size
+    assert flops > lower_bound, (flops, lower_bound)
+    # and it cannot exceed a generous multiple of the analytic forward cost
+    analytic_fwd = 2 * B * T * cfg.num_params() + 4 * B * T * T * cfg.hidden_size * cfg.num_layers
+    assert flops < 20 * analytic_fwd, (flops, analytic_fwd)
+
+
+def test_engine_flops_profiler_section(tmp_path, caplog):
+    out = tmp_path / "flops.json"
+    comm._state["mesh"] = None
+    model = get_model("tiny", dtype=jnp.float32)
+    cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000,
+           "flops_profiler": {"enabled": True, "profile_step": 2, "output_file": str(out)}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 32)).astype(np.int32)}
+    engine.train_batch(batch=batch)
+    assert not hasattr(engine, "flops_profile")
+    engine.train_batch(batch=batch)  # profile_step
+    assert engine.flops_profile["flops"] > 0
+    assert out.exists()
+
+
+def test_env_report_runs():
+    from deepspeed_tpu.env_report import main, op_compatibility
+    report = main(hide_operator_status=False)
+    assert "jax" in report and "op name" in report
+    names = [row[0] for row in op_compatibility()]
+    assert any("cpu_adam" in n for n in names)
+    assert any("flash_attention" in n for n in names)

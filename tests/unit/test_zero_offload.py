@@ -1,0 +1,133 @@
+"""ZeRO-Offload tests (host-DRAM optimizer state + native cpu_adam).
+
+Pattern from the reference suite: offloaded training must be numerically
+equivalent to the on-device optimizer (tests/unit/runtime/zero/test_zero.py
+correctness-vs-baseline), plus checkpoint save/load round-trips and the
+native kernel matches optax math (tests/unit/ops/adam/ kernel-vs-torch).
+"""
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+
+from .simple_model import SimpleModel, random_batch
+
+HIDDEN = 64
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def offload_config(**over):
+    return base_config(zero_optimization={"stage": 2,
+                                          "offload_optimizer": {"device": "cpu"}}, **over)
+
+
+def make_engine(config, seed=0):
+    comm._state["mesh"] = None
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config, rng_seed=seed)
+    return engine
+
+
+def train_losses(engine, steps=5):
+    losses = []
+    for i in range(steps):
+        batch = random_batch(engine.train_batch_size(), HIDDEN, seed=100 + i % 2)
+        losses.append(float(engine.train_batch(batch=batch)))
+    return losses
+
+
+def test_offload_matches_device_optimizer():
+    """Host C AdamW over offloaded state == on-device optax.adamw."""
+    baseline = train_losses(make_engine(base_config()))
+    off = train_losses(make_engine(offload_config()))
+    np.testing.assert_allclose(baseline, off, rtol=2e-4)
+
+
+def test_offload_state_not_in_hbm():
+    import jax
+    engine = make_engine(offload_config())
+    assert jax.tree_util.tree_leaves(engine.state.opt_state) == []
+    assert engine.host_opt is not None
+    n_model = sum(x.size for x in jax.tree_util.tree_leaves(engine.state.params))
+    assert engine.host_opt.num_params() == n_model
+    train_losses(engine, steps=2)
+    # moments actually moved: a step changes them away from zero
+    assert any(np.abs(leaf).max() > 0 for leaf in jax.tree_util.tree_leaves(engine.host_opt.m))
+
+
+def test_offload_checkpoint_roundtrip(tmp_path):
+    engine = make_engine(offload_config())
+    train_losses(engine, steps=3)
+    engine.save_checkpoint(str(tmp_path))
+    cont_a = train_losses(engine, steps=2)
+
+    engine2 = make_engine(offload_config(), seed=1)
+    engine2.load_checkpoint(str(tmp_path))
+    cont_b = train_losses(engine2, steps=2)
+    np.testing.assert_allclose(cont_a, cont_b, rtol=1e-5)
+
+
+def test_offload_resume_from_non_offload_checkpoint(tmp_path):
+    """Cross-mode resume: params load, master rebuilds, training continues."""
+    engine = make_engine(base_config())
+    train_losses(engine, steps=2)
+    engine.save_checkpoint(str(tmp_path))
+
+    import jax
+    engine2 = make_engine(offload_config(), seed=1)
+    loaded_from_seed1 = np.asarray(jax.tree_util.tree_leaves(engine2.state.params)[0])
+    engine2.load_checkpoint(str(tmp_path))
+    # params came from the checkpoint (not the seed-1 init), master rebuilt
+    assert not np.allclose(np.asarray(jax.tree_util.tree_leaves(engine2.state.params)[0]),
+                           loaded_from_seed1)
+    np.testing.assert_allclose(
+        jax.tree_util.tree_leaves(engine2.host_opt.master)[0],
+        np.asarray(jax.tree_util.tree_leaves(engine2.state.params)[0], dtype=np.float32), rtol=1e-6)
+    losses = train_losses(engine2, steps=8)
+    assert np.isfinite(losses).all()
+    assert min(losses[-3:]) < losses[0]  # recovers and keeps improving
+
+
+def test_offload_with_zero3_sharded_params():
+    cfg = offload_config()
+    cfg["zero_optimization"]["stage"] = 3
+    cfg["zero_optimization"]["stage3_param_persistence_threshold"] = 0
+    baseline = train_losses(make_engine(base_config()))
+    off = train_losses(make_engine(cfg))
+    np.testing.assert_allclose(baseline, off, rtol=2e-4)
+
+
+def test_offload_fp16_overflow_skips_host_step():
+    cfg = offload_config(fp16={"enabled": True, "initial_scale_power": 16})
+    del cfg["optimizer"]["params"]["weight_decay"]
+    engine = make_engine(cfg)
+    master_before = [leaf.copy() for leaf in
+                     __import__("jax").tree_util.tree_leaves(engine.host_opt.master)]
+    bad = random_batch(engine.train_batch_size(), HIDDEN, seed=0)
+    bad["y"] = np.full_like(bad["y"], 1e25)
+    engine.train_batch(batch=bad)
+    assert int(engine.state.skipped_steps) == 1
+    import jax
+    for before, after in zip(master_before, jax.tree_util.tree_leaves(engine.host_opt.master)):
+        np.testing.assert_array_equal(before, after)
+    losses = train_losses(engine, steps=2)
+    assert np.isfinite(losses).all()
+
+
+def test_facade_rejected_under_offload():
+    engine = make_engine(offload_config())
+    with pytest.raises(RuntimeError, match="facade"):
+        engine.forward(random_batch(8, HIDDEN))
