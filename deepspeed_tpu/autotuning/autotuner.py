@@ -1,0 +1,121 @@
+"""Autotuner.
+
+TPU-native analogue of reference ``deepspeed/autotuning/autotuner.py:42``
+(``Autotuner``, ``tune`` :404): search the (ZeRO stage × micro-batch × remat
+policy) space by timing short *real* runs and keep the fastest configuration
+that fits. Design translation: the reference launches whole cluster jobs
+through the launcher and parses their logs; under a single-controller JAX
+runtime each trial is an in-process engine build + a few compiled steps —
+an OOM surfaces as a catchable ``RESOURCE_EXHAUSTED`` from XLA instead of a
+dead worker, so the resource manager/log scraping machinery
+(``autotuning/scheduler.py``) is unnecessary.
+
+Config surface (``autotuning`` section, reference key names):
+``enabled``, ``metric`` ("throughput"), ``tuner_type`` ("gridsearch" |
+"random"), ``max_trials``, plus the TPU search dims ``micro_batch_sizes``,
+``zero_stages``, ``remat_policies``.
+"""
+
+import itertools
+import json
+import random
+import time
+
+from ..utils.logging import log_dist, logger
+
+
+class Autotuner:
+
+    def __init__(self, model_factory, base_config, tuning_config=None, steps_per_trial=5,
+                 warmup_steps=2, make_batch=None):
+        """``model_factory``: () -> model (fresh per trial — engines mutate
+        model config for remat); ``base_config``: engine config dict the
+        candidates overlay; ``make_batch``: (global_batch_size) -> batch dict."""
+        self.model_factory = model_factory
+        self.base_config = dict(base_config)
+        tc = dict(tuning_config if tuning_config is not None
+                  else self.base_config.get("autotuning", {}))
+        self.metric = tc.get("metric", "throughput")
+        self.tuner_type = tc.get("tuner_type", "gridsearch")
+        self.max_trials = int(tc.get("max_trials", 0)) or None
+        self.micro_batch_sizes = list(tc.get("micro_batch_sizes", [])) or [
+            self.base_config.get("train_micro_batch_size_per_gpu", 1)]
+        self.zero_stages = list(tc.get("zero_stages", [0]))
+        self.remat_policies = list(tc.get("remat_policies", [None]))
+        self.steps_per_trial = steps_per_trial
+        self.warmup_steps = warmup_steps
+        self.make_batch = make_batch
+        self.results = []
+
+    def candidates(self):
+        space = list(itertools.product(self.micro_batch_sizes, self.zero_stages,
+                                       self.remat_policies))
+        if self.tuner_type == "random":
+            random.Random(0).shuffle(space)
+        if self.max_trials:
+            space = space[:self.max_trials]
+        return space
+
+    def _trial_config(self, micro_bs, stage, remat):
+        cfg = {k: v for k, v in self.base_config.items()
+               if k not in ("autotuning", "train_batch_size", "gradient_accumulation_steps")}
+        cfg["train_micro_batch_size_per_gpu"] = micro_bs
+        zero = dict(cfg.get("zero_optimization", {}))
+        zero["stage"] = stage
+        cfg["zero_optimization"] = zero
+        if remat is not None:
+            ac = dict(cfg.get("activation_checkpointing", {}))
+            ac["policy"] = remat
+            cfg["activation_checkpointing"] = ac
+        return cfg
+
+    def _run_trial(self, cfg):
+        import numpy as np
+        import deepspeed_tpu
+        from ..comm import comm
+        comm._state["mesh"] = None
+        engine, _, _, _ = deepspeed_tpu.initialize(model=self.model_factory(), config=cfg)
+        batch = self.make_batch(engine.train_batch_size())
+        for _ in range(self.warmup_steps):
+            engine.train_batch(batch=batch)
+        t0 = time.perf_counter()
+        loss = 0.0
+        for _ in range(self.steps_per_trial):
+            loss = engine.train_batch(batch=batch)
+        float(loss)  # fence
+        dt = time.perf_counter() - t0
+        return engine.train_batch_size() * self.steps_per_trial / dt
+
+    def tune(self):
+        """Run all trials; returns (best_config, best_metric). OOM/compile
+        failures score None and are skipped (reference marks them
+        'untunable')."""
+        best = None
+        for micro_bs, stage, remat in self.candidates():
+            cfg = self._trial_config(micro_bs, stage, remat)
+            label = f"micro_bs={micro_bs} zero={stage} remat={remat}"
+            try:
+                samples_per_sec = self._run_trial(cfg)
+            except Exception as e:  # RESOURCE_EXHAUSTED, bad combos, ...
+                logger.warning(f"autotuner: trial {label} failed: {type(e).__name__}: {e}")
+                self.results.append({"config": label, "samples_per_sec": None})
+                continue
+            self.results.append({"config": label, "samples_per_sec": round(samples_per_sec, 2)})
+            log_dist(f"autotuner: {label} -> {samples_per_sec:.1f} samples/s", [0])
+            if best is None or samples_per_sec > best[1]:
+                best = (cfg, samples_per_sec)
+        if best is None:
+            raise RuntimeError("autotuner: every trial failed")
+        log_dist(f"autotuner: best = {json.dumps(self.results, default=str)}", [0])
+        return best
+
+    def write_results(self, path):
+        with open(path, "w") as f:
+            json.dump(self.results, f, indent=2)
+
+
+def autotune(model_factory, base_config, make_batch, **kw):
+    """One-call façade: returns the fastest engine config."""
+    tuner = Autotuner(model_factory, base_config, make_batch=make_batch, **kw)
+    best_cfg, _ = tuner.tune()
+    return best_cfg

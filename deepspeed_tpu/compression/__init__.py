@@ -1,0 +1,2 @@
+from .compress import init_compression, redundancy_clean  # noqa: F401
+from .helper import fake_quantize, magnitude_mask  # noqa: F401

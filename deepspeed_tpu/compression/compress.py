@@ -1,0 +1,166 @@
+"""Compression entry points.
+
+TPU-native analogue of reference ``deepspeed/compression/compress.py``
+(``init_compression`` :95, ``redundancy_clean`` :123) with the same
+``compression_training`` config section. Design translation: the reference
+rewrites ``nn.Linear`` modules into ``LinearLayer_Compress`` subclasses
+carrying quantizers and mask buffers; here models are pure functions over a
+parameter pytree, so compression is a *parameter transform* applied inside
+the loss (QAT fake-quant with straight-through gradients, magnitude masks
+for pruning) and ``redundancy_clean`` bakes the same transform into the
+stored parameters permanently.
+
+Supported groups (same JSON keys): ``weight_quantization``
+(target_bits/quantize_groups/quantization_type per different_group),
+``sparse_pruning``, ``row_pruning`` (structured along the output dim),
+``head_pruning`` (structured along the heads dim of attention projections).
+``schedule_offset`` activates each transform only after that global step —
+the wrapped model re-jits once when a transform flips on.
+"""
+
+import re
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger, log_dist
+from .helper import fake_quantize, magnitude_mask
+
+
+def _section(cfg_dict):
+    sec = dict(cfg_dict.get("compression_training", cfg_dict))
+    return sec
+
+
+def _iter_groups(group_cfg):
+    """Yield (params_cfg, modules_regex_list) per different_group."""
+    for name, g in dict(group_cfg.get("different_groups", {})).items():
+        yield dict(g.get("params", {})), list(g.get("modules", ["*"])), name
+
+
+def _normalize_path(keystr_path):
+    """jax keystr "['layers']['attn']['k_proj']" -> "layers/attn/k_proj"."""
+    return re.sub(r"\['([^']*)'\]", r"\1/", keystr_path).rstrip("/")
+
+
+def _path_matches(path, patterns):
+    for pat in patterns:
+        if pat == "*" or re.search(pat, path):
+            return True
+    return False
+
+
+class _Transform:
+    """One compression action bound to matching parameter paths."""
+
+    def __init__(self, kind, patterns, params, schedule_offset=0):
+        self.kind = kind
+        self.patterns = patterns
+        self.params = params
+        self.schedule_offset = schedule_offset
+
+    def applies(self, path):
+        return _path_matches(path, self.patterns)
+
+    def apply(self, path, w):
+        if self.kind == "weight_quantization":
+            bits = int(self.params.get("target_bits", 8))
+            groups = int(self.params.get("quantize_groups", 1))
+            sym = self.params.get("quantization_type", "symmetric") == "symmetric"
+            return fake_quantize(w, bits=bits, groups=groups, symmetric=sym)
+        ratio = float(self.params.get("dense_ratio", 0.5))
+        if self.kind == "sparse_pruning":
+            mask = magnitude_mask(w, ratio)
+        elif self.kind == "row_pruning":
+            mask = magnitude_mask(w, ratio, dim=w.ndim - 1)  # output dim
+        elif self.kind == "head_pruning":
+            # bhtd attention projections: kernel (H, heads, hd) — prune the
+            # heads dim; fall back to dim 0 for 2-D params
+            mask = magnitude_mask(w, ratio, dim=1 if w.ndim >= 3 else 0)
+        else:
+            raise ValueError(f"unknown compression kind {self.kind}")
+        return w * mask.astype(w.dtype)
+
+
+def _build_transforms(sec):
+    transforms = []
+    for kind in ("weight_quantization", "sparse_pruning", "row_pruning", "head_pruning"):
+        group = dict(sec.get(kind, {}))
+        shared = dict(group.get("shared_parameters", {}))
+        if not shared.get("enabled", False):
+            continue
+        offset = int(shared.get("schedule_offset", 0))
+        for params, modules, name in _iter_groups(group):
+            transforms.append(_Transform(kind, modules, params, offset))
+            log_dist(f"compression: {kind}/{name} on {modules} "
+                     f"(offset {offset}): {params}", [0])
+    return transforms
+
+
+class CompressedModel:
+    """Wraps a deepspeed_tpu model; applies active transforms to matching
+    params inside loss/apply. Exposes the same engine-facing contract."""
+
+    def __init__(self, inner, transforms):
+        self.inner = inner
+        self.transforms = transforms
+        self.global_step = 0  # advanced by the engine-side scheduler
+
+    def __getattr__(self, name):  # delegate cfg, tp_rules, init_params, ...
+        return getattr(self.inner, name)
+
+    def _active(self):
+        return [t for t in self.transforms if self.global_step >= t.schedule_offset]
+
+    def compress_params(self, params):
+        active = self._active()
+        if not active:
+            return params
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        out = []
+        for path, w in flat:
+            path_str = _normalize_path(jax.tree_util.keystr(path))
+            for t in active:
+                if getattr(w, "ndim", 0) >= 2 and t.applies(path_str):
+                    w = t.apply(path_str, w)
+            out.append(w)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def loss(self, params, batch, rng):
+        return self.inner.loss(self.compress_params(params), batch, rng)
+
+    def apply(self, params, *a, **kw):
+        return self.inner.apply(self.compress_params(params), *a, **kw)
+
+
+def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
+    """Wrap ``model`` with the compression transforms from the
+    ``compression_training`` section (reference :95). ``teacher_model``
+    (layer-reduction distillation) is not supported and must be None."""
+    if teacher_model is not None:
+        raise NotImplementedError("layer_reduction/distillation is not supported yet")
+    if hasattr(deepspeed_config, "raw_config"):
+        deepspeed_config = deepspeed_config.raw_config
+    transforms = _build_transforms(_section(dict(deepspeed_config)))
+    if not transforms:
+        logger.warning("init_compression: no enabled compression groups found; "
+                       "returning the model unchanged")
+        return model
+    return CompressedModel(model, transforms)
+
+
+def redundancy_clean(model_or_params, deepspeed_config=None):
+    """Bake the compression permanently into parameters (reference :123):
+    pruning masks zero the weights for real, fake-quant becomes a real
+    quantize-dequantize. Accepts a ``CompressedModel`` + live params, or a
+    params pytree with ``deepspeed_config``. Returns cleaned params."""
+    if isinstance(model_or_params, CompressedModel):
+        raise TypeError("pass (params, deepspeed_config) or use "
+                        "model.compress_params(params) for a wrapped model")
+    params = model_or_params
+    transforms = _build_transforms(_section(dict(
+        deepspeed_config.raw_config if hasattr(deepspeed_config, "raw_config")
+        else deepspeed_config)))
+    shim = CompressedModel(None, transforms)
+    shim.global_step = np.inf  # everything active at clean time
+    return shim.compress_params(params)

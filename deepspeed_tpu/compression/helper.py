@@ -1,0 +1,59 @@
+"""Compression primitives: fake quantization + magnitude masks.
+
+TPU-native analogue of the reference's compression math
+(``deepspeed/compression/basic_layer.py`` LinearLayer_Compress and the
+quantizers in ``deepspeed/compression/utils.py``). These are pure jnp
+functions — the reference's module-surgery (replacing ``nn.Linear``
+subclasses) becomes parameter transforms applied inside the loss/forward.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def fake_quantize(w, bits=8, groups=1, symmetric=True):
+    """Quantize-dequantize ``w`` to ``bits`` with per-group scaling and a
+    straight-through gradient (QAT). Group dim is the flattened tail."""
+    orig_shape = w.shape
+    flat = w.reshape(groups, -1).astype(jnp.float32)
+    qmax = 2.0**(bits - 1) - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.round(flat / scale)
+        q = jnp.clip(q, -qmax - 1, qmax)
+        deq = q * scale
+    else:
+        lo = jnp.min(flat, axis=1, keepdims=True)
+        hi = jnp.max(flat, axis=1, keepdims=True)
+        levels = 2.0**bits - 1
+        scale = jnp.where(hi > lo, (hi - lo) / levels, 1.0)
+        q = jnp.round((flat - lo) / scale)
+        q = jnp.clip(q, 0, levels)
+        deq = q * scale + lo
+    deq = deq.reshape(orig_shape).astype(w.dtype)
+    # straight-through estimator: forward sees deq, backward sees identity
+    return w + jax.lax.stop_gradient(deq - w)
+
+
+def magnitude_mask(w, dense_ratio, dim=None):
+    """Keep-mask retaining the largest-|w| fraction ``dense_ratio``
+    (traceable: recomputed from the live weights inside the compiled step, so
+    the sparsity pattern tracks training like the reference's periodically
+    refreshed masks).
+
+    ``dim=None``: unstructured (per-element, reference sparse_pruning l1
+    method). ``dim=k``: structured — whole slices along dim ``k`` are kept or
+    dropped by their L1 norm (row/head pruning)."""
+    aw = jnp.abs(w.astype(jnp.float32))
+    if dim is None:
+        k = max(1, int(round(w.size * dense_ratio)))
+        threshold = jax.lax.top_k(aw.reshape(-1), k)[0][-1]
+        return aw >= threshold
+    scores = aw.sum(axis=tuple(i for i in range(w.ndim) if i != dim))
+    k = max(1, int(round(scores.size * dense_ratio)))
+    threshold = jax.lax.top_k(scores, k)[0][-1]
+    keep = scores >= threshold
+    shape = [1] * w.ndim
+    shape[dim] = w.shape[dim]
+    return jnp.broadcast_to(keep.reshape(shape), w.shape)

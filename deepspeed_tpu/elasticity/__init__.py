@@ -1,0 +1,2 @@
+from .elasticity import (compute_elastic_config, elasticity_enabled,  # noqa: F401
+                         ElasticityError, ElasticityConfigError, ElasticityIncompatibleWorldSize)

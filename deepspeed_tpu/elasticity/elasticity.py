@@ -1,0 +1,146 @@
+"""Batch-size elasticity.
+
+TPU-native analogue of reference ``deepspeed/elasticity/elasticity.py``
+(``compute_elastic_config`` :233, ``_get_compatible_gpus_v01/02`` :83/:126):
+pre-compute one effective batch size that stays FIXED while the chip count
+varies across preemptions/resizes, plus the set of chip counts it is
+compatible with. The elastic unit on TPU is a slice resize (multiples of a
+host's chips) rather than individual GPUs; ``model_parallel_size`` maps to
+the ``tensor×pipe×seq`` product that divides the world before data
+parallelism.
+
+Heuristic (same public scheme as the reference): take each allowed
+micro-batch (and their LCM) as a base, scale each base to the largest
+multiple under ``max_acceptable_batch_size`` whose multiplier is a highly
+composite number (maximizing divisor count ⇒ maximizing compatible world
+sizes), then keep the candidate compatible with the most chip counts.
+"""
+
+import functools
+
+from ..utils.logging import logger
+
+# highly composite numbers (record-setting divisor counts); enough to cover
+# batch multipliers into the hundreds of thousands
+_HIGHLY_COMPOSITE = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680, 2520, 5040,
+    7560, 10080, 15120, 20160, 25200, 27720, 45360, 50400, 55440, 83160, 110880, 166320,
+    221760, 277200, 332640, 498960, 554400, 665280, 720720,
+]
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def _largest_hcn_multiple(base, ceiling):
+    """base * h <= ceiling with h the largest usable highly-composite number."""
+    if base >= ceiling:
+        return base
+    best = base
+    for h in _HIGHLY_COMPOSITE:
+        if base * h > ceiling:
+            break
+        best = base * h
+    return best
+
+
+def _divisors(n):
+    out = set()
+    d = 1
+    while d * d <= n:
+        if n % d == 0:
+            out.add(d)
+            out.add(n // d)
+        d += 1
+    return out
+
+
+def _compatible_world_sizes(batch_size, micro_batches, lo, hi):
+    """Chip counts w in [lo, hi] such that some micro-batch evenly tiles:
+    batch_size == micro * grad_acc * w for integer grad_acc."""
+    sizes = set()
+    for micro in micro_batches:
+        if batch_size % micro:
+            continue
+        per_step = batch_size // micro  # = grad_acc * world
+        sizes |= {w for w in _divisors(per_step) if lo <= w <= hi}
+    return sorted(sizes)
+
+
+def _pick_batch_size(micro_batches, max_batch, lo, hi, prefer_larger=True):
+    import math
+    bases = sorted(set(micro_batches) | {functools.reduce(math.lcm, micro_batches)})
+    candidates = sorted({_largest_hcn_multiple(b, max_batch) for b in bases})
+    best = None  # (n_compatible, signed batch, batch, worlds)
+    for cand in candidates:
+        worlds = _compatible_world_sizes(cand, micro_batches, lo, hi)
+        rank = (len(worlds), cand if prefer_larger else -cand)
+        if best is None or rank > best[0]:
+            best = (rank, cand, worlds)
+    return best[1], best[2]
+
+
+def elasticity_enabled(ds_config):
+    return bool(dict(ds_config.get("elasticity", {})).get("enabled", False))
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version=None, world_size=0, return_microbatch=False):
+    """Resolve the elastic batch configuration (reference :233).
+
+    Returns ``(final_batch_size, valid_world_sizes[, micro_batch])``; when
+    ``world_size`` > 0 also validates it and resolves the micro-batch for
+    that world size (raising ``ElasticityIncompatibleWorldSize`` otherwise).
+    """
+    sec = dict(ds_config.get("elasticity", {}))
+    if not sec.get("enabled", False):
+        raise ElasticityConfigError("elasticity section missing or not enabled")
+    micro_batches = sorted(set(int(m) for m in sec.get("micro_batch_sizes", [])), reverse=True)
+    max_batch = int(sec.get("max_train_batch_size", 0))
+    if not micro_batches or max_batch <= 0:
+        raise ElasticityConfigError("elasticity requires micro_batch_sizes and max_train_batch_size")
+    if any(m <= 0 for m in micro_batches):
+        raise ElasticityConfigError(f"micro_batch_sizes must be positive: {micro_batches}")
+    if max_batch < max(micro_batches):
+        raise ElasticityConfigError(
+            f"max_train_batch_size {max_batch} below largest micro batch {max(micro_batches)}")
+    lo = int(sec.get("min_gpus", 1))
+    hi = int(sec.get("max_gpus", max_batch // min(micro_batches)))
+    prefer_larger = bool(sec.get("prefer_larger_batch", True))
+    mp = int(sec.get("model_parallel_size", 1))
+
+    version = float(sec.get("version", 0.1))
+    if version >= 0.2 and mp > 1:
+        # data-parallel replicas are world/mp; express constraints in replicas
+        lo = max(1, lo // mp)
+        hi = max(lo, hi // mp)
+
+    final_batch, worlds = _pick_batch_size(micro_batches, max_batch, lo, hi, prefer_larger)
+    if version >= 0.2 and mp > 1:
+        worlds = [w * mp for w in worlds]
+    logger.info(f"elasticity: final_batch_size={final_batch} valid_world_sizes={worlds}")
+
+    if world_size > 0:
+        if world_size not in worlds:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} is not in the compatible set {worlds} for "
+                f"batch size {final_batch}")
+        dp = world_size // mp if (version >= 0.2 and mp > 1) else world_size
+        micro = next((m for m in micro_batches if final_batch % (m * dp) == 0), None)
+        if micro is None:
+            raise ElasticityIncompatibleWorldSize(
+                f"no configured micro batch tiles batch {final_batch} over {dp} replicas")
+        if return_microbatch:
+            return final_batch, worlds, micro
+        return final_batch, worlds
+    if return_microbatch:
+        return final_batch, worlds, None
+    return final_batch, worlds

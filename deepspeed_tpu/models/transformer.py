@@ -499,12 +499,18 @@ class CausalLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
-                 cache_index=None, position_ids=None, return_hidden=False):
+                 cache_index=None, position_ids=None, return_hidden=False,
+                 pld_theta=None, pld_rng=None):
         """``kv_cache``: optional per-layer (k, v) with leading layer dim —
         shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
         stack. Returns logits, or (logits, new_kv_cache) when caching, or the
         final-norm hidden states when ``return_hidden`` (the loss path fuses
-        the vocab projection into a chunked cross-entropy instead)."""
+        the vocab projection into a chunked cross-entropy instead).
+
+        ``pld_theta``/``pld_rng``: progressive layer drop (reference
+        ``runtime/progressive_layer_drop.py``) — stochastic depth where layer
+        ``i`` of ``L`` is kept with probability ``1 - (i/L)(1 - theta)``
+        (deeper layers dropped more, per the PLD paper)."""
         cfg = self.cfg
         B, T = input_ids.shape
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
@@ -527,22 +533,35 @@ class CausalLM(nn.Module):
             block = nn.remat(Block, policy=resolve_remat_policy(cfg.remat_policy),
                              prevent_cse=not cfg.scan_layers,
                              static_argnums=())
+        def apply_pld(y, x_in, layer_idx):
+            if pld_theta is None or pld_rng is None:
+                return y
+            keep_p = 1.0 - (layer_idx / cfg.num_layers) * (1.0 - pld_theta)
+            keep = jax.random.bernoulli(jax.random.fold_in(pld_rng, layer_idx), keep_p)
+            return jnp.where(keep, y, x_in)
+
         new_cache = None
         if cfg.scan_layers:
+            def scan_body(mdl, carry, xs):
+                layer_cache, layer_idx = xs
+                y, c = mdl(carry, sin, cos, attn_mask, deterministic,
+                           layer_cache, cache_index, position_ids)
+                return apply_pld(y, carry, layer_idx), c
+
             x, new_cache = nn.scan(
-                lambda mdl, carry, layer_cache: mdl(carry, sin, cos, attn_mask, deterministic,
-                                                    layer_cache, cache_index, position_ids),
+                scan_body,
                 variable_axes={"params": 0, "intermediates": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={"partition_name": "layers"},
-            )(block(cfg, name="layers"), x, kv_cache)
+            )(block(cfg, name="layers"), x, (kv_cache, jnp.arange(cfg.num_layers)))
         else:
             caches = []
             for i in range(cfg.num_layers):
                 layer_cache = None if kv_cache is None else jax.tree_util.tree_map(lambda c: c[i], kv_cache)
-                x, c = block(cfg, name=f"layer_{i}")(x, sin, cos, attn_mask, deterministic,
+                y, c = block(cfg, name=f"layer_{i}")(x, sin, cos, attn_mask, deterministic,
                                                      layer_cache, cache_index, position_ids)
+                x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
                 new_cache = jax.tree_util.tree_map(lambda *cs: jnp.stack(cs), *caches)
@@ -563,6 +582,8 @@ class CausalLM(nn.Module):
 
 class CausalLMModel:
     """Engine-facing wrapper: init_params / loss / tp_rules / expert_pattern."""
+
+    supports_pld = True  # consumes the engine's progressive-layer-drop theta
 
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
@@ -640,6 +661,9 @@ class CausalLMModel:
         attn_mask = batch.get("attention_mask")
         kw = self._apply_kwargs(rng)
         det = kw.pop("deterministic")
+        pld_theta = batch.get("__pld_theta__")  # progressive layer drop schedule value
+        if pld_theta is not None and rng is not None:
+            kw.update(pld_theta=pld_theta, pld_rng=jax.random.fold_in(rng, 0x1D))
         chunked = self._use_chunked_ce()
         out = self.module.apply({"params": params}, input_ids, attn_mask, det,
                                 return_hidden=chunked,

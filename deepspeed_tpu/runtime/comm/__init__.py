@@ -1,0 +1,1 @@
+from .compressed import onebit_all_reduce, quantized_all_reduce  # noqa: F401

@@ -204,6 +204,17 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
         if self.gradient_checkpointing is not None:
             if self.gradient_checkpointing and self.activation_checkpointing.policy is None:
                 self.activation_checkpointing.policy = "nothing_saveable"
+        if dict(config_dict.get("elasticity", {})).get("enabled"):
+            # elastic batch resolution (reference engine.py:462 guard +
+            # elasticity.py:233): the pre-computed elastic batch overrides any
+            # explicit batch keys so resizes keep the effective batch fixed
+            from ..elasticity import compute_elastic_config
+            final_batch, _, micro = compute_elastic_config(
+                config_dict, world_size=self.world_size, return_microbatch=True)
+            self.train_batch_size = final_batch
+            if micro is not None:
+                self.train_micro_batch_size_per_gpu = micro
+                self.gradient_accumulation_steps = None
         self._resolve_data_parallel_size()
         self._configure_train_batch_size()
         self._do_sanity_check()
@@ -213,9 +224,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     # trap for users porting configs, so their presence warns loudly. Remove
     # entries as the corresponding subsystem lands.
     INERT_SECTIONS = frozenset({
-        "amp", "sparse_attention", "progressive_layer_drop", "data_efficiency",
-        "curriculum_learning", "compression_training", "autotuning", "elasticity",
-        "aio", "pipeline", "sparse_gradients", "communication_data_type",
+        "amp", "sparse_attention", "data_efficiency", "aio", "pipeline", "sparse_gradients", "communication_data_type",
         "fp32_allreduce", "disable_allgather", "memory_breakdown", "dump_state",
         "data_types", "zero_force_ds_cpu_optimizer", "nebula",
     })

@@ -1,0 +1,2 @@
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_sampler import DeepSpeedDataSampler  # noqa: F401

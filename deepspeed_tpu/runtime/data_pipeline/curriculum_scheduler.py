@@ -1,0 +1,72 @@
+"""Curriculum difficulty scheduler.
+
+Analogue of reference ``runtime/data_pipeline/curriculum_scheduler.py:11``
+(``CurriculumScheduler``): maps the global step to a difficulty level
+(typically a sequence length). Supported ``schedule_type``s, same config keys
+as the reference:
+
+- ``fixed_linear``: min -> max linearly over ``total_curriculum_step``,
+  rounded down to a multiple of ``difficulty_step``.
+- ``fixed_root``: min + (max-min) * (t/T)^(1/root_degree), same rounding.
+- ``fixed_discrete``: step function over ``difficulty`` / ``max_step`` lists.
+- ``custom``: a user callable ``step -> difficulty`` set via
+  ``set_custom_get_difficulty``.
+"""
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config):
+        config = dict(config or {})
+        self.schedule_type = config.get("schedule_type", "fixed_linear")
+        self.min_difficulty = int(config.get("min_difficulty", 1))
+        self.max_difficulty = int(config.get("max_difficulty", self.min_difficulty))
+        sched = dict(config.get("schedule_config", {}))
+        self._custom_fn = None
+        if self.schedule_type in ("fixed_linear", "fixed_root"):
+            if "total_curriculum_step" not in sched:
+                raise ValueError(f"{self.schedule_type} schedule requires "
+                                 "schedule_config.total_curriculum_step")
+            self.total_step = int(sched["total_curriculum_step"])
+            self.difficulty_step = int(sched.get("difficulty_step", 1))
+            self.root_degree = int(sched.get("root_degree", 1 if self.schedule_type == "fixed_linear" else 2))
+        elif self.schedule_type == "fixed_discrete":
+            if "difficulty" not in sched or "max_step" not in sched:
+                raise ValueError("fixed_discrete schedule requires schedule_config.difficulty "
+                                 "and schedule_config.max_step lists")
+            self.levels = [int(d) for d in sched["difficulty"]]
+            self.boundaries = [int(s) for s in sched["max_step"]]
+            if len(self.boundaries) != len(self.levels) - 1:
+                raise ValueError("fixed_discrete: len(max_step) must be len(difficulty) - 1")
+        elif self.schedule_type == "custom":
+            pass
+        else:
+            raise ValueError(f"unknown curriculum schedule_type {self.schedule_type!r}")
+        self.current_difficulty = self.get_difficulty(0)
+
+    def set_custom_get_difficulty(self, fn):
+        self._custom_fn = fn
+        return self
+
+    def get_difficulty(self, global_steps):
+        if self.schedule_type == "custom":
+            if self._custom_fn is None:
+                raise ValueError("custom schedule: call set_custom_get_difficulty first")
+            return self._custom_fn(global_steps)
+        if self.schedule_type == "fixed_discrete":
+            level = self.levels[-1]
+            for d, bound in zip(self.levels, self.boundaries):
+                if global_steps < bound:
+                    level = d
+                    break
+            return min(level, self.max_difficulty)
+        frac = min(1.0, max(0.0, global_steps / max(self.total_step, 1)))
+        if self.schedule_type == "fixed_root":
+            frac = frac**(1.0 / self.root_degree)
+        raw = self.min_difficulty + (self.max_difficulty - self.min_difficulty) * frac
+        stepped = int(raw) // self.difficulty_step * self.difficulty_step
+        return max(self.min_difficulty, min(self.max_difficulty, stepped))
+
+    def update_difficulty(self, global_steps):
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty

@@ -1,0 +1,66 @@
+"""Curriculum-aware data sampler.
+
+Analogue of reference ``runtime/data_pipeline/data_sampler.py:36``
+(``DeepSpeedDataSampler``): draws sample indices whose difficulty is within
+the current curriculum threshold. The reference reads difficulties from an
+offline data-analyzer index; here they are supplied directly (a sequence
+aligned with the dataset) or computed by a callable per sample — the
+analyzer's mmap machinery collapses to a numpy argsort on TPU hosts.
+
+Usable as ``DeepSpeedDataLoader(..., data_sampler=...)`` — iterating yields
+an epoch's worth of indices filtered/clipped by difficulty; call
+``set_custom_map`` / ``state_dict`` / ``load_state_dict`` for parity.
+"""
+
+import numpy as np
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self, difficulties, curriculum_scheduler=None, total_samples=None, seed=0,
+                 shuffle=True, drop_last=True):
+        self.difficulties = np.asarray(difficulties)
+        self.total_samples = total_samples or len(self.difficulties)
+        self.scheduler = curriculum_scheduler
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.global_steps = 0
+        # ascending difficulty order; the active prefix grows with the schedule
+        self._order_by_difficulty = np.argsort(self.difficulties, kind="stable")
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def advance(self, global_steps):
+        self.global_steps = global_steps
+        if self.scheduler is not None:
+            self.scheduler.update_difficulty(global_steps)
+
+    def _active_indices(self):
+        if self.scheduler is None:
+            return np.arange(self.total_samples)
+        limit = self.scheduler.current_difficulty
+        sorted_diff = self.difficulties[self._order_by_difficulty]
+        n_active = int(np.searchsorted(sorted_diff, limit, side="right"))
+        n_active = max(n_active, 1)  # never an empty pool
+        return self._order_by_difficulty[:n_active]
+
+    def __iter__(self):
+        active = self._active_indices()
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            active = rng.permutation(active)
+        return iter(active.tolist())
+
+    def __len__(self):
+        return len(self._active_indices())
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "global_steps": self.global_steps,
+                "current_difficulty": None if self.scheduler is None
+                else self.scheduler.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.epoch = sd.get("epoch", 0)
+        self.advance(sd.get("global_steps", 0))

@@ -189,6 +189,22 @@ class DeepSpeedEngine:
         self.state = self._init_state(params)
         del params
 
+        # ---- curriculum learning + progressive layer drop ----------------
+        # (legacy `curriculum_learning` section, reference engine.py:1663
+        # seqlen truncation; `progressive_layer_drop`, engine.py:1658)
+        cl_cfg = dict(self._config.raw_config.get("curriculum_learning", {}))
+        self.curriculum_scheduler = None
+        if cl_cfg.get("enabled"):
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
+            self.curriculum_type = cl_cfg.get("curriculum_type", "seqlen")
+        pld_cfg = dict(self._config.raw_config.get("progressive_layer_drop", {}))
+        self.progressive_layer_drop = None
+        if pld_cfg.get("enabled"):
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001))
+
         # ---- timers / monitor / io ---------------------------------------
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
         self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
@@ -689,9 +705,12 @@ class DeepSpeedEngine:
             entries = [None] * x.ndim
             if x.ndim > batch_dim and dp:
                 dp_size = int(np.prod([self.mesh.shape[a] for a in dp]))
-                if x.shape[batch_dim] % dp_size != 0:
+                # each process holds 1/process_count of the global batch dim
+                global_dim = x.shape[batch_dim] * jax.process_count()
+                if global_dim % dp_size != 0:
                     raise ValueError(
-                        f"batch dim {x.shape[batch_dim]} not divisible by the data-parallel "
+                        f"global batch dim {global_dim} (local {x.shape[batch_dim]} x "
+                        f"{jax.process_count()} processes) not divisible by the data-parallel "
                         f"degree {dp_size} (mesh axes {dp}); pad or resize the batch — "
                         f"silent replication would drop data parallelism")
                 entries[batch_dim] = tuple(dp) if len(dp) > 1 else dp[0]
@@ -720,26 +739,64 @@ class DeepSpeedEngine:
 
         Pass either ``data_iter`` (pulls ``gradient_accumulation_steps``
         microbatches, PipelineEngine-style reference pipe/engine.py:285) or a
-        ``batch`` whose leaves already carry the total train batch.
+        ``batch`` whose leaves carry this process's share of the train batch
+        (``train_batch_size / process_count``; with a single controller that
+        is the whole batch).
         """
         gas = self.gradient_accumulation_steps()
         if batch is not None:
+            # each feeding process supplies its share of the global batch
+            # (single-controller: one process feeds everything)
+            if self.train_batch_size() % jax.process_count() != 0:
+                raise ValueError(f"train_batch_size {self.train_batch_size()} not divisible by "
+                                 f"process count {jax.process_count()}")
+            expected = self.train_batch_size() // jax.process_count()
+            if expected % gas != 0:
+                raise ValueError(f"per-process batch share {expected} not divisible by "
+                                 f"gradient_accumulation_steps {gas}")
             leading = {np.shape(x)[0] for x in jax.tree_util.tree_leaves(batch)}
-            if leading != {self.train_batch_size()}:
+            if leading != {expected}:
                 raise ValueError(
-                    f"train_batch(batch=...) leaves have leading dim {sorted(leading)}; expected the "
-                    f"full train batch of {self.train_batch_size()} samples "
-                    f"(= micro {self.train_micro_batch_size_per_gpu()} x gas {gas} x "
-                    f"dp {self.dp_world_size()})")
+                    f"train_batch(batch=...) leaves have leading dim {sorted(leading)}; expected "
+                    f"this process's share of {expected} samples (train_batch "
+                    f"{self.train_batch_size()} = micro {self.train_micro_batch_size_per_gpu()} x "
+                    f"gas {gas} x dp {self.dp_world_size()}, over {jax.process_count()} processes)")
             stacked = jax.tree_util.tree_map(
                 lambda x: np.asarray(x).reshape((gas, -1) + np.shape(x)[1:]), batch)
         else:
             it = data_iter if data_iter is not None else iter(self.training_dataloader)
             micro = self._next_microbatches(it, gas)
             stacked = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *micro)
+        if self.curriculum_scheduler is not None and self.curriculum_type == "seqlen":
+            seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+            # truncate only the known sequence-bearing keys (reference
+            # engine.py:1663 curriculum_seqlen); other leaves pass untouched
+            stacked = {k: (v[:, :, :seqlen] if k in ("input_ids", "labels", "attention_mask")
+                           and np.ndim(v) >= 3 else v)
+                       for k, v in stacked.items()}
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+            if getattr(self.module, "supports_pld", False):
+                stacked = dict(stacked)
+                # one theta per microbatch: every batch leaf must carry the
+                # gas leading dim the fused step scans over
+                stacked["__pld_theta__"] = np.full((gas, ), self.progressive_layer_drop.get_theta(),
+                                                   np.float32)
+            else:
+                from ..utils.logging import warning_once
+                warning_once("progressive_layer_drop enabled but the model does not consume it "
+                             "(no supports_pld attribute; deepspeed_tpu.models transformers do) "
+                             "— schedule advances with NO effect")
         stacked = self._shard_batch(stacked, leading_scan_dim=True)
 
         self.tput_timer.start()
+        # compression scheduler (reference engine.py:1268): advance the step
+        # and re-trace the compiled step once when a transform activates
+        if hasattr(self.module, "transforms") and hasattr(self.module, "_active"):
+            n_before = len(self.module._active())
+            self.module.global_step = self.global_steps
+            if len(self.module._active()) != n_before:
+                self._compiled.clear()
         if self.offload_optimizer:
             metrics = self._offload_train_batch(stacked)
         else:

@@ -1,0 +1,30 @@
+"""Progressive layer drop (PLD).
+
+Analogue of reference ``deepspeed/runtime/progressive_layer_drop.py``: the
+keep-probability schedule theta(t) = (1 - theta_bar) * gamma^t ... in the
+reference's form ``theta(t) = theta_bar + (1 - theta_bar) * exp(-gamma t)``
+applied as stochastic depth across transformer blocks. The engine advances
+the schedule each global step and models consume ``pld_theta`` as the
+per-layer keep probability (``CausalLM`` applies it inside the layer scan
+with a per-(step, layer) folded rng).
+"""
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = float(theta)  # asymptotic keep probability
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+        from ..utils.logging import log_dist
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})", [0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        import math
+        self.current_theta = (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta

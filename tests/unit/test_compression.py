@@ -1,0 +1,81 @@
+"""Compression tests (reference tests/unit/compression pattern: transformed
+layers change weights the intended way and training still converges)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.compression import fake_quantize, init_compression, magnitude_mask, redundancy_clean
+from deepspeed_tpu.models import get_model
+
+
+def test_fake_quantize_levels_and_ste():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(16, 64)).astype(np.float32))
+    q = fake_quantize(w, bits=4, groups=4)
+    # 4-bit symmetric: at most 16 distinct levels per group
+    for g in np.asarray(q).reshape(4, -1):
+        assert len(np.unique(g)) <= 16
+    # straight-through: gradient of sum(q) w.r.t. w is all-ones
+    g = jax.grad(lambda w: jnp.sum(fake_quantize(w, bits=4, groups=4)))(w)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
+
+def test_magnitude_mask_ratios():
+    w = jnp.asarray(np.random.default_rng(0).normal(size=(32, 64)).astype(np.float32))
+    m = magnitude_mask(w, 0.25)
+    assert abs(float(jnp.mean(m.astype(jnp.float32))) - 0.25) < 0.01
+    mr = magnitude_mask(w, 0.5, dim=1)
+    kept_cols = np.asarray(mr)[0]
+    assert kept_cols.sum() == 32  # half of 64 columns, whole columns
+
+
+COMPRESSION_CFG = {
+    "compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "wq1": {"params": {"target_bits": 8, "quantize_groups": 1},
+                        "modules": ["mlp"]}},
+        },
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2, "method": "l1"},
+            "different_groups": {
+                "sp1": {"params": {"dense_ratio": 0.5}, "modules": ["attn/.*proj"]}},
+        },
+    }
+}
+
+
+def test_init_compression_trains():
+    comm._state["mesh"] = None
+    model = init_compression(get_model("tiny", dtype=jnp.float32), COMPRESSION_CFG)
+    cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    # schedule_offset=2 pruning activated mid-run
+    assert len(model._active()) == 2
+
+
+def test_redundancy_clean_bakes_transforms():
+    model = get_model("tiny", dtype=jnp.float32)
+    params = model.init_params(jax.random.key(0))
+    cleaned = redundancy_clean(params, COMPRESSION_CFG)
+    flat = jax.tree_util.tree_flatten_with_path(cleaned)[0]
+    for path, w in flat:
+        p = jax.tree_util.keystr(path)
+        if "attn" in p and "proj" in p and np.ndim(w) >= 2:
+            zeros = float(np.mean(np.asarray(w) == 0))
+            assert zeros >= 0.45, (p, zeros)  # ~50% pruned
+
+
+def test_init_compression_noop_without_groups():
+    model = get_model("tiny", dtype=jnp.float32)
+    assert init_compression(model, {"compression_training": {}}) is model

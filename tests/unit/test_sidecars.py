@@ -1,0 +1,171 @@
+"""Sidecar subsystems: elasticity, curriculum learning, progressive layer
+drop, eigenvalue (reference tests/unit/{elasticity,...} patterns)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models import get_model
+
+
+# ---------------------------------------------------------------- elasticity
+def elastic_dict(**over):
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 2000,
+                          "micro_batch_sizes": [2, 4, 6], "min_gpus": 1, "max_gpus": 10000,
+                          "version": 0.1}}
+    cfg["elasticity"].update(over)
+    return cfg
+
+
+def test_elastic_config_properties():
+    from deepspeed_tpu.elasticity import compute_elastic_config
+    fb, worlds = compute_elastic_config(elastic_dict())
+    assert fb <= 2000
+    # the chosen batch must tile for every listed world size with some micro batch
+    for w in worlds:
+        assert any(fb % (m * w) == 0 for m in (2, 4, 6)), (fb, w)
+    # highly-composite scaling should make the batch highly divisible
+    assert len(worlds) > 20
+
+
+def test_elastic_world_size_validation():
+    from deepspeed_tpu.elasticity import (compute_elastic_config,
+                                          ElasticityIncompatibleWorldSize, ElasticityConfigError)
+    fb, worlds, micro = compute_elastic_config(elastic_dict(), world_size=4, return_microbatch=True)
+    assert 4 in worlds and fb % (micro * 4) == 0
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(elastic_dict(), world_size=worlds[-1] + 7919)
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_elastic_batch_overrides_config():
+    """An elasticity-enabled engine config resolves its batch from the
+    elastic computation, not the explicit keys."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    cfg = DeepSpeedConfig({**elastic_dict(), "train_batch_size": 12345}, world_size=4)
+    assert cfg.train_batch_size != 12345
+    assert cfg.train_batch_size % (cfg.train_micro_batch_size_per_gpu * 4) == 0
+
+
+# ---------------------------------------------------------------- curriculum
+def test_curriculum_schedules():
+    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+    lin = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                               "schedule_type": "fixed_linear",
+                               "schedule_config": {"total_curriculum_step": 100,
+                                                   "difficulty_step": 8}})
+    assert lin.get_difficulty(0) == 8
+    assert lin.get_difficulty(50) == 32  # halfway, rounded to multiple of 8
+    assert lin.get_difficulty(1000) == 64
+    root = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                "schedule_type": "fixed_root",
+                                "schedule_config": {"total_curriculum_step": 100,
+                                                    "difficulty_step": 8, "root_degree": 2}})
+    assert root.get_difficulty(25) >= lin.get_difficulty(25)  # sqrt front-loads
+    disc = CurriculumScheduler({"min_difficulty": 8, "max_difficulty": 64,
+                                "schedule_type": "fixed_discrete",
+                                "schedule_config": {"difficulty": [8, 32, 64],
+                                                    "max_step": [10, 20]}})
+    assert disc.get_difficulty(5) == 8
+    assert disc.get_difficulty(15) == 32
+    assert disc.get_difficulty(25) == 64
+
+
+def test_curriculum_seqlen_in_engine():
+    comm._state["mesh"] = None
+    model = get_model("tiny", dtype=jnp.float32)
+    cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000,
+           "curriculum_learning": {"enabled": True, "curriculum_type": "seqlen",
+                                   "min_difficulty": 16, "max_difficulty": 64,
+                                   "schedule_type": "fixed_linear",
+                                   "schedule_config": {"total_curriculum_step": 4,
+                                                       "difficulty_step": 16}}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 64)).astype(np.int32)}
+    for _ in range(5):
+        loss = engine.train_batch(batch=batch)
+        assert np.isfinite(float(loss))
+    assert engine.curriculum_scheduler.current_difficulty == 64
+
+
+def test_curriculum_data_sampler():
+    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler, DeepSpeedDataSampler
+    sched = CurriculumScheduler({"min_difficulty": 10, "max_difficulty": 100,
+                                 "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 10,
+                                                     "difficulty_step": 10}})
+    difficulties = np.arange(100)  # sample i has difficulty i
+    sampler = DeepSpeedDataSampler(difficulties, curriculum_scheduler=sched)
+    sampler.advance(0)
+    early = list(iter(sampler))
+    assert max(difficulties[early]) <= 10
+    sampler.advance(10)
+    late = list(iter(sampler))
+    assert len(late) == 100
+
+
+# ------------------------------------------------------ progressive layer drop
+def test_pld_schedule_and_training():
+    from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    pld.update_state(0)
+    assert pld.get_theta() == 1.0
+    pld.update_state(10**6)
+    assert abs(pld.get_theta() - 0.5) < 1e-6
+
+    comm._state["mesh"] = None
+    model = get_model("tiny", dtype=jnp.float32, num_layers=4)
+    cfg = {"train_batch_size": 8, "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000,
+           "progressive_layer_drop": {"enabled": True, "theta": 0.3, "gamma": 0.5}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, 256, (8, 32)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert engine.progressive_layer_drop.get_theta() < 1.0
+
+
+# ---------------------------------------------------------------- eigenvalue
+def test_eigenvalue_power_iteration():
+    """On a pure quadratic loss 0.5 x^T diag(d) x the Hessian eigenvalue is
+    max(d) exactly."""
+    import jax
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+    d = jnp.asarray([1.0, 4.0, 2.5])
+
+    def loss_fn(params, batch, rng):
+        x = params["w"]["x"]
+        return 0.5 * jnp.sum(d * x * x)
+
+    params = {"w": {"x": jnp.asarray([0.3, -0.2, 0.9])}}
+    eig = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(loss_fn, params, batch=None)
+    np.testing.assert_allclose(eig["w"], 4.0, rtol=1e-3)
+
+
+# ---------------------------------------------------------------- autotuner
+def test_autotuner_picks_best_and_skips_failures():
+    from deepspeed_tpu.autotuning import Autotuner
+
+    def model_factory():
+        return get_model("tiny", dtype=jnp.float32)
+
+    def make_batch(global_bs):
+        rng = np.random.default_rng(0)
+        return {"input_ids": rng.integers(0, 256, (global_bs, 32)).astype(np.int32)}
+
+    base = {"optimizer": {"type": "Adam", "params": {"lr": 1e-3}}, "steps_per_print": 10**9,
+            "autotuning": {"enabled": True, "micro_batch_sizes": [1, 2],
+                           "zero_stages": [0, 3]}}
+    tuner = Autotuner(model_factory, base, steps_per_trial=2, warmup_steps=1,
+                      make_batch=make_batch)
+    best_cfg, best_rate = tuner.tune()
+    assert best_rate > 0
+    assert len(tuner.results) == 4
+    assert best_cfg["train_micro_batch_size_per_gpu"] in (1, 2)
+    assert all(r["samples_per_sec"] is not None for r in tuner.results)
