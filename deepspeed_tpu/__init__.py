@@ -76,10 +76,22 @@ def initialize(args=None,
 def init_inference(model=None, config=None, params=None, **kwargs):
     """Initialize the inference engine (reference ``deepspeed.init_inference``).
 
-    ``model``: a ``deepspeed_tpu.models`` model or preset name. ``params``:
+    ``model``: a ``deepspeed_tpu.models`` model or preset name, or a
+    HuggingFace ``transformers`` model / checkpoint directory (auto-converted
+    via ``module_inject``, the reference's kernel-injection path). ``params``:
     optional weight pytree (otherwise loaded from ``config['checkpoint']``)."""
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
+    import os as _os2
+    is_hf_module = hasattr(model, "state_dict") and hasattr(model, "config")
+    is_hf_dir = (isinstance(model, (str, bytes)) or hasattr(model, "__fspath__")) and \
+        _os2.path.isdir(_os2.fspath(model)) and \
+        _os2.path.exists(_os2.path.join(_os2.fspath(model), "config.json"))
+    if is_hf_module or is_hf_dir:
+        from .module_inject import inject_hf_model
+        model, injected = inject_hf_model(model)
+        if params is None:  # explicit params win over the module's state dict
+            params = injected
     if isinstance(config, DeepSpeedInferenceConfig):
         ds_inference_config = config
     else:

@@ -1,0 +1,15 @@
+"""Module injection: HuggingFace -> TPU-native model conversion + AutoTP.
+
+TPU-native counterpart of the reference's ``deepspeed/module_inject``
+(``replace_module.py:279`` ``replace_transformer_layer``, ``auto_tp.py:13``
+``AutoTP``, ``load_checkpoint.py``). The reference swaps ``nn.Module``
+instances inside a live torch model graph; here the torch model (or its
+checkpoint files) is the *source* and the injected artifact is a
+``CausalLMModel`` plus a converted JAX parameter pytree, with tensor
+parallelism expressed as PartitionSpec rules rather than sliced weights.
+"""
+
+from .auto_tp import AutoTP  # noqa: F401
+from .policy import InjectionPolicy, get_policy, replace_policies  # noqa: F401
+from .replace_module import inject_hf_model, replace_module  # noqa: F401
+from .load_checkpoint import HFCheckpointLoader  # noqa: F401

@@ -1,0 +1,93 @@
+"""AutoTP: derive tensor-parallel sharding rules from a parameter pytree.
+
+Counterpart of reference ``module_inject/auto_tp.py`` (``tp_parser`` walks a
+torch module graph classifying ``nn.Linear`` children as all-reduce rows).
+Here the classification runs over parameter *paths* and emits
+``TensorParallelRules`` (regex -> PartitionSpec over the ``tensor`` mesh
+axis) that the sharding planner applies — no weights are sliced; XLA's SPMD
+partitioner materializes the split and inserts the all-reduces the reference
+adds by hand (``LinearAllreduce``).
+
+Classification, Megatron-style:
+- column-parallel (split the *output* dim): q/k/v projections, MLP in/gate/up
+  — outputs stay head- or ffn-sharded, no comm needed between them.
+- row-parallel (split the *input* dim): attention out-proj, MLP down-proj
+  — produces the partial sums that need the all-reduce.
+"""
+
+import jax
+
+from ..runtime.zero.sharding import TensorParallelRules
+from ..comm import comm as dist
+
+# name fragments -> class; order matters (first match wins)
+_COLUMN = ("q_proj", "k_proj", "v_proj", "query", "key", "value", "c_attn",
+           "gate_proj", "up_proj", "fc1", "wi", "w1", "w3", "dense_h_to_4h")
+_ROW = ("o_proj", "out_proj", "c_proj", "down_proj", "fc2", "wo", "w2",
+        "dense_4h_to_h", "dense(?!_)")
+
+
+class AutoTP:
+    """``AutoTP.tp_parser(params)`` -> TensorParallelRules for any pytree."""
+
+    @staticmethod
+    def _classify(path_str):
+        for frag in _COLUMN:
+            if frag in path_str:
+                return "column"
+        for frag in _ROW:
+            if frag in path_str:
+                return "row"
+        return None
+
+    @staticmethod
+    def tp_parser(params, tensor_axis=None):
+        """Walk ``params`` and emit one rule per distinct (module-name, ndim)
+        kernel. Head-major kernels (ndim 3) shard the head dim; plain dense
+        kernels (ndim 2) shard out-dim (column) or in-dim (row)."""
+        axis = tensor_axis or dist.TENSOR_AXIS
+        seen = {}
+        for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+            parts = [str(getattr(p, "key", getattr(p, "name", p))) for p in path]
+            if not parts or parts[-1] not in ("kernel", "embedding"):
+                continue
+            name = "/".join(parts)
+            kind = AutoTP._classify(name)
+            if kind is None:
+                continue
+            module = parts[-2]  # e.g. q_proj
+            # nn.scan-stacked layer blocks carry a leading L dim the
+            # head/dense classification must skip
+            stacked = parts[0] == "layers"
+            eff = leaf.ndim - (1 if stacked else 0)
+            key = (module, leaf.ndim, kind, stacked)
+            if key in seen:
+                continue
+            spec = AutoTP._spec_for(kind, eff, axis)
+            if stacked:
+                from jax.sharding import PartitionSpec as P
+                spec = P(None, *tuple(spec))
+            seen[key] = spec
+        rules = []
+        for (module, ndim, kind, stacked), spec in seen.items():
+            prefix = r"layers/.*" if stacked else ""
+            rules.append((rf"{prefix}{module}/kernel$", spec))
+        return TensorParallelRules(rules)
+
+    @staticmethod
+    def _spec_for(kind, ndim, axis):
+        from jax.sharding import PartitionSpec as P
+        if ndim == 3:
+            # head-major (in, heads, hd) or stacked experts (E, in, out):
+            # shard the middle dim for column, leading for row
+            return P(None, axis, None) if kind == "column" else P(axis, None, None)
+        if ndim == 2:
+            return P(None, axis) if kind == "column" else P(axis, None)
+        return P(*([None] * ndim))
+
+    # reference-API-shaped helpers -------------------------------------
+    @staticmethod
+    def supported(model):
+        """Any model exposing a params pytree is supported; mirrors the
+        reference's allowlist check in spirit."""
+        return hasattr(model, "init_params") or hasattr(model, "tp_rules")

@@ -1,0 +1,126 @@
+"""Lazy HuggingFace checkpoint reader.
+
+Counterpart of reference ``module_inject/load_checkpoint.py`` (which walks a
+live torch module assigning tensors from sharded state dicts). Here no torch
+module exists on the target side, so the loader is a name->ndarray service
+over a checkpoint directory: it resolves the safetensors / pytorch ``.bin``
+index, memory-maps one shard file at a time, and hands out fp32 numpy views
+for the converter to re-layout. Keeps at most one shard resident so a 70B
+conversion never holds the full checkpoint in host RAM.
+"""
+
+import json
+import os
+
+import numpy as np
+
+_SAFE_INDEX = "model.safetensors.index.json"
+_BIN_INDEX = "pytorch_model.bin.index.json"
+_SAFE_SINGLE = "model.safetensors"
+_BIN_SINGLE = "pytorch_model.bin"
+
+
+class HFCheckpointLoader:
+    """Random-access ``get(name)`` over an HF checkpoint directory."""
+
+    def __init__(self, path):
+        self.path = path
+        self._weight_map = None  # name -> filename
+        self._open_file = None
+        self._open_handle = None
+        if os.path.isdir(path):
+            self._resolve_dir(path)
+        elif os.path.isfile(path):
+            base = os.path.basename(path)
+            self._weight_map = None
+            self._single = path
+            self._is_safe = base.endswith(".safetensors")
+        else:
+            raise FileNotFoundError(f"checkpoint path {path} does not exist")
+
+    def _resolve_dir(self, d):
+        if os.path.exists(os.path.join(d, _SAFE_INDEX)):
+            with open(os.path.join(d, _SAFE_INDEX)) as f:
+                self._weight_map = json.load(f)["weight_map"]
+            self._is_safe = True
+        elif os.path.exists(os.path.join(d, _SAFE_SINGLE)):
+            self._single = os.path.join(d, _SAFE_SINGLE)
+            self._is_safe = True
+        elif os.path.exists(os.path.join(d, _BIN_INDEX)):
+            with open(os.path.join(d, _BIN_INDEX)) as f:
+                self._weight_map = json.load(f)["weight_map"]
+            self._is_safe = False
+        elif os.path.exists(os.path.join(d, _BIN_SINGLE)):
+            self._single = os.path.join(d, _BIN_SINGLE)
+            self._is_safe = False
+        else:
+            raise FileNotFoundError(f"no safetensors/bin checkpoint found under {d}")
+
+    # -- shard file management -------------------------------------------
+    def _handle_for(self, fname):
+        if self._open_file != fname:
+            self.close()
+            full = os.path.join(self.path, fname) if self._weight_map is not None else fname
+            if self._is_safe:
+                from safetensors import safe_open
+                self._open_handle = safe_open(full, framework="np")
+            else:
+                import torch
+                self._open_handle = torch.load(full, map_location="cpu", weights_only=True)
+            self._open_file = fname
+        return self._open_handle
+
+    def close(self):
+        self._open_handle = None
+        self._open_file = None
+
+    def keys(self):
+        if self._weight_map is not None:
+            return list(self._weight_map)
+        h = self._handle_for(self._single)
+        return list(h.keys())
+
+    def get(self, name):
+        fname = self._weight_map[name] if self._weight_map is not None else self._single
+        h = self._handle_for(fname)
+        if self._is_safe:
+            arr = h.get_tensor(name)
+            if arr.dtype == np.dtype("V2"):  # raw bf16 comes out as void16
+                arr = _bf16_to_f32(arr)
+            return np.asarray(arr, dtype=np.float32)
+        t = h[name]
+        return t.detach().to("cpu").float().numpy()
+
+    def __contains__(self, name):
+        if self._weight_map is not None:
+            return name in self._weight_map
+        return name in self.keys()
+
+
+def _bf16_to_f32(void_arr):
+    """Widen a raw-bf16 (void16) array to float32 by zero-extending mantissas."""
+    u16 = void_arr.view(np.uint16)
+    u32 = u16.astype(np.uint32) << 16
+    return u32.view(np.float32)
+
+
+class StateDictLoader:
+    """Same ``get`` interface over an in-memory state dict (torch or numpy)."""
+
+    def __init__(self, sd):
+        self.sd = sd
+
+    def keys(self):
+        return list(self.sd)
+
+    def get(self, name):
+        t = self.sd[name]
+        if hasattr(t, "detach"):
+            return t.detach().to("cpu").float().numpy()
+        return np.asarray(t, dtype=np.float32)
+
+    def __contains__(self, name):
+        return name in self.sd
+
+    def close(self):
+        pass

@@ -1,0 +1,282 @@
+"""Per-architecture injection policies.
+
+Counterpart of reference ``module_inject/replace_policy.py`` +
+``containers/`` (gpt2, opt, llama, megatron, ...): each policy knows how to
+(a) derive a ``TransformerConfig`` from an HF config object and (b) re-layout
+the HF weight names/shapes into this framework's parameter pytree.
+
+Layout contracts (see ``models/transformer.py``):
+- ``q/k/v_proj.kernel``: (H, heads, head_dim)  [HeadProjection, bhtd-native]
+- ``o_proj.kernel``:     (heads, head_dim, H)
+- Dense kernels:         (in, out) — torch ``nn.Linear`` weights are (out, in)
+  and transpose on the way in; GPT-2 ``Conv1D`` weights are already (in, out).
+- RoPE: this framework and HF Llama both use the rotate-half convention with
+  half-split sin/cos tables, so rotary weights transfer without permutation.
+"""
+
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+
+
+def _heads_in(w, n, hd):
+    """(H, n*hd) -> (H, n, hd) head-major projection kernel."""
+    return np.ascontiguousarray(w.reshape(w.shape[0], n, hd))
+
+
+def _heads_out(w, n, hd):
+    """(n*hd, H) -> (n, hd, H) output-projection kernel."""
+    return np.ascontiguousarray(w.reshape(n, hd, w.shape[-1]))
+
+
+def _t(w):
+    return np.ascontiguousarray(w.T)
+
+
+class InjectionPolicy:
+    """Base: subclasses set ``architectures``/``model_types`` and implement
+    ``build_config`` + ``convert``."""
+
+    architectures = ()
+    model_types = ()
+
+    @classmethod
+    def matches(cls, hf_config):
+        archs = tuple(getattr(hf_config, "architectures", None) or ())
+        if any(a in cls.architectures for a in archs):
+            return True
+        return getattr(hf_config, "model_type", None) in cls.model_types
+
+    def build_config(self, hf, **overrides):
+        raise NotImplementedError
+
+    def convert(self, get, cfg):
+        """``get(name) -> np.float32 ndarray``; returns the params pytree
+        (layers stacked along axis 0 when ``cfg.scan_layers``)."""
+        raise NotImplementedError
+
+    # -- shared assembly helpers -----------------------------------------
+    def _assemble(self, cfg, top, layer_fn):
+        layers = [layer_fn(i) for i in range(cfg.num_layers)]
+        if cfg.scan_layers:
+            import jax
+            top["layers"] = jax.tree_util.tree_map(lambda *xs: np.stack(xs), *layers)
+        else:
+            for i, lp in enumerate(layers):
+                top[f"layer_{i}"] = lp
+        return top
+
+
+class LlamaPolicy(InjectionPolicy):
+    """Llama 1/2/3 and Mistral (sliding-window attention is not modeled; for
+    contexts within the window the computation is identical)."""
+
+    architectures = ("LlamaForCausalLM", "MistralForCausalLM")
+    model_types = ("llama", "mistral")
+    prefix = "model."
+
+    def build_config(self, hf, **overrides):
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            num_kv_heads=getattr(hf, "num_key_value_heads", None) or hf.num_attention_heads,
+            head_dim=getattr(hf, "head_dim", None),
+            max_seq_len=hf.max_position_embeddings,
+            pos_embedding="rope",
+            norm="rmsnorm",
+            activation="swiglu",
+            tie_embeddings=bool(getattr(hf, "tie_word_embeddings", False)),
+            rope_theta=float(getattr(hf, "rope_theta", 10000.0)),
+            layernorm_epsilon=float(getattr(hf, "rms_norm_eps", 1e-5)),
+        )
+        kw.update(overrides)
+        return TransformerConfig(**kw)
+
+    def convert(self, get, cfg):
+        p = self.prefix
+        nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_size
+
+        def layer(i):
+            q = f"{p}layers.{i}."
+            out = {
+                "attn_norm": {"scale": get(q + "input_layernorm.weight")},
+                "mlp_norm": {"scale": get(q + "post_attention_layernorm.weight")},
+                "attn": {
+                    "q_proj": {"kernel": _heads_in(_t(get(q + "self_attn.q_proj.weight")), nh, hd)},
+                    "k_proj": {"kernel": _heads_in(_t(get(q + "self_attn.k_proj.weight")), nkv, hd)},
+                    "v_proj": {"kernel": _heads_in(_t(get(q + "self_attn.v_proj.weight")), nkv, hd)},
+                    "o_proj": {"kernel": _heads_out(_t(get(q + "self_attn.o_proj.weight")), nh, hd)},
+                },
+            }
+            out.update(self._layer_mlp(get, q, cfg))
+            return out
+
+        top = {"embed": {"embedding": get(p + "embed_tokens.weight")},
+               "final_norm": {"scale": get(p + "norm.weight")}}
+        if not cfg.tie_embeddings:
+            top["lm_head"] = {"kernel": _t(get("lm_head.weight"))}
+        return self._assemble(cfg, top, layer)
+
+    def _layer_mlp(self, get, q, cfg):
+        return {"mlp": {
+            "gate_proj": {"kernel": _t(get(q + "mlp.gate_proj.weight"))},
+            "up_proj": {"kernel": _t(get(q + "mlp.up_proj.weight"))},
+            "down_proj": {"kernel": _t(get(q + "mlp.down_proj.weight"))},
+        }}
+
+
+class MixtralPolicy(LlamaPolicy):
+    """Mixtral: Llama attention + top-k MoE MLP (``block_sparse_moe``)."""
+
+    architectures = ("MixtralForCausalLM", )
+    model_types = ("mixtral", )
+
+    def build_config(self, hf, **overrides):
+        kw = dict(num_experts=hf.num_local_experts, moe_top_k=hf.num_experts_per_tok)
+        kw.update(overrides)
+        return super().build_config(hf, **kw)
+
+    def _layer_mlp(self, get, q, cfg):
+        E = cfg.num_experts
+        # HF expert weights: w1 = gate (F,H), w2 = down (H,F), w3 = up (F,H)
+        gate_k = np.stack([_t(get(f"{q}block_sparse_moe.experts.{e}.w1.weight")) for e in range(E)])
+        down_k = np.stack([_t(get(f"{q}block_sparse_moe.experts.{e}.w2.weight")) for e in range(E)])
+        up_k = np.stack([_t(get(f"{q}block_sparse_moe.experts.{e}.w3.weight")) for e in range(E)])
+        return {"moe": {
+            "gate": _t(get(q + "block_sparse_moe.gate.weight")),
+            "experts": {"gate_proj": gate_k, "up_proj": up_k, "down_proj": down_k},
+        }}
+
+
+class GPT2Policy(InjectionPolicy):
+    architectures = ("GPT2LMHeadModel", )
+    model_types = ("gpt2", )
+
+    def build_config(self, hf, **overrides):
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.n_embd,
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            max_seq_len=hf.n_positions,
+            pos_embedding="learned",
+            norm="layernorm",
+            activation="gelu",
+            tie_embeddings=True,
+            layernorm_epsilon=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+        )
+        kw.update(overrides)
+        return TransformerConfig(**kw)
+
+    def convert(self, get, cfg):
+        nh, hd, H = cfg.num_heads, cfg.head_size, cfg.hidden_size
+
+        def layer(i):
+            q = f"transformer.h.{i}."
+            # Conv1D: weight already (in, out); c_attn fuses q|k|v on the out dim
+            qkv_w = get(q + "attn.c_attn.weight")
+            qkv_b = get(q + "attn.c_attn.bias")
+            wq, wk, wv = np.split(qkv_w, 3, axis=1)
+            bq, bk, bv = np.split(qkv_b, 3)
+            return {
+                "attn_norm": {"scale": get(q + "ln_1.weight"), "bias": get(q + "ln_1.bias")},
+                "mlp_norm": {"scale": get(q + "ln_2.weight"), "bias": get(q + "ln_2.bias")},
+                "attn": {
+                    "q_proj": {"kernel": _heads_in(wq, nh, hd), "bias": bq.reshape(nh, hd)},
+                    "k_proj": {"kernel": _heads_in(wk, nh, hd), "bias": bk.reshape(nh, hd)},
+                    "v_proj": {"kernel": _heads_in(wv, nh, hd), "bias": bv.reshape(nh, hd)},
+                    "o_proj": {"kernel": _heads_out(get(q + "attn.c_proj.weight"), nh, hd),
+                               "bias": get(q + "attn.c_proj.bias")},
+                },
+                "mlp": {
+                    "up_proj": {"kernel": get(q + "mlp.c_fc.weight"), "bias": get(q + "mlp.c_fc.bias")},
+                    "down_proj": {"kernel": get(q + "mlp.c_proj.weight"), "bias": get(q + "mlp.c_proj.bias")},
+                },
+            }
+
+        top = {
+            "embed": {"embedding": get("transformer.wte.weight")},
+            "pos_embed": get("transformer.wpe.weight"),
+            "final_norm": {"scale": get("transformer.ln_f.weight"), "bias": get("transformer.ln_f.bias")},
+        }
+        return self._assemble(cfg, top, layer)
+
+
+class OPTPolicy(InjectionPolicy):
+    architectures = ("OPTForCausalLM", )
+    model_types = ("opt", )
+
+    def build_config(self, hf, **overrides):
+        if not getattr(hf, "do_layer_norm_before", True):
+            raise ValueError("OPT with do_layer_norm_before=False (350m) is post-norm; unsupported")
+        if getattr(hf, "word_embed_proj_dim", hf.hidden_size) != hf.hidden_size:
+            raise ValueError("OPT with word_embed_proj_dim != hidden_size is unsupported")
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.ffn_dim,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            max_seq_len=hf.max_position_embeddings,
+            pos_embedding="learned",
+            norm="layernorm",
+            activation="relu",
+            tie_embeddings=True,
+            layernorm_epsilon=1e-5,
+        )
+        kw.update(overrides)
+        return TransformerConfig(**kw)
+
+    def convert(self, get, cfg):
+        nh, hd = cfg.num_heads, cfg.head_size
+        p = "model.decoder."
+
+        def lin_in(name, n):
+            return {"kernel": _heads_in(_t(get(name + ".weight")), n, hd),
+                    "bias": get(name + ".bias").reshape(n, hd)}
+
+        def layer(i):
+            q = f"{p}layers.{i}."
+            return {
+                "attn_norm": {"scale": get(q + "self_attn_layer_norm.weight"),
+                              "bias": get(q + "self_attn_layer_norm.bias")},
+                "mlp_norm": {"scale": get(q + "final_layer_norm.weight"),
+                             "bias": get(q + "final_layer_norm.bias")},
+                "attn": {
+                    "q_proj": lin_in(q + "self_attn.q_proj", nh),
+                    "k_proj": lin_in(q + "self_attn.k_proj", nh),
+                    "v_proj": lin_in(q + "self_attn.v_proj", nh),
+                    "o_proj": {"kernel": _heads_out(_t(get(q + "self_attn.out_proj.weight")), nh, hd),
+                               "bias": get(q + "self_attn.out_proj.bias")},
+                },
+                "mlp": {
+                    "up_proj": {"kernel": _t(get(q + "fc1.weight")), "bias": get(q + "fc1.bias")},
+                    "down_proj": {"kernel": _t(get(q + "fc2.weight")), "bias": get(q + "fc2.bias")},
+                },
+            }
+
+        top = {
+            "embed": {"embedding": get(p + "embed_tokens.weight")},
+            # OPT's learned positions carry a +2 slot offset (padding legacy)
+            "pos_embed": get(p + "embed_positions.weight")[2:],
+            "final_norm": {"scale": get(p + "final_layer_norm.weight"),
+                           "bias": get(p + "final_layer_norm.bias")},
+        }
+        return self._assemble(cfg, top, layer)
+
+
+replace_policies = [LlamaPolicy, MixtralPolicy, GPT2Policy, OPTPolicy]
+
+
+def get_policy(hf_config):
+    # Mixtral before Llama: both match model_type prefixes via architectures
+    for cls in (MixtralPolicy, LlamaPolicy, GPT2Policy, OPTPolicy):
+        if cls.matches(hf_config):
+            return cls()
+    raise ValueError(
+        f"No injection policy for architecture {getattr(hf_config, 'architectures', None)} "
+        f"(model_type={getattr(hf_config, 'model_type', None)}). Supported: "
+        + ", ".join(sorted(a for c in replace_policies for a in c.architectures)))

@@ -1,0 +1,99 @@
+"""Top-level HF-model injection.
+
+Counterpart of reference ``module_inject/replace_module.py:279``
+(``replace_transformer_layer``): where the reference rewrites a torch model
+in place (policy chooses a container, weights are sliced per TP rank), this
+produces a fresh ``CausalLMModel`` + converted parameter pytree; tensor
+parallelism comes later, from PartitionSpec rules at engine init — the same
+weights serve any mesh shape.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import CausalLMModel
+from ..utils.logging import logger
+from .load_checkpoint import HFCheckpointLoader, StateDictLoader
+from .policy import get_policy
+
+
+def _as_loader(model_or_path):
+    """(loader, hf_config) from a transformers module, state dict, or path."""
+    m = model_or_path
+    if hasattr(m, "state_dict") and hasattr(m, "config"):  # live torch module
+        return StateDictLoader(m.state_dict()), m.config
+    if isinstance(m, dict):
+        raise ValueError("state-dict injection needs a config: pass (sd, hf_config) "
+                         "via inject_hf_model(sd, hf_config=cfg)")
+    if isinstance(m, (str, bytes)) or hasattr(m, "__fspath__"):
+        import json
+        import os
+        path = os.fspath(m)
+        cfg_path = os.path.join(path, "config.json") if os.path.isdir(path) else None
+        if cfg_path is None or not os.path.exists(cfg_path):
+            raise FileNotFoundError(f"{path} is not an HF checkpoint dir (no config.json)")
+        with open(cfg_path) as f:
+            raw = json.load(f)
+
+        class _Cfg:
+            def __init__(self, d):
+                self.__dict__.update(d)
+
+        return HFCheckpointLoader(path), _Cfg(raw)
+    raise TypeError(f"cannot inject from {type(m)}; pass a transformers model or checkpoint dir")
+
+
+def inject_hf_model(model_or_path, hf_config=None, dtype=None, **overrides):
+    """Convert an HF causal-LM into ``(CausalLMModel, params)``.
+
+    ``model_or_path``: a ``transformers`` model instance, an HF checkpoint
+    directory (config.json + safetensors/bin), or a raw state dict (then pass
+    ``hf_config``). ``dtype``: compute dtype for the built model (params stay
+    fp32; the engine/inference config casts). ``overrides`` forward into
+    ``TransformerConfig`` (e.g. ``attention_impl='flash'``,
+    ``scan_layers=False``)."""
+    if isinstance(model_or_path, dict):
+        if hf_config is None:
+            raise ValueError("inject_hf_model(state_dict) requires hf_config=")
+        loader = StateDictLoader(model_or_path)
+        cfg_src = hf_config
+    else:
+        loader, cfg_src = _as_loader(model_or_path)
+    policy = get_policy(cfg_src)
+    if dtype is not None:
+        overrides = dict(overrides, dtype=dtype)
+    cfg = policy.build_config(cfg_src, **overrides)
+    logger.info(f"module_inject: {type(policy).__name__} -> TransformerConfig("
+                f"L={cfg.num_layers}, H={cfg.hidden_size}, heads={cfg.num_heads}/"
+                f"{cfg.kv_heads}, vocab={cfg.vocab_size})")
+    params = policy.convert(loader.get, cfg)
+    loader.close()
+    params = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), params)
+    model = CausalLMModel(cfg)
+    _check_tree(model, params)
+    return model, params
+
+
+def _check_tree(model, params):
+    """Shape-check the converted tree against a freshly-initialized one."""
+    ref = jax.eval_shape(model.init_params, jax.random.key(0))
+    ref_flat = {_pstr(p): l for p, l in jax.tree_util.tree_leaves_with_path(ref)}
+    got_flat = {_pstr(p): l for p, l in jax.tree_util.tree_leaves_with_path(params)}
+    missing = sorted(set(ref_flat) - set(got_flat))
+    extra = sorted(set(got_flat) - set(ref_flat))
+    if missing or extra:
+        raise ValueError(f"injected tree mismatch: missing={missing[:5]} extra={extra[:5]}")
+    for k, leaf in ref_flat.items():
+        if tuple(got_flat[k].shape) != tuple(leaf.shape):
+            raise ValueError(f"injected {k}: shape {got_flat[k].shape} != expected {leaf.shape}")
+
+
+def _pstr(path):
+    return "/".join(str(getattr(p, "key", getattr(p, "name", p))) for p in path)
+
+
+def replace_module(model, **kwargs):
+    """Reference-shaped alias (``replace_module.py``'s entry used by
+    ``init_inference`` with kernel injection)."""
+    return inject_hf_model(model, **kwargs)
