@@ -1,16 +1,19 @@
-"""Benchmark: GPT-2 125M training throughput on the local chip(s).
+"""Benchmark: training throughput/MFU on the local chip(s).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Baseline: the reference's published pretrain efficiency for this model class
-is 52% MFU (BERT-record, 66 TFLOPS/V100, `docs/_posts/2020-05-19-bert-record.md:14`)
-and this repo's north-star target is >=40% MFU (BASELINE.md). vs_baseline
-reports achieved_MFU / 0.40.
+Headline: GPT-2 large (774M) — the largest zoo model whose fp32 Adam state
+fits a single 16 GB chip without offload, where MFU is meaningful (BASELINE.md
+north star: >=40% MFU; the reference's published efficiency is 50-65% MFU on
+A100 clusters, `docs/_posts/2022-07-26-deepspeed-azure.md:97`). vs_baseline
+reports achieved_MFU / 0.40. The GPT-2 125M config benched in earlier rounds
+is re-measured and reported in "extra" for continuity.
 
 Timing note: on the axon-tunneled TPU, block_until_ready() returns
 immediately (remote placeholder buffers), so the fence is a value fetch of
 the final step's loss — which transitively depends on every prior donated
-state update.
+state update. The fetch RPC costs ~100ms; step counts are sized to amortize
+it below 1% of the measurement.
 """
 
 import json
@@ -19,22 +22,24 @@ import time
 import numpy as np
 
 
-def main():
+def _mfu(cfg, tok_per_sec, seq, peak):
+    # PaLM-style MFU: 6*N_nonemb + 12*L*H*T matmul flops per token
+    n_emb = cfg.vocab_size * cfg.hidden_size + (cfg.max_seq_len * cfg.hidden_size
+                                                if cfg.pos_embedding == "learned" else 0)
+    n_nonemb = cfg.num_params() - n_emb
+    flops_per_token = 6 * n_nonemb + 12 * cfg.num_layers * cfg.hidden_size * seq
+    return flops_per_token * tok_per_sec / peak
+
+
+def _run(model_name, micro_bs, steps, seq=1024):
     import jax
-    import jax.numpy as jnp
     import deepspeed_tpu
-    from deepspeed_tpu.models import get_model, _PRESETS
-    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.models import get_model
 
-    seq = 1024
-    micro_bs = 16
-    model_name = "gpt2-125m"
-    # fastest measured config for this size (sweep on v5e): unrolled layers,
-    # no remat (125M fits HBM comfortably), Pallas flash attention in bhtd
+    # fastest measured config for these sizes (sweep on v5e): unrolled
+    # layers, no remat, Pallas flash attention in bhtd
     model = get_model(model_name, remat_policy=None, scan_layers=False, attention_impl="flash")
-    cfg = _PRESETS[model_name]()
-
-    n_chips = len(jax.devices())
+    cfg = model.cfg
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         config={
@@ -56,8 +61,6 @@ def main():
         for _ in range(3):  # warmup + compile
             state, metrics = step_fn(state, placed)
         float(metrics["loss"])
-
-        steps = 20
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = step_fn(state, placed)
@@ -65,28 +68,37 @@ def main():
         dt = time.perf_counter() - t0
 
     tokens = steps * global_bs * seq
-    tok_per_sec_chip = tokens / dt / n_chips
+    return cfg, tokens / dt, dt / steps, final_loss, global_bs
 
-    # PaLM-style MFU: 6*N_nonemb + 12*L*H*T matmul flops per token
-    n_emb = cfg.vocab_size * cfg.hidden_size + cfg.max_seq_len * cfg.hidden_size
-    n_nonemb = cfg.num_params() - n_emb
-    flops_per_token = 6 * n_nonemb + 12 * cfg.num_layers * cfg.hidden_size * seq
-    achieved = flops_per_token * tok_per_sec_chip
+
+def main():
+    import jax
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    n_chips = len(jax.devices())
     peak = get_accelerator().peak_flops()
-    mfu = achieved / peak
+    seq = 1024
+
+    cfg_l, tok_l, step_l, loss_l, bs_l = _run("gpt2-large", micro_bs=4, steps=40, seq=seq)
+    mfu_l = _mfu(cfg_l, tok_l / n_chips, seq, peak)
+
+    cfg_s, tok_s, step_s, loss_s, bs_s = _run("gpt2-125m", micro_bs=16, steps=60, seq=seq)
+    mfu_s = _mfu(cfg_s, tok_s / n_chips, seq, peak)
 
     print(json.dumps({
-        "metric": f"{model_name} train throughput/chip (bf16, seq{seq}, bs{global_bs})",
-        "value": round(tok_per_sec_chip, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.40, 4),
+        "metric": f"gpt2-large(774M) train MFU (bf16, seq{seq}, bs{bs_l}, fp32 Adam on-chip)",
+        "value": round(mfu_l * 100, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu_l / 0.40, 4),
         "extra": {
-            "mfu_vs_nominal_peak": round(mfu, 4),
-            "achieved_tflops_per_chip": round(achieved / 1e12, 2),
+            "gpt2_large_tokens_per_sec_chip": round(tok_l / n_chips, 1),
+            "gpt2_large_ms_per_step": round(step_l * 1000, 1),
+            "gpt2_large_final_loss": round(loss_l, 4),
+            "gpt2_125m_tokens_per_sec_chip": round(tok_s / n_chips, 1),
+            "gpt2_125m_mfu": round(mfu_s, 4),
+            "gpt2_125m_ms_per_step": round(step_s * 1000, 1),
             "nominal_peak_tflops": round(peak / 1e12, 1),
-            "ms_per_step": round(dt / steps * 1000, 1),
             "n_chips": n_chips,
-            "final_loss": round(final_loss, 4),
             # ZeRO-Offload capacity (measured offline, not re-run here: the
             # dev harness tunnels host<->HBM at ~50 MB/s, so the per-step
             # full-gradient round-trip is link-bound): gpt2-xl, 1,557,611,200

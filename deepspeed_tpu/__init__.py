@@ -82,13 +82,8 @@ def init_inference(model=None, config=None, params=None, **kwargs):
     optional weight pytree (otherwise loaded from ``config['checkpoint']``)."""
     from .inference.engine import InferenceEngine
     from .inference.config import DeepSpeedInferenceConfig
-    import os as _os2
-    is_hf_module = hasattr(model, "state_dict") and hasattr(model, "config")
-    is_hf_dir = (isinstance(model, (str, bytes)) or hasattr(model, "__fspath__")) and \
-        _os2.path.isdir(_os2.fspath(model)) and \
-        _os2.path.exists(_os2.path.join(_os2.fspath(model), "config.json"))
-    if is_hf_module or is_hf_dir:
-        from .module_inject import inject_hf_model
+    from .module_inject import inject_hf_model, is_hf_source
+    if is_hf_source(model):
         model, injected = inject_hf_model(model)
         if params is None:  # explicit params win over the module's state dict
             params = injected

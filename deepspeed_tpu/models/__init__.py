@@ -65,6 +65,11 @@ def gpt2_medium():
     return _gpt2(1024, 24, 16)
 
 
+@register("gpt2-large")
+def gpt2_large():
+    return _gpt2(1280, 36, 20)
+
+
 @register("gpt2-xl")
 def gpt2_xl():
     return _gpt2(1600, 48, 25)

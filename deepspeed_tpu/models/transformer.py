@@ -652,7 +652,9 @@ class CausalLMModel:
         return not (dist.has_mesh() and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
 
     def _ce_chunk(self):
-        return self.cfg.ce_chunk_size or 128
+        # 256-row chunks measured fastest on v5e (vs 128: −6.7ms/step at
+        # bs16/seq1024/vocab50k; 512/1024 are within noise of 256)
+        return self.cfg.ce_chunk_size or 256
 
     def loss(self, params, batch, rng):
         """Next-token cross entropy. batch: input_ids (B,T), optional labels

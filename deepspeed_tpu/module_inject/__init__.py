@@ -11,5 +11,5 @@ parallelism expressed as PartitionSpec rules rather than sliced weights.
 
 from .auto_tp import AutoTP  # noqa: F401
 from .policy import InjectionPolicy, get_policy, replace_policies  # noqa: F401
-from .replace_module import inject_hf_model, replace_module  # noqa: F401
+from .replace_module import inject_hf_model, is_hf_source, replace_module  # noqa: F401
 from .load_checkpoint import HFCheckpointLoader  # noqa: F401

@@ -15,16 +15,18 @@ Classification, Megatron-style:
   — produces the partial sums that need the all-reduce.
 """
 
+import re
+
 import jax
 
 from ..runtime.zero.sharding import TensorParallelRules
 from ..comm import comm as dist
 
-# name fragments -> class; order matters (first match wins)
+# name regex fragments -> class; order matters (first match wins)
 _COLUMN = ("q_proj", "k_proj", "v_proj", "query", "key", "value", "c_attn",
-           "gate_proj", "up_proj", "fc1", "wi", "w1", "w3", "dense_h_to_4h")
-_ROW = ("o_proj", "out_proj", "c_proj", "down_proj", "fc2", "wo", "w2",
-        "dense_4h_to_h", "dense(?!_)")
+           "gate_proj", "up_proj", "fc1", r"\bwi\b", r"\bw1\b", r"\bw3\b", "dense_h_to_4h")
+_ROW = ("o_proj", "out_proj", "c_proj", "down_proj", "fc2", r"\bwo\b", r"\bw2\b",
+        "dense_4h_to_h", r"dense(?!_)")
 
 
 class AutoTP:
@@ -33,10 +35,10 @@ class AutoTP:
     @staticmethod
     def _classify(path_str):
         for frag in _COLUMN:
-            if frag in path_str:
+            if re.search(frag, path_str):
                 return "column"
         for frag in _ROW:
-            if frag in path_str:
+            if re.search(frag, path_str):
                 return "row"
         return None
 
@@ -56,21 +58,26 @@ class AutoTP:
             if kind is None:
                 continue
             module = parts[-2]  # e.g. q_proj
-            # nn.scan-stacked layer blocks carry a leading L dim the
-            # head/dense classification must skip
+            # nn.scan-stacked layer blocks carry a leading L dim, and expert
+            # banks a leading E dim, which the head/dense classification
+            # must skip (stacked experts shard their ffn dim over tensor;
+            # the E dim belongs to the expert axis, not TP)
             stacked = parts[0] == "layers"
-            eff = leaf.ndim - (1 if stacked else 0)
-            key = (module, leaf.ndim, kind, stacked)
+            expert = "experts" in parts
+            lead = (1 if stacked else 0) + (1 if expert else 0)
+            eff = leaf.ndim - lead
+            key = (module, leaf.ndim, kind, stacked, expert)
             if key in seen:
                 continue
             spec = AutoTP._spec_for(kind, eff, axis)
-            if stacked:
-                from jax.sharding import PartitionSpec as P
-                spec = P(None, *tuple(spec))
+            from jax.sharding import PartitionSpec as P
+            spec = P(*([None] * lead), *tuple(spec))
             seen[key] = spec
         rules = []
-        for (module, ndim, kind, stacked), spec in seen.items():
+        for (module, ndim, kind, stacked, expert), spec in seen.items():
             prefix = r"layers/.*" if stacked else ""
+            if expert:
+                prefix += r"experts/.*"
             rules.append((rf"{prefix}{module}/kernel$", spec))
         return TensorParallelRules(rules)
 

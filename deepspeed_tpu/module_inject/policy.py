@@ -76,6 +76,10 @@ class LlamaPolicy(InjectionPolicy):
     prefix = "model."
 
     def build_config(self, hf, **overrides):
+        scaling = getattr(hf, "rope_scaling", None)
+        if scaling and dict(scaling).get("rope_type", dict(scaling).get("type")) != "default":
+            raise ValueError(f"rope_scaling={scaling!r} is not supported (plain RoPE only); "
+                             "converting would silently change positional geometry")
         kw = dict(
             vocab_size=hf.vocab_size,
             hidden_size=hf.hidden_size,
@@ -214,6 +218,9 @@ class OPTPolicy(InjectionPolicy):
             raise ValueError("OPT with do_layer_norm_before=False (350m) is post-norm; unsupported")
         if getattr(hf, "word_embed_proj_dim", hf.hidden_size) != hf.hidden_size:
             raise ValueError("OPT with word_embed_proj_dim != hidden_size is unsupported")
+        act = getattr(hf, "activation_function", "relu")
+        if act not in ("relu", "gelu", "gelu_new"):  # Galactica ships gelu
+            raise ValueError(f"OPT activation_function={act!r} unsupported")
         kw = dict(
             vocab_size=hf.vocab_size,
             hidden_size=hf.hidden_size,
@@ -223,8 +230,8 @@ class OPTPolicy(InjectionPolicy):
             max_seq_len=hf.max_position_embeddings,
             pos_embedding="learned",
             norm="layernorm",
-            activation="relu",
-            tie_embeddings=True,
+            activation="relu" if act == "relu" else "gelu",
+            tie_embeddings=bool(getattr(hf, "tie_word_embeddings", True)),
             layernorm_epsilon=1e-5,
         )
         kw.update(overrides)
@@ -265,6 +272,8 @@ class OPTPolicy(InjectionPolicy):
             "final_norm": {"scale": get(p + "final_layer_norm.weight"),
                            "bias": get(p + "final_layer_norm.bias")},
         }
+        if not cfg.tie_embeddings:
+            top["lm_head"] = {"kernel": _t(get("lm_head.weight"))}
         return self._assemble(cfg, top, layer)
 
 

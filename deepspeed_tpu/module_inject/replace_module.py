@@ -18,6 +18,21 @@ from .load_checkpoint import HFCheckpointLoader, StateDictLoader
 from .policy import get_policy
 
 
+def is_hf_source(obj):
+    """True when ``obj`` is something ``inject_hf_model`` can convert: a live
+    transformers module or an HF checkpoint directory (config.json + weights
+    — a bare weights file carries no config and is not convertible). Shared
+    with ``init_inference`` so detection cannot drift from what the loader
+    actually accepts."""
+    import os
+    if hasattr(obj, "state_dict") and hasattr(obj, "config"):
+        return True
+    if isinstance(obj, (str, bytes)) or hasattr(obj, "__fspath__"):
+        path = os.fspath(obj)
+        return os.path.isdir(path) and os.path.exists(os.path.join(path, "config.json"))
+    return False
+
+
 def _as_loader(model_or_path):
     """(loader, hf_config) from a transformers module, state dict, or path."""
     m = model_or_path

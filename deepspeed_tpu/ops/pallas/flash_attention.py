@@ -6,7 +6,11 @@ attention in ``csrc/transformer/ds_transformer_cuda.cpp``; the Triton
 block-sparse path in ``deepspeed/ops/sparse_attention/matmul.py``).
 
 FlashAttention-2-style online softmax: O(T) memory, fp32 accumulators in
-VMEM, bf16 MXU matmuls. Operates natively on the model's ``(B, H, T, D)``
+VMEM, bf16 MXU matmuls — operands stay in the input dtype (bf16) and every
+``dot_general`` accumulates in fp32 via ``preferred_element_type``; softmax
+probabilities are cast back to the operand dtype before the P·V / Pᵀ·dO
+matmuls (the MXU contracts bf16×bf16→fp32 natively; an fp32 operand path
+would run at ~1/4 rate). Operates natively on the model's ``(B, H, T, D)``
 ("bhtd") layout — blocks are carved by BlockSpec index maps over the
 sequence dim, so no transposes/copies appear around the kernel (those
 copies cost ~7% of a train step in the packed ``(B*H, T, D)`` formulation
@@ -50,7 +54,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
     qi = pl.program_id(2)
     q_start = qi * block_q
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale  # (bq, D)
+    q = q_ref[0, 0]  # (bq, D) operand dtype; accumulation is fp32
 
     m = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
@@ -61,27 +65,30 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
         num_kv_eff = jax.lax.min(num_kv, pl.cdiv(q_start + block_q, block_kv))
     else:
         num_kv_eff = num_kv
+    # loop-invariant local iotas: mask = (ik - iq) <= q_start - kv_start —
+    # one scalar-broadcast compare per iteration instead of two iota adds
+    iq = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+    ik = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+    ikq = ik - iq
 
     def body(j, carry):
         m, l, acc = carry
         kv_start = j * block_kv
-        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
+        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :]
+        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :]
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
-                                preferred_element_type=jnp.float32)  # (bq, bkv)
+                                preferred_element_type=jnp.float32) * scale  # (bq, bkv)
 
-        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-        kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-        mask = kv_pos < seq_len
+        mask = ik < seq_len - kv_start
         if causal:
-            mask = mask & (kv_pos <= q_pos)
+            mask = mask & (ikq <= q_start - kv_start)
         s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(p, v, (((1, ), (0, )), ((), ())),
+        acc = acc * alpha + jax.lax.dot_general(p.astype(v.dtype), v, (((1, ), (0, )), ((), ())),
                                                 preferred_element_type=jnp.float32)
         return m_new, l, acc
 
@@ -99,32 +106,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
     qi = pl.program_id(2)
     q_start = qi * block_q
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale
-    do = do_ref[0, 0].astype(jnp.float32)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
     lse = lse_ref[0, 0]  # (bq, 1)
     delta = delta_ref[0, 0]  # (bq, 1)
 
     num_kv = pl.cdiv(k_ref.shape[2], block_kv)
     num_kv_eff = jax.lax.min(num_kv, pl.cdiv(q_start + block_q, block_kv)) if causal else num_kv
+    iq = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+    ik = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+    ikq = ik - iq
 
     def body(j, dq):
         kv_start = j * block_kv
-        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())), preferred_element_type=jnp.float32)
-        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-        kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-        mask = kv_pos < seq_len
+        k = k_ref[0, 0, pl.ds(kv_start, block_kv), :]
+        v = v_ref[0, 0, pl.ds(kv_start, block_kv), :]
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = ik < seq_len - kv_start
         if causal:
-            mask = mask & (kv_pos <= q_pos)
+            mask = mask & (ikq <= q_start - kv_start)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        # fold the softmax scale into ds before the bf16 cast (dq = scale·dsᵀk)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         return dq + jax.lax.dot_general(ds, k, (((1, ), (0, )), ((), ())),
                                         preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, num_kv_eff, body, jnp.zeros((block_q, d), jnp.float32))
-    dq_ref[0, 0] = (dq * scale).astype(dq_ref.dtype)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q,
@@ -137,40 +147,44 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
     ki = pl.program_id(2)
     kv_start = ki * block_kv
 
-    k = k_ref[0, 0].astype(jnp.float32)
-    v = v_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0]
+    v = v_ref[0, 0]
 
     num_q = pl.cdiv(q_ref.shape[2], block_q)
     start_q = (kv_start // block_q) if causal else 0
 
+    iq = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+    ik = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+    ikq = ik - iq
+
     def body(i, carry):
         dk, dv = carry
         q_start = i * block_q
-        q = q_ref[0, 0, pl.ds(q_start, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, 0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        q = q_ref[0, 0, pl.ds(q_start, block_q), :]
+        do = do_ref[0, 0, pl.ds(q_start, block_q), :]
         lse = lse_ref[0, 0, pl.ds(q_start, block_q), :]  # (bq, 1)
         delta = delta_ref[0, 0, pl.ds(q_start, block_q), :]  # (bq, 1)
 
-        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())), preferred_element_type=jnp.float32)
-        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
-        kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
-        mask = (kv_pos < seq_len) & (q_pos < seq_len)
+        s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        mask = (ik < seq_len - kv_start) & (iq < seq_len - q_start)
         if causal:
-            mask = mask & (kv_pos <= q_pos)
+            mask = mask & (ikq <= q_start - kv_start)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        pb = p.astype(do.dtype)
 
-        dv = dv + jax.lax.dot_general(p, do, (((0, ), (0, )), ((), ())),
+        dv = dv + jax.lax.dot_general(pb, do, (((0, ), (0, )), ((), ())),
                                       preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())), preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        dp = jax.lax.dot_general(do, v, (((1, ), (1, )), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        # scale folds into ds (dk = scale·dsᵀq), matching the fwd s-scaling
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0, ), (0, )), ((), ())),
                                       preferred_element_type=jnp.float32)
         return dk, dv
 
     zero = jnp.zeros((block_kv, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(start_q, num_q, body, (zero, zero))
-    # q was pre-scaled inside the loop, so ds^T @ q_scaled already carries the
-    # softmax scale — no extra factor here
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
