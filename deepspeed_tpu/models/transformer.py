@@ -42,7 +42,7 @@ class TransformerConfig:
     # family switches
     pos_embedding: str = "rope"  # "rope" | "learned" | "none"
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
-    activation: str = "swiglu"  # "swiglu" | "gelu" | "relu" | "geglu"
+    activation: str = "swiglu"  # "swiglu" | "gelu" (tanh) | "gelu_exact" (erf) | "relu" | "geglu"
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
     layernorm_epsilon: float = 1e-5
@@ -464,7 +464,12 @@ class MLP(nn.Module):
             h = act * up
         else:
             h = dense(cfg.ffn_size, name="up_proj")(x)
-            h = nn.gelu(h) if cfg.activation == "gelu" else nn.relu(h)
+            if cfg.activation == "gelu":
+                h = nn.gelu(h)  # tanh approximation (HF "gelu_new")
+            elif cfg.activation == "gelu_exact":
+                h = nn.gelu(h, approximate=False)  # erf (HF "gelu")
+            else:
+                h = nn.relu(h)
         return dense(cfg.hidden_size, name="down_proj")(h)
 
 

@@ -219,7 +219,9 @@ class OPTPolicy(InjectionPolicy):
         if getattr(hf, "word_embed_proj_dim", hf.hidden_size) != hf.hidden_size:
             raise ValueError("OPT with word_embed_proj_dim != hidden_size is unsupported")
         act = getattr(hf, "activation_function", "relu")
-        if act not in ("relu", "gelu", "gelu_new"):  # Galactica ships gelu
+        # HF "gelu" is the exact erf form (Galactica); "gelu_new" is tanh
+        act_map = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu"}
+        if act not in act_map:
             raise ValueError(f"OPT activation_function={act!r} unsupported")
         kw = dict(
             vocab_size=hf.vocab_size,
@@ -230,7 +232,7 @@ class OPTPolicy(InjectionPolicy):
             max_seq_len=hf.max_position_embeddings,
             pos_embedding="learned",
             norm="layernorm",
-            activation="relu" if act == "relu" else "gelu",
+            activation=act_map[act],
             tie_embeddings=bool(getattr(hf, "tie_word_embeddings", True)),
             layernorm_epsilon=1e-5,
         )

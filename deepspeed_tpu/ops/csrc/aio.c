@@ -1,0 +1,146 @@
+/* Asynchronous file I/O worker pool for the NVMe offload tier.
+ *
+ * Native analogue of the reference's libaio-based engine (csrc/aio/py_lib/
+ * deepspeed_aio_thread.cpp, deepspeed_py_aio_handle.cpp): a pool of POSIX
+ * threads services pread/pwrite requests from a mutex+condvar queue so
+ * device<->host<->disk stages overlap. Buffered pread/pwrite instead of
+ * io_submit: the swap working set is stream-shaped (large sequential leaf
+ * blocks), where the page cache either helps or is bypassed by O_DIRECT-
+ * capable deployments at mount level; the scheduling benefit (overlap with
+ * the host Adam step and the TPU transfers) comes from the thread pool, not
+ * the kernel AIO interface.
+ *
+ * API (ctypes-bound in deepspeed_tpu/ops/aio/__init__.py):
+ *   ds_aio_create(threads) -> handle
+ *   ds_aio_submit(h, path, buf, nbytes, file_offset, is_write) -> 0/-1
+ *   ds_aio_wait(h) -> number of failed requests since last wait
+ *   ds_aio_destroy(h)
+ */
+
+#define _GNU_SOURCE
+#include <fcntl.h>
+#include <pthread.h>
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+typedef struct req {
+    char *path;
+    char *buf;
+    int64_t nbytes;
+    int64_t offset;
+    int is_write;
+    struct req *next;
+} req_t;
+
+typedef struct {
+    pthread_mutex_t mu;
+    pthread_cond_t work_cv;   /* signalled when a request is queued */
+    pthread_cond_t done_cv;   /* signalled when in_flight drops */
+    req_t *head, *tail;
+    int64_t in_flight;        /* queued + executing */
+    int64_t failed;
+    int shutdown;
+    int nthreads;
+    pthread_t *threads;
+} ds_aio_t;
+
+static int run_request(req_t *r) {
+    int fd = r->is_write ? open(r->path, O_WRONLY | O_CREAT, 0644)
+                         : open(r->path, O_RDONLY);
+    if (fd < 0) return -1;
+    int64_t done = 0;
+    while (done < r->nbytes) {
+        ssize_t n = r->is_write
+            ? pwrite(fd, r->buf + done, (size_t)(r->nbytes - done), r->offset + done)
+            : pread(fd, r->buf + done, (size_t)(r->nbytes - done), r->offset + done);
+        if (n <= 0) { close(fd); return -1; }
+        done += n;
+    }
+    close(fd);
+    return 0;
+}
+
+static void *worker(void *arg) {
+    ds_aio_t *h = (ds_aio_t *)arg;
+    for (;;) {
+        pthread_mutex_lock(&h->mu);
+        while (!h->head && !h->shutdown)
+            pthread_cond_wait(&h->work_cv, &h->mu);
+        if (!h->head && h->shutdown) {
+            pthread_mutex_unlock(&h->mu);
+            return NULL;
+        }
+        req_t *r = h->head;
+        h->head = r->next;
+        if (!h->head) h->tail = NULL;
+        pthread_mutex_unlock(&h->mu);
+
+        int rc = run_request(r);
+
+        pthread_mutex_lock(&h->mu);
+        if (rc != 0) h->failed++;
+        h->in_flight--;
+        pthread_cond_broadcast(&h->done_cv);
+        pthread_mutex_unlock(&h->mu);
+        free(r->path);
+        free(r);
+    }
+}
+
+ds_aio_t *ds_aio_create(int nthreads) {
+    if (nthreads < 1) nthreads = 1;
+    ds_aio_t *h = (ds_aio_t *)calloc(1, sizeof(ds_aio_t));
+    pthread_mutex_init(&h->mu, NULL);
+    pthread_cond_init(&h->work_cv, NULL);
+    pthread_cond_init(&h->done_cv, NULL);
+    h->nthreads = nthreads;
+    h->threads = (pthread_t *)calloc((size_t)nthreads, sizeof(pthread_t));
+    for (int i = 0; i < nthreads; i++)
+        pthread_create(&h->threads[i], NULL, worker, h);
+    return h;
+}
+
+int ds_aio_submit(ds_aio_t *h, const char *path, char *buf, int64_t nbytes,
+                  int64_t offset, int is_write) {
+    req_t *r = (req_t *)malloc(sizeof(req_t));
+    if (!r) return -1;
+    r->path = strdup(path);
+    r->buf = buf;
+    r->nbytes = nbytes;
+    r->offset = offset;
+    r->is_write = is_write;
+    r->next = NULL;
+    pthread_mutex_lock(&h->mu);
+    if (h->tail) h->tail->next = r; else h->head = r;
+    h->tail = r;
+    h->in_flight++;
+    pthread_cond_signal(&h->work_cv);
+    pthread_mutex_unlock(&h->mu);
+    return 0;
+}
+
+int64_t ds_aio_wait(ds_aio_t *h) {
+    pthread_mutex_lock(&h->mu);
+    while (h->in_flight > 0)
+        pthread_cond_wait(&h->done_cv, &h->mu);
+    int64_t failed = h->failed;
+    h->failed = 0;
+    pthread_mutex_unlock(&h->mu);
+    return failed;
+}
+
+void ds_aio_destroy(ds_aio_t *h) {
+    pthread_mutex_lock(&h->mu);
+    h->shutdown = 1;
+    pthread_cond_broadcast(&h->work_cv);
+    pthread_mutex_unlock(&h->mu);
+    for (int i = 0; i < h->nthreads; i++)
+        pthread_join(h->threads[i], NULL);
+    free(h->threads);
+    pthread_mutex_destroy(&h->mu);
+    pthread_cond_destroy(&h->work_cv);
+    pthread_cond_destroy(&h->done_cv);
+    free(h);
+}

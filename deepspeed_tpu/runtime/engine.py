@@ -163,9 +163,8 @@ class DeepSpeedEngine:
         # ---- ZeRO-Offload (optimizer state in host DRAM) -----------------
         off = self._config.zero_optimization.offload_optimizer
         self.offload_optimizer = off.device in ("cpu", "nvme")
-        if off.device == "nvme":
-            logger.warning("offload_optimizer.device='nvme': NVMe tier not yet implemented; "
-                           "optimizer state will live in host DRAM (cpu offload)")
+        if off.device == "nvme" and not off.nvme_path:
+            raise ValueError("offload_optimizer.device='nvme' requires nvme_path")
         if self.offload_optimizer and self.mesh.shape[dist.PIPE_AXIS] > 1:
             raise NotImplementedError("offload_optimizer does not yet compose with "
                                       "pipeline_parallel_size > 1")
@@ -298,18 +297,30 @@ class DeepSpeedEngine:
         raise ValueError("Provide model_parameters or a model with init_params(rng)")
 
     def _init_host_optimizer(self, params_f32):
-        """ZeRO-Offload: move fp32 master + moments to host, return the
-        compute-dtype device params that replace them in TrainState. HBM
-        afterwards holds only ~2 bytes/param instead of 16."""
+        """ZeRO-Offload: move fp32 master + moments to host DRAM (or NVMe —
+        ZeRO-Infinity), return the compute-dtype device params that replace
+        them in TrainState. HBM afterwards holds only ~2 bytes/param instead
+        of 16 (and with NVMe, host DRAM holds only a rotating leaf window)."""
         from .zero.offload import HostOffloadOptimizer
-        self.host_opt = HostOffloadOptimizer(self._config.optimizer, self.lr_schedule_fn)
+        off = self._config.zero_optimization.offload_optimizer
+        if off.device == "nvme":
+            from .swap_tensor import NVMeOffloadOptimizer, get_aio_config
+            self.host_opt = NVMeOffloadOptimizer(
+                self._config.optimizer, self.lr_schedule_fn, nvme_path=off.nvme_path,
+                aio_config=get_aio_config(self._config.raw_config),
+                pipeline_read=bool(off.pipeline_read),
+                pipeline_write=bool(off.pipeline_write))
+            self.host_opt.compute_dtype = self.compute_dtype
+        else:
+            self.host_opt = HostOffloadOptimizer(self._config.optimizer, self.lr_schedule_fn)
         self.host_opt.init_from_device(params_f32)
         shardings = self.planner.shardings(self.planner.master_specs(params_f32))
         cast = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype), p),
                        donate_argnums=(0, ), out_shardings=shardings)
         with self.mesh:
             compute_params = cast(params_f32)
-        log_dist(f"ZeRO-Offload: {self.host_opt.num_params():,} params' optimizer state on host "
+        tier = "NVMe" if off.device == "nvme" else "host DRAM"
+        log_dist(f"ZeRO-Offload: {self.host_opt.num_params():,} params' optimizer state on {tier} "
                  f"(native cpu_adam), {jnp.dtype(self.compute_dtype).name} compute copy in HBM", [0])
         return compute_params
 
@@ -994,9 +1005,9 @@ class DeepSpeedEngine:
         _save(save_dir, tag, self.state._replace(grad_acc={}), client_sd, save_latest=save_latest,
               use_async=self._config.checkpoint.async_save)
         if self.offload_optimizer and jax.process_index() == 0:
-            # host-resident master/moments ride next to the device state
-            np.savez(os.path.join(save_dir, str(tag), "host_optimizer.npz"),
-                     **self.host_opt.state_dict_arrays())
+            # offloaded master/moments ride next to the device state (npz for
+            # the DRAM tier; streamed file copies for the NVMe tier)
+            self.host_opt.save_to(os.path.join(save_dir, str(tag)))
         log_dist(f"saved checkpoint {save_dir}/{tag}", [0])
         return True
 
@@ -1020,22 +1031,14 @@ class DeepSpeedEngine:
         if self.offload_optimizer:
             tag_used = tag or client_sd.get("__tag__") or None
             from .checkpoint_engine.engine import get_latest_tag
-            npz = os.path.join(os.path.abspath(load_dir), str(tag_used or get_latest_tag(load_dir)),
-                               "host_optimizer.npz")
-            if os.path.isfile(npz) and load_optimizer_states:
-                with np.load(npz) as arrays:
-                    self.host_opt.load_state_dict_arrays(arrays)
-            else:
-                logger.warning("offload_optimizer: checkpoint has no host_optimizer.npz "
-                               "(saved without offload?); rebuilding fp32 master from loaded "
-                               "params with fresh moments")
-                for dst, src in zip(jax.tree_util.tree_leaves(self.host_opt.master),
-                                    jax.tree_util.tree_leaves(self.state.params)):
-                    dst[...] = np.asarray(jax.device_get(src), dtype=np.float32)
-                for t in (self.host_opt.m, self.host_opt.v):
-                    for leaf in jax.tree_util.tree_leaves(t):
-                        leaf[...] = 0
-                self.host_opt.t = client_sd.get("global_steps", 0)
+            tag_dir = os.path.join(os.path.abspath(load_dir),
+                                   str(tag_used or get_latest_tag(load_dir)))
+            if not (load_optimizer_states and self.host_opt.load_from(tag_dir)):
+                logger.warning("offload_optimizer: checkpoint carries no offloaded optimizer "
+                               "state (saved without offload?); rebuilding fp32 master from "
+                               "loaded params with fresh moments")
+                self.host_opt.reset_from_params(self.state.params,
+                                                client_sd.get("global_steps", 0))
             # device params re-derive from master so both views agree exactly
             self.state = self.state._replace(params=self.host_opt.compute_params(
                 self.compute_dtype, self.state_shardings.params))

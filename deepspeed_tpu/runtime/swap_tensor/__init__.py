@@ -1,0 +1,2 @@
+from .aio_config import get_aio_config  # noqa: F401
+from .optimizer_swapper import NVMeOffloadOptimizer  # noqa: F401

@@ -108,6 +108,34 @@ class HostOffloadOptimizer:
         return out
 
     # ---- checkpoint ------------------------------------------------------
+    def save_to(self, tag_dir):
+        """Persist master/m/v next to the device checkpoint."""
+        import os
+        np.savez(os.path.join(tag_dir, "host_optimizer.npz"), **self.state_dict_arrays())
+
+    def load_from(self, tag_dir):
+        """Restore from ``save_to`` output; False when the checkpoint carries
+        no offloaded optimizer state."""
+        import os
+        p = os.path.join(tag_dir, "host_optimizer.npz")
+        if not os.path.isfile(p):
+            return False
+        with np.load(p) as arrays:
+            self.load_state_dict_arrays(arrays)
+        return True
+
+    def reset_from_params(self, params, step):
+        """Rebuild fp32 master from (already-loaded) device params with
+        fresh moments — the fallback when a checkpoint was saved without
+        offload."""
+        for dst, src in zip(jax.tree_util.tree_leaves(self.master),
+                            jax.tree_util.tree_leaves(params)):
+            dst[...] = np.asarray(jax.device_get(src), dtype=np.float32)
+        for t in (self.m, self.v):
+            for leaf in jax.tree_util.tree_leaves(t):
+                leaf[...] = 0
+        self.t = step
+
     def state_dict_arrays(self):
         """Flat {path: np.ndarray} for np.savez (checkpoint sidecar)."""
         out = {"__step__": np.asarray(self.t, np.int64)}

@@ -1,0 +1,167 @@
+"""ZeRO-Infinity (NVMe optimizer swap) + native AIO tests.
+
+Pattern from the reference suite: tests/unit/ops/aio/test_aio.py (handle
+read/write parity) and tests/unit/runtime/zero/test_zero_nvme_offloading —
+NVMe-offloaded training must match the host-DRAM offload numerics exactly
+(same C AdamW, different residence), and checkpoints must round-trip.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+from .simple_model import SimpleModel, random_batch
+
+HIDDEN = 64
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2, "weight_decay": 0.01}},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 1000,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def make_engine(config, seed=0):
+    comm._state["mesh"] = None
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config, rng_seed=seed)
+    return engine
+
+
+def train_losses(engine, steps=4):
+    losses = []
+    for i in range(steps):
+        batch = random_batch(engine.train_batch_size(), HIDDEN, seed=100 + i % 2)
+        losses.append(float(engine.train_batch(batch=batch)))
+    return losses
+
+
+# ---- native AIO handle -------------------------------------------------
+
+def test_aio_roundtrip(tmp_path):
+    h = AsyncIOHandle(block_size=4096, thread_count=2)
+    src = np.random.default_rng(0).standard_normal(10000).astype(np.float32)
+    f = str(tmp_path / "blob.bin")
+    h.async_pwrite(src, f)
+    h.wait()
+    dst = np.empty_like(src)
+    h.async_pread(dst, f)
+    h.wait()
+    np.testing.assert_array_equal(src, dst)
+    h.close()
+
+
+def test_aio_many_blocks_and_offsets(tmp_path):
+    h = AsyncIOHandle(block_size=1024, thread_count=4)
+    f = str(tmp_path / "blob.bin")
+    a = np.arange(5000, dtype=np.int64)
+    b = np.arange(5000, 9096, dtype=np.int64)
+    h.async_pwrite(a, f)
+    h.wait()
+    h.async_pwrite(b, f, file_offset=a.nbytes)
+    h.wait()
+    out = np.empty(9096, np.int64)
+    h.sync_pread(out, f)
+    np.testing.assert_array_equal(out, np.arange(9096, dtype=np.int64))
+    h.close()
+
+
+def test_aio_read_missing_file_raises(tmp_path):
+    h = AsyncIOHandle(thread_count=1)
+    buf = np.empty(16, np.float32)
+    h.async_pread(buf, str(tmp_path / "nope.bin"))
+    with pytest.raises(OSError):
+        h.wait()
+    h.close()
+
+
+# ---- NVMe optimizer tier ----------------------------------------------
+
+def nvme_config(tmp_path, **offload_over):
+    off = {"device": "nvme", "nvme_path": str(tmp_path), "pipeline_read": True,
+           "pipeline_write": True}
+    off.update(offload_over)
+    return base_config(zero_optimization={"stage": 2, "offload_optimizer": off},
+                       aio={"block_size": 65536, "thread_count": 2})
+
+
+def test_nvme_offload_matches_cpu_offload(tmp_path):
+    cpu = train_losses(make_engine(base_config(
+        zero_optimization={"stage": 2, "offload_optimizer": {"device": "cpu"}})))
+    nvme = train_losses(make_engine(nvme_config(tmp_path)))
+    np.testing.assert_allclose(cpu, nvme, rtol=1e-6)  # same C AdamW, same math
+    # state actually lives under nvme_path
+    swap = os.path.join(str(tmp_path), "zero_stage_opt_swap")
+    files = os.listdir(swap)
+    assert any(f.endswith(".master") for f in files)
+    assert any(f.endswith(".m") for f in files) and any(f.endswith(".v") for f in files)
+
+
+def test_nvme_offload_unpipelined_matches(tmp_path):
+    piped = train_losses(make_engine(nvme_config(tmp_path / "a")))
+    unpiped = train_losses(make_engine(nvme_config(tmp_path / "b", pipeline_read=False,
+                                                  pipeline_write=False)))
+    np.testing.assert_allclose(piped, unpiped, rtol=0)
+
+
+def test_nvme_offload_checkpoint_roundtrip(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    e1 = make_engine(nvme_config(tmp_path / "swap1"))
+    train_losses(e1, steps=3)
+    e1.save_checkpoint(ckpt, tag="t1")
+    cont1 = train_losses(e1, steps=2)
+
+    e2 = make_engine(nvme_config(tmp_path / "swap2"))
+    e2.load_checkpoint(ckpt, tag="t1")
+    cont2 = train_losses(e2, steps=2)
+    np.testing.assert_allclose(cont1, cont2, rtol=1e-6)
+
+
+def test_nvme_restore_from_cpu_tier_checkpoint(tmp_path):
+    """Cross-tier resume: checkpoint saved with cpu offload (npz) restores
+    into an NVMe-tier engine."""
+    ckpt = str(tmp_path / "ckpt")
+    e1 = make_engine(base_config(zero_optimization={
+        "stage": 2, "offload_optimizer": {"device": "cpu"}}))
+    train_losses(e1, steps=3)
+    e1.save_checkpoint(ckpt, tag="t1")
+    cont1 = train_losses(e1, steps=2)
+
+    e2 = make_engine(nvme_config(tmp_path / "swap"))
+    e2.load_checkpoint(ckpt, tag="t1")
+    cont2 = train_losses(e2, steps=2)
+    np.testing.assert_allclose(cont1, cont2, rtol=1e-6)
+
+
+def test_nvme_restore_from_offloadless_checkpoint(tmp_path):
+    """Checkpoint saved WITHOUT offload: NVMe engine rebuilds master from the
+    loaded params (not from its own stale init) with fresh moments."""
+    ckpt = str(tmp_path / "ckpt")
+    e1 = make_engine(base_config())
+    train_losses(e1, steps=3)
+    e1.save_checkpoint(ckpt, tag="t1")
+    ref_loss = float(e1.train_batch(batch=random_batch(e1.train_batch_size(), HIDDEN, seed=100)))
+
+    e2 = make_engine(nvme_config(tmp_path / "swap"))
+    e2.load_checkpoint(ckpt, tag="t1")
+    got_loss = float(e2.train_batch(batch=random_batch(e2.train_batch_size(), HIDDEN, seed=100)))
+    # same params -> same forward loss (the moment reset only affects the
+    # update applied after the loss is computed)
+    np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-5)
+
+
+def test_nvme_requires_path():
+    with pytest.raises(ValueError, match="nvme_path"):
+        make_engine(base_config(zero_optimization={
+            "stage": 2, "offload_optimizer": {"device": "nvme"}}))
