@@ -69,6 +69,10 @@ class AsyncIOHandle:
     One handle owns one native thread pool. Buffers passed to the async calls
     MUST stay alive (and unmodified, for writes) until ``wait()`` returns —
     the same contract as the reference's pinned-tensor handle.
+
+    ``queue_depth``/``overlap_events`` are recorded for reference config
+    parity but advisory: the pool's queue is unbounded and overlap comes
+    from its threads (see ``runtime/swap_tensor/aio_config.py``).
     """
 
     def __init__(self, block_size=1048576, queue_depth=8, single_submit=False,
@@ -122,20 +126,27 @@ class AsyncIOHandle:
 
     def wait(self):
         if self._h is None:
-            for arr, filename, is_write, off in self._pending_sync:
-                view = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
-                if is_write:
-                    with open(filename, "r+b" if os.path.exists(filename) else "wb") as f:
-                        f.seek(off)
-                        f.write(view.tobytes())
-                else:
-                    with open(filename, "rb") as f:
-                        f.seek(off)
-                        data = f.read(view.nbytes)
-                    view[:] = np.frombuffer(data, np.uint8)
+            first_err = None
             n = len(self._pending_sync)
+            for arr, filename, is_write, off in self._pending_sync:
+                try:
+                    view = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+                    if is_write:
+                        with open(filename, "r+b" if os.path.exists(filename) else "wb") as f:
+                            f.seek(off)
+                            f.write(view.tobytes())
+                    else:
+                        with open(filename, "rb") as f:
+                            f.seek(off)
+                            data = f.read(view.nbytes)
+                        view[:] = np.frombuffer(data, np.uint8)
+                except OSError as e:
+                    first_err = first_err or e
+            # always drain: a failed request must not wedge the handle
             self._pending_sync.clear()
             self._keepalive.clear()
+            if first_err is not None:
+                raise OSError(f"async IO request failed: {first_err}") from first_err
             return n
         failed = self._lib.ds_aio_wait(self._h)
         self._keepalive.clear()

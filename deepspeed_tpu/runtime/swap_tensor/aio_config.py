@@ -1,6 +1,12 @@
 """``aio`` config section (reference ``runtime/swap_tensor/aio_config.py`` /
 ``constants.py``: AIO_BLOCK_SIZE .. AIO_OVERLAP_EVENTS — same keys, same
-defaults)."""
+defaults).
+
+``block_size``, ``thread_count`` and ``single_submit`` drive the native pool
+directly. ``queue_depth`` and ``overlap_events`` are accepted for config
+parity but advisory here: the pthread pool's request queue is unbounded and
+read/write overlap comes from the dual read/write handles, not from a
+libaio-style event window."""
 
 AIO_BLOCK_SIZE = "block_size"
 AIO_QUEUE_DEPTH = "queue_depth"

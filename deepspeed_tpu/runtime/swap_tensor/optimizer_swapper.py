@@ -71,20 +71,20 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
         leaves, treedef = jax.tree_util.tree_flatten(params_f32)
         self._treedef = treedef
         self._stores = []
-        zeros_reuse = {}
+        zeros = np.zeros(max(int(np.prod(l.shape)) for l in leaves), np.float32)
+        window = 0
         for i, leaf in enumerate(leaves):
             host = np.array(jax.device_get(leaf), dtype=np.float32, copy=True)
             store = _LeafStore(self.swap_dir, i, host.shape)
-            self._write_h.async_pwrite(host, store.paths["master"])
-            self._write_h.wait()  # host buffer is reused next iteration
-            z = zeros_reuse.get(host.nbytes)
-            if z is None:
-                z = np.zeros(host.size, np.float32)
-                zeros_reuse = {host.nbytes: z}  # keep only the largest-so-far
+            self._write_h.async_pwrite(host, store.paths["master"])  # keepalive pins host
             for kind in ("m", "v"):
-                self._write_h.async_pwrite(z[:host.size], store.paths[kind])
-                self._write_h.wait()
+                self._write_h.async_pwrite(zeros[:host.size], store.paths[kind])
             self._stores.append(store)
+            window += 1
+            if window >= 4:  # bound pinned DRAM to a few leaves, keep IO deep
+                self._write_h.wait()
+                window = 0
+        self._write_h.wait()
         total = sum(int(np.prod(s.shape)) for s in self._stores)
         log_dist(f"ZeRO-Infinity: {total:,} params' optimizer state on NVMe "
                  f"({3 * total * 4 / 2**30:.2f} GiB under {self.swap_dir})", ranks=[0])

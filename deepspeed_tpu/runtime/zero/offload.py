@@ -114,15 +114,35 @@ class HostOffloadOptimizer:
         np.savez(os.path.join(tag_dir, "host_optimizer.npz"), **self.state_dict_arrays())
 
     def load_from(self, tag_dir):
-        """Restore from ``save_to`` output; False when the checkpoint carries
-        no offloaded optimizer state."""
+        """Restore from ``save_to`` output — this tier's npz, or an NVMe-tier
+        ``nvme_optimizer/`` directory (cross-tier resume works both ways);
+        False when the checkpoint carries no offloaded optimizer state."""
+        import json
         import os
         p = os.path.join(tag_dir, "host_optimizer.npz")
-        if not os.path.isfile(p):
-            return False
-        with np.load(p) as arrays:
-            self.load_state_dict_arrays(arrays)
-        return True
+        if os.path.isfile(p):
+            with np.load(p) as arrays:
+                self.load_state_dict_arrays(arrays)
+            return True
+        nv = os.path.join(tag_dir, "nvme_optimizer")
+        if os.path.isdir(nv):
+            with open(os.path.join(nv, "meta.json")) as f:
+                meta = json.load(f)
+            trees = {"master": self.master, "m": self.m, "v": self.v}
+            for kind, tree in trees.items():
+                leaves = jax.tree_util.tree_leaves(tree)
+                if len(leaves) != len(meta["leaves"]):
+                    raise ValueError(f"nvme optimizer checkpoint has {len(meta['leaves'])} "
+                                     f"leaves; the model expects {len(leaves)}")
+                for i, leaf in enumerate(leaves):
+                    path = os.path.join(nv, f"leaf{i:05d}.{kind}")
+                    data = np.fromfile(path, dtype=np.float32)
+                    if data.size != leaf.size:
+                        raise ValueError(f"{path}: {data.size} values != leaf size {leaf.size}")
+                    leaf[...] = data.reshape(leaf.shape)
+            self.t = int(meta["step"])
+            return True
+        return False
 
     def reset_from_params(self, params, step):
         """Rebuild fp32 master from (already-loaded) device params with

@@ -1,0 +1,141 @@
+"""Block-sparse attention: layout configs + kernel parity vs masked dense.
+
+Mirrors the reference's sparse-attention tests (tests/unit/ops/sparse_attention/
+test_sparse_attention.py compares Triton block-sparse matmul/softmax against
+dense torch with the layout-expanded mask); here the whole fused kernel is
+compared against XLA dense attention under the same mask, values and grads.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig, SparseSelfAttention,
+    VariableSparsityConfig, make_block_sparse_attention)
+
+B, H, T, D = 2, 2, 256, 64
+BLOCK = 32
+
+
+def dense_reference(q, k, v, layout, block, causal):
+    """XLA attention with the block layout expanded to a position mask."""
+    mask = np.kron(layout, np.ones((block, block), dtype=bool))  # (H, T, T)
+    if causal:
+        mask = mask & np.tril(np.ones((T, T), dtype=bool))[None]
+    bias = jnp.where(jnp.asarray(mask)[None], 0.0, -jnp.inf)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D) + bias
+    # rows with no visible positions: output 0 (kernel's l==0 guard)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(jnp.isnan(p), 0.0, p)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def qkv(seed=0, t=T):
+    r = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(r.standard_normal((B, H, t, D)), jnp.float32)
+    return mk(), mk(), mk()
+
+
+CONFIGS = [
+    ("fixed-uni", FixedSparsityConfig(H, block=BLOCK, num_local_blocks=2,
+                                      attention="unidirectional")),
+    ("fixed-bi", FixedSparsityConfig(H, block=BLOCK, num_local_blocks=2,
+                                     attention="bidirectional",
+                                     horizontal_global_attention=True)),
+    ("bigbird", BigBirdSparsityConfig(H, block=BLOCK, num_random_blocks=1,
+                                      num_sliding_window_blocks=3, num_global_blocks=1)),
+    ("bslongformer", BSLongformerSparsityConfig(H, block=BLOCK, num_sliding_window_blocks=3,
+                                                global_block_indices=[0, 5])),
+    ("variable", VariableSparsityConfig(H, block=BLOCK, num_random_blocks=1,
+                                        local_window_blocks=[1, 2],
+                                        global_block_indices=[0])),
+    ("sliding", LocalSlidingWindowSparsityConfig(H, block=BLOCK, num_sliding_window_blocks=3,
+                                                 attention="unidirectional")),
+    ("dense", DenseSparsityConfig(H, block=BLOCK)),
+]
+
+
+@pytest.mark.parametrize("name,cfg", CONFIGS, ids=[c[0] for c in CONFIGS])
+def test_kernel_matches_masked_dense(name, cfg):
+    layout = cfg.make_layout(T)
+    causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
+    q, k, v = qkv()
+    out = make_block_sparse_attention(layout, BLOCK, causal=causal)(q, k, v)
+    ref = dense_reference(q, k, v, layout, BLOCK, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("name,cfg", CONFIGS[:3], ids=[c[0] for c in CONFIGS[:3]])
+def test_kernel_gradients_match(name, cfg):
+    layout = cfg.make_layout(T)
+    causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
+    attend = make_block_sparse_attention(layout, BLOCK, causal=causal)
+    q, k, v = qkv(1)
+    w = jnp.asarray(np.random.default_rng(9).standard_normal((B, H, T, D)), jnp.float32)
+
+    g1 = jax.grad(lambda q, k, v: jnp.sum(attend(q, k, v) * w), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: jnp.sum(dense_reference(q, k, v, layout, BLOCK, causal) * w),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b, tag in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5, rtol=5e-5,
+                                   err_msg=f"d{tag}")
+
+
+def test_ragged_tail_is_masked():
+    cfg = FixedSparsityConfig(H, block=BLOCK, num_local_blocks=2, attention="unidirectional")
+    t = T - 8  # not a block multiple: kernel pads, positions >= t must not leak
+    layout = cfg.make_layout(T)
+    attend = make_block_sparse_attention(layout, BLOCK, causal=True)
+    q, k, v = qkv(2, t=t)
+    out = np.asarray(attend(q, k, v))
+    # reference on the unpadded shapes with the layout cropped positionally
+    mask = np.kron(layout, np.ones((BLOCK, BLOCK), dtype=bool))[:, :t, :t]
+    mask = mask & np.tril(np.ones((t, t), dtype=bool))[None]
+    bias = jnp.where(jnp.asarray(mask)[None], 0.0, -jnp.inf)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / np.sqrt(D) + bias
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v.astype(jnp.float32))
+    np.testing.assert_allclose(out, np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_sparse_self_attention_module():
+    cfg = BSLongformerSparsityConfig(H, block=BLOCK, num_sliding_window_blocks=3)
+    ssa = SparseSelfAttention(cfg)
+    q, k, v = qkv(4)
+    out = ssa(q, k, v)
+    assert out.shape == (B, H, T, D)
+    assert len(ssa._cache) == 1
+    ssa(q, k, v)
+    assert len(ssa._cache) == 1  # layout/kernel cached per seq_len
+
+
+def test_layout_shapes_and_density():
+    cfg = LocalSlidingWindowSparsityConfig(4, block=16, num_sliding_window_blocks=3)
+    layout = cfg.make_layout(256)
+    assert layout.shape == (4, 16, 16)
+    dense = DenseSparsityConfig(4, block=16).make_layout(256)
+    assert layout.sum() < dense.sum() * 0.35  # actually sparse
+    # unidirectional: nothing above the diagonal
+    assert np.triu(layout[0], 1).sum() == 0
+
+
+def test_fully_masked_row_outputs_zero():
+    """A causal q-block row whose only active blocks are strictly in the
+    future must produce zeros (not the mean of masked V)."""
+    nb = T // BLOCK
+    layout = np.zeros((H, nb, nb), np.int64)
+    layout[:, :, :] = np.eye(nb, dtype=np.int64)
+    layout[:, 0, :] = 0
+    layout[:, 0, nb - 1] = 1  # row 0 attends only the last (future) block
+    q, k, v = qkv(7)
+    out = np.asarray(make_block_sparse_attention(layout, BLOCK, causal=True)(q, k, v))
+    np.testing.assert_array_equal(out[:, :, :BLOCK], 0.0)
+    assert np.abs(out[:, :, BLOCK:]).sum() > 0  # other rows still attend
+
+
+def test_seq_len_must_divide_block():
+    with pytest.raises(ValueError, match="multiple of block"):
+        FixedSparsityConfig(2, block=32).make_layout(100)

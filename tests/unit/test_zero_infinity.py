@@ -144,6 +144,22 @@ def test_nvme_restore_from_cpu_tier_checkpoint(tmp_path):
     np.testing.assert_allclose(cont1, cont2, rtol=1e-6)
 
 
+def test_cpu_restore_from_nvme_tier_checkpoint(tmp_path):
+    """Cross-tier resume the other way: NVMe-tier checkpoint restores into a
+    cpu-tier engine without losing Adam moments."""
+    ckpt = str(tmp_path / "ckpt")
+    e1 = make_engine(nvme_config(tmp_path / "swap"))
+    train_losses(e1, steps=3)
+    e1.save_checkpoint(ckpt, tag="t1")
+    cont1 = train_losses(e1, steps=2)
+
+    e2 = make_engine(base_config(zero_optimization={
+        "stage": 2, "offload_optimizer": {"device": "cpu"}}))
+    e2.load_checkpoint(ckpt, tag="t1")
+    cont2 = train_losses(e2, steps=2)
+    np.testing.assert_allclose(cont1, cont2, rtol=1e-6)
+
+
 def test_nvme_restore_from_offloadless_checkpoint(tmp_path):
     """Checkpoint saved WITHOUT offload: NVMe engine rebuilds master from the
     loaded params (not from its own stale init) with fresh moments."""
