@@ -1,0 +1,93 @@
+"""Pallas weight-only-quantized matmul (w8a16 / w4-ready).
+
+Serving counterpart of the reference's CUDA dequant+GEMM inference kernels
+(``csrc/transformer/inference/csrc/gelu.cu`` fused bias/dequant paths and the
+``ds_quantizer`` ops): activations stay bf16, weights stream from HBM as
+int8 and are dequantized block-by-block in VMEM right before the MXU — the
+bf16 weight matrix never exists in HBM, halving weight bandwidth (the
+decode-time bottleneck).
+
+Layout: x (M, K) bf16; qw (K, N) int8; scales (G, N) fp32 with group size
+K/G along the contraction dim. Requires block_k <= group size and
+group_size % block_k == 0 so each k-block sees one scale row.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret():
+    return jax.default_backend() == "cpu"
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    # dequantize the int8 block in VMEM: one fp32 scale row per k-block (the
+    # scale rows arrive 8x-replicated to satisfy Mosaic's sublane tiling;
+    # row 0 of the block is the group's scale)
+    w = w_ref[...].astype(jnp.float32) * s_ref[0:1, :]
+    acc_ref[...] += jax.lax.dot_general(x_ref[...], w.astype(x_ref.dtype),
+                                        (((1, ), (0, )), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    @pl.when(k == nk - 1)
+    def _flush():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def quant_matmul(x, qw, scales, block_m=256, block_n=256, block_k=512, out_dtype=None):
+    """``x @ dequantize(qw, scales)`` without materializing the bf16 weight.
+
+    x: (M, K); qw: (K, N) int8; scales: (G, N) fp32, G | K. Returns (M, N)
+    in ``out_dtype`` (defaults to x.dtype)."""
+    M, K = x.shape
+    K2, N = qw.shape
+    scales = jnp.asarray(scales, jnp.float32)
+    if scales.ndim == 3 and scales.shape[1] == 1:
+        scales = scales[:, 0, :]  # accept quantize()'s (G, 1, N) directly
+    if scales.ndim != 2:
+        raise ValueError(f"scales must be (G, N), got shape {scales.shape}")
+    G = scales.shape[0]
+    if K != K2:
+        raise ValueError(f"x K={K} != qw K={K2}")
+    if scales.shape[1] != N:
+        raise ValueError(f"scales N={scales.shape[1]} != weight N={N}")
+    if K % G != 0:
+        raise ValueError(f"groups {G} must divide K={K}")
+    gsize = K // G
+    block_m = min(block_m, M)
+    block_n = min(block_n, N)
+    block_k = min(block_k, gsize)
+    if gsize % block_k != 0:
+        raise ValueError(f"group size {gsize} must be a multiple of block_k {block_k}")
+    if M % block_m or N % block_n or K % block_k:
+        raise ValueError(f"shape ({M},{K})x({K},{N}) not divisible by blocks "
+                         f"({block_m},{block_k},{block_n})")
+    out_dtype = out_dtype or x.dtype
+    nk = K // block_k
+    # 8x-replicate scale rows: Mosaic block shapes need >=8 sublanes, and a
+    # (G, N) array cannot hand out (1, block_n) blocks
+    scales8 = jnp.repeat(scales, 8, axis=0)
+
+    return pl.pallas_call(
+        functools.partial(_qmm_kernel, nk=nk),
+        grid=(M // block_m, N // block_n, nk),
+        in_specs=[
+            pl.BlockSpec((block_m, block_k), lambda i, j, k: (i, k)),
+            pl.BlockSpec((block_k, block_n), lambda i, j, k: (k, j)),
+            pl.BlockSpec((8, block_n), lambda i, j, k: (k * block_k // gsize, j)),
+        ],
+        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
+        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        interpret=_interpret(),
+    )(x, qw, scales8)

@@ -1,0 +1,68 @@
+"""Quantization ops: group-wise symmetric/asymmetric int quantize/dequantize.
+
+Counterpart of the reference's ``deepspeed/ops/quantizer`` (CUDA
+``ds_quantizer``: ``csrc/quantization/pt_binding.cpp`` quantize/sr_quantize
+with grouped scales). On TPU the offline direction (weights -> int8) is plain
+XLA below; the *serving* direction — matmul against int8 weights without
+ever materializing the bf16 dequantized matrix in HBM — is the Pallas kernel
+in ``ops/pallas/quant_matmul.py``.
+
+Convention: per-group scales along the contraction (first) axis of a
+(K, N) weight; ``groups`` divides K. Symmetric: q = round(w / s),
+s = max|w| / (2^(b-1) - 1) per (group, column).
+"""
+
+import jax.numpy as jnp
+
+
+def _group_reshape(w, groups):
+    K = w.shape[0]
+    if K % groups != 0:
+        raise ValueError(f"groups {groups} must divide contraction dim {K}")
+    return w.reshape(groups, K // groups, *w.shape[1:])
+
+
+def quantize(w, bits=8, groups=1, symmetric=True):
+    """w: (K, ...) float -> (q int8, scale fp32, zero fp32 or None).
+
+    ``scale``/``zero`` have shape (groups, 1, ...) broadcastable against the
+    grouped weight."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    qmax = 2.0**(bits - 1) - 1
+    wg = _group_reshape(jnp.asarray(w, jnp.float32), groups)
+    if symmetric:
+        scale = jnp.max(jnp.abs(wg), axis=1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = jnp.clip(jnp.round(wg / scale), -qmax - 1, qmax)
+        return q.reshape(w.shape).astype(jnp.int8), scale, None
+    lo = jnp.min(wg, axis=1, keepdims=True)
+    hi = jnp.max(wg, axis=1, keepdims=True)
+    scale = (hi - lo) / (2.0**bits - 1)
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round((wg - lo) / scale) - 2.0**(bits - 1), -qmax - 1, qmax)
+    zero = lo + scale * 2.0**(bits - 1)
+    return q.reshape(w.shape).astype(jnp.int8), scale, zero
+
+
+def dequantize(q, scale, zero=None, groups=None, dtype=jnp.bfloat16):
+    """Inverse of :func:`quantize` (group count inferred from ``scale``)."""
+    g = scale.shape[0] if groups is None else groups
+    qg = _group_reshape(jnp.asarray(q, jnp.float32), g)
+    w = qg * scale if zero is None else qg * scale + zero
+    return w.reshape(q.shape).astype(dtype)
+
+
+class Quantizer:
+    """Stateful façade mirroring the reference's ``ds_quantizer`` call shape."""
+
+    def __init__(self, bits=8, groups=1, symmetric=True):
+        self.bits = bits
+        self.groups = groups
+        self.symmetric = symmetric
+
+    def quantize(self, w):
+        return quantize(w, self.bits, self.groups, self.symmetric)
+
+    def dequantize(self, q, scale, zero=None, dtype=jnp.bfloat16):
+        return dequantize(q, scale, zero, self.groups, dtype)
