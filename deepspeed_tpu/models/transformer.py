@@ -505,7 +505,7 @@ class CausalLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
                  cache_index=None, position_ids=None, return_hidden=False,
-                 pld_theta=None, pld_rng=None):
+                 pld_theta=None, pld_rng=None, ltd_keep=None, ltd_layers=(), ltd_rng=None):
         """``kv_cache``: optional per-layer (k, v) with leading layer dim —
         shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
         stack. Returns logits, or (logits, new_kv_cache) when caching, or the
@@ -545,12 +545,38 @@ class CausalLM(nn.Module):
             keep = jax.random.bernoulli(jax.random.fold_in(pld_rng, layer_idx), keep_p)
             return jnp.where(keep, y, x_in)
 
+        # random layerwise token dropping (reference data_routing/basic_layer.py
+        # RandomLayerTokenDrop): selected layers process a random sorted subset
+        # of ltd_keep tokens; dropped tokens ride the residual stream. Sorted
+        # gather preserves causal order, and RoPE uses the original positions
+        # via position_ids. Requires rope/none positions (learned pos are
+        # added before the layer stack, so they survive the gather too).
+        ltd_active = (ltd_keep is not None and ltd_rng is not None and ltd_keep < T
+                      and kv_cache is None)
+
+        def ltd_apply(block_fn, x, layer_idx):
+            idx = jnp.sort(jax.random.permutation(jax.random.fold_in(ltd_rng, layer_idx), T)[:ltd_keep])
+            pos = jnp.broadcast_to(idx[None], (B, ltd_keep))
+            x_sub = jnp.take(x, idx, axis=1)
+            m_sub = None if attn_mask is None else jnp.take(attn_mask, idx, axis=1)
+            y_sub, c = block_fn(x_sub, m_sub, pos)
+            return x.at[:, idx].set(y_sub.astype(x.dtype)), c
+
         new_cache = None
         if cfg.scan_layers:
             def scan_body(mdl, carry, xs):
                 layer_cache, layer_idx = xs
-                y, c = mdl(carry, sin, cos, attn_mask, deterministic,
-                           layer_cache, cache_index, position_ids)
+                if ltd_active:
+                    # scan shares one program across layers, so LTD applies to
+                    # every scanned layer (per-layer opt-out needs
+                    # scan_layers=False)
+                    y, c = ltd_apply(
+                        lambda xs_, ms_, ps_: mdl(xs_, sin, cos, ms_, deterministic,
+                                                  layer_cache, cache_index, ps_),
+                        carry, layer_idx)
+                else:
+                    y, c = mdl(carry, sin, cos, attn_mask, deterministic,
+                               layer_cache, cache_index, position_ids)
                 return apply_pld(y, carry, layer_idx), c
 
             x, new_cache = nn.scan(
@@ -564,8 +590,15 @@ class CausalLM(nn.Module):
             caches = []
             for i in range(cfg.num_layers):
                 layer_cache = None if kv_cache is None else jax.tree_util.tree_map(lambda c: c[i], kv_cache)
-                y, c = block(cfg, name=f"layer_{i}")(x, sin, cos, attn_mask, deterministic,
-                                                     layer_cache, cache_index, position_ids)
+                blk = block(cfg, name=f"layer_{i}")
+                if ltd_active and i in ltd_layers:
+                    y, c = ltd_apply(
+                        lambda xs_, ms_, ps_, blk=blk, lc=layer_cache: blk(
+                            xs_, sin, cos, ms_, deterministic, lc, cache_index, ps_),
+                        x, i)
+                else:
+                    y, c = blk(x, sin, cos, attn_mask, deterministic,
+                               layer_cache, cache_index, position_ids)
                 x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
@@ -589,10 +622,20 @@ class CausalLMModel:
     """Engine-facing wrapper: init_params / loss / tp_rules / expert_pattern."""
 
     supports_pld = True  # consumes the engine's progressive-layer-drop theta
+    supports_random_ltd = True  # consumes the engine's random-LTD keep length
 
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
         self.module = CausalLM(cfg)
+        self._ltd_keep = None  # static per-compile; engine clears its cache on change
+        self._ltd_layers = ()
+
+    def set_random_ltd(self, keep, layers):
+        """Engine hook (data_efficiency.data_routing.random_ltd): train-time
+        token keep-count for the selected layers. Static under jit — the
+        engine invalidates its compiled step when the schedule advances."""
+        self._ltd_keep = None if keep is None else int(keep)
+        self._ltd_layers = tuple(layers or ())
 
     def set_remat_policy(self, policy):
         """Engine hook for the ``activation_checkpointing`` config section:
@@ -671,6 +714,9 @@ class CausalLMModel:
         pld_theta = batch.get("__pld_theta__")  # progressive layer drop schedule value
         if pld_theta is not None and rng is not None:
             kw.update(pld_theta=pld_theta, pld_rng=jax.random.fold_in(rng, 0x1D))
+        if self._ltd_keep is not None and rng is not None and self._ltd_keep < input_ids.shape[1]:
+            kw.update(ltd_keep=self._ltd_keep, ltd_layers=self._ltd_layers,
+                      ltd_rng=jax.random.fold_in(rng, 0x17D))
         chunked = self._use_chunked_ce()
         out = self.module.apply({"params": params}, input_ids, attn_mask, det,
                                 return_hidden=chunked,

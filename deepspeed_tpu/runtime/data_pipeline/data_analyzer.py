@@ -1,0 +1,77 @@
+"""Offline dataset analysis for curriculum learning.
+
+Counterpart of reference ``runtime/data_pipeline/data_analyzer.py``
+(``DataAnalyzer``: map workers compute per-sample metric values, reduce
+builds sorted index files the curriculum ``DeepSpeedDataSampler`` consumes).
+The torch-distributed map/reduce collapses to process-parallel chunks on one
+host (TPU hosts are fat; dataset metrics are CPU work), and the output is
+one ``.npy`` value file + one difficulty-sorted index file per metric —
+exactly what ``data_sampler.DeepSpeedDataSampler(difficulties=...)`` takes.
+"""
+
+import os
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class DataAnalyzer:
+    """``run_map_reduce(dataset)`` -> {metric: difficulties array} + files.
+
+    ``metric_fns``: {name: fn(sample) -> scalar difficulty}. ``save_path``:
+    optional directory for ``<metric>_values.npy`` /
+    ``<metric>_index_to_sample.npy`` sidecars (reference file naming).
+    """
+
+    def __init__(self, metric_fns, save_path=None, num_workers=1, worker_id=0):
+        self.metric_fns = dict(metric_fns)
+        self.save_path = save_path
+        self.num_workers = max(1, num_workers)
+        self.worker_id = worker_id
+
+    def _my_range(self, n):
+        per = (n + self.num_workers - 1) // self.num_workers
+        lo = self.worker_id * per
+        return lo, min(n, lo + per)
+
+    def run_map(self, dataset):
+        """This worker's chunk: {metric: (indices, values)}."""
+        n = len(dataset)
+        lo, hi = self._my_range(n)
+        out = {}
+        for name, fn in self.metric_fns.items():
+            vals = np.asarray([fn(dataset[i]) for i in range(lo, hi)], np.float64)
+            out[name] = (np.arange(lo, hi), vals)
+        return out
+
+    def run_reduce(self, map_results):
+        """Merge worker chunks, write sidecar files, return full value arrays."""
+        merged = {}
+        for name in self.metric_fns:
+            idx = np.concatenate([r[name][0] for r in map_results])
+            vals = np.concatenate([r[name][1] for r in map_results])
+            order = np.argsort(idx, kind="stable")
+            values = vals[order]
+            merged[name] = values
+            if self.save_path:
+                os.makedirs(self.save_path, exist_ok=True)
+                np.save(os.path.join(self.save_path, f"{name}_values.npy"), values)
+                # difficulty-ascending sample order (reference index_to_sample)
+                np.save(os.path.join(self.save_path, f"{name}_index_to_sample.npy"),
+                        np.argsort(values, kind="stable"))
+                logger.info(f"DataAnalyzer: wrote {name} index for {len(values)} samples "
+                            f"under {self.save_path}")
+        return merged
+
+    def run_map_reduce(self, dataset):
+        workers = [DataAnalyzer(self.metric_fns, None, self.num_workers, w)
+                   for w in range(self.num_workers)]
+        results = [w.run_map(dataset) for w in workers]
+        self_result = self.run_reduce(results)
+        return self_result
+
+    @staticmethod
+    def load(save_path, metric):
+        """Read back a metric's difficulty values (for the data sampler)."""
+        return np.load(os.path.join(save_path, f"{metric}_values.npy"))

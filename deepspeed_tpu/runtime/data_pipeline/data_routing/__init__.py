@@ -1,0 +1,1 @@
+from .scheduler import RandomLTDScheduler  # noqa: F401

@@ -1,0 +1,63 @@
+"""Random-LTD (layerwise token dropping) schedule.
+
+Counterpart of reference ``runtime/data_pipeline/data_routing/scheduler.py``
+(``RandomLTDScheduler`` :38; paper: "Random-LTD: Random and Layerwise Token
+Dropping"): the number of tokens the selected layers *keep* grows from
+``min_value`` to ``max_value`` (the full sequence) over
+``schedule_config.require_steps`` steps in increments of ``seq_per_step``.
+Same config keys as the reference's ``random_ltd`` section; the token
+gather/scatter itself lives in the model (``models/transformer.py``
+``ltd_apply``), selected per compile because shapes are static under jit.
+"""
+
+
+class RandomLTDScheduler:
+
+    def __init__(self, config):
+        cfg = dict(config or {})
+        sched = dict(cfg.get("random_ltd_schedule", {}))
+        self.min_value = int(sched.get("min_value", 128))
+        self.max_value = int(sched.get("max_value", 2048))
+        self.schedule_type = sched.get("schedule_type", "fixed_linear")
+        if self.schedule_type != "fixed_linear":
+            raise ValueError(f"random_ltd schedule_type {self.schedule_type!r} unsupported "
+                             "(reference ships fixed_linear)")
+        sc = dict(sched.get("schedule_config", {}))
+        self.require_steps = int(sc.get("require_steps", 1))
+        self.seq_per_step = int(sc.get("seq_per_step", 16))
+        self.total_layer_num = int(cfg.get("total_layer_num", 0))
+        self.random_ltd_layer_num = int(cfg.get("random_ltd_layer_num", 0))
+        self.random_ltd_layer_id = list(cfg.get("random_ltd_layer_id", []))
+        if self.random_ltd_layer_num and len(self.random_ltd_layer_id) != self.random_ltd_layer_num:
+            raise ValueError("random_ltd_layer_id length must equal random_ltd_layer_num")
+        self.current_seq = self.min_value
+        self.state = {"consumed_layer_tokens": 0}
+
+    def get_value(self, global_steps):
+        """fixed_linear in ``seq_per_step`` increments, clamped to the range."""
+        frac = min(1.0, max(0.0, global_steps / max(1, self.require_steps)))
+        raw = self.min_value + frac * (self.max_value - self.min_value)
+        stepped = self.min_value + int((raw - self.min_value) // self.seq_per_step) * self.seq_per_step
+        return min(self.max_value, stepped)
+
+    def update_seq(self, global_steps):
+        self.current_seq = self.get_value(global_steps)
+        self.state["consumed_layer_tokens"] += self.current_seq * max(1, self.random_ltd_layer_num)
+        return self.current_seq
+
+    def get_current_seq(self):
+        return self.current_seq
+
+    def set_current_seq(self, seq_length):
+        self.current_seq = int(seq_length)
+
+    def reset_to_init(self):
+        self.current_seq = self.min_value
+        self.state["consumed_layer_tokens"] = 0
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq, **self.state}
+
+    def load_state_dict(self, sd):
+        self.current_seq = int(sd["current_seq"])
+        self.state["consumed_layer_tokens"] = int(sd.get("consumed_layer_tokens", 0))

@@ -203,6 +203,40 @@ class DeepSpeedEngine:
             from .progressive_layer_drop import ProgressiveLayerDrop
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001))
+        # random-LTD (reference data_efficiency.data_routing.random_ltd,
+        # data_routing/scheduler.py:38): keep-length schedule; the model does
+        # the per-layer token gather/scatter with a static keep per compile
+        routing_cfg = dict(dict(self._config.raw_config.get("data_efficiency", {}))
+                           .get("data_routing", {}))
+        ltd_cfg = dict(routing_cfg.get("random_ltd", {}))
+        self.random_ltd_scheduler = None
+        if routing_cfg.get("enabled") and ltd_cfg.get("enabled"):
+            from .data_pipeline.data_routing import RandomLTDScheduler
+            if not getattr(model, "supports_random_ltd", False):
+                raise ValueError("random_ltd enabled but the model does not support it "
+                                 "(no set_random_ltd; deepspeed_tpu.models transformers do)")
+            if self.mesh.shape[dist.PIPE_AXIS] > 1:
+                raise NotImplementedError("random_ltd does not compose with "
+                                          "pipeline_parallel_size > 1 (pipeline_loss does not "
+                                          "consume the keep length)")
+            self.random_ltd_scheduler = RandomLTDScheduler(ltd_cfg)
+            if not self.random_ltd_scheduler.random_ltd_layer_id:
+                # default: every layer (reference requires the list; all-layers
+                # is the only choice that also matches scanned models)
+                n_layers = getattr(getattr(model, "cfg", None), "num_layers", 0)
+                self.random_ltd_scheduler.random_ltd_layer_id = list(range(n_layers))
+            if getattr(getattr(model, "cfg", None), "scan_layers", False):
+                n_layers = model.cfg.num_layers
+                if len(self.random_ltd_scheduler.random_ltd_layer_id) != n_layers:
+                    logger.warning("random_ltd: scan_layers models apply token dropping to "
+                                   "EVERY layer; the configured random_ltd_layer_id subset "
+                                   "is ignored (use scan_layers=False for per-layer control)")
+            self._ltd_current = None
+        if dict(dict(self._config.raw_config.get("data_efficiency", {}))
+                .get("data_sampling", {})).get("enabled"):
+            logger.warning("data_efficiency.data_sampling is not consumed by the engine; "
+                           "use runtime.data_pipeline.data_sampler.DeepSpeedDataSampler with "
+                           "your dataloader (see data_analyzer.py) — section has NO effect here")
 
         # ---- timers / monitor / io ---------------------------------------
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
@@ -798,6 +832,17 @@ class DeepSpeedEngine:
                 warning_once("progressive_layer_drop enabled but the model does not consume it "
                              "(no supports_pld attribute; deepspeed_tpu.models transformers do) "
                              "— schedule advances with NO effect")
+        if self.random_ltd_scheduler is not None:
+            keep = int(self.random_ltd_scheduler.update_seq(self.global_steps))
+            # clamp to the batch's sequence length: values past it are inert,
+            # so advancing within the inert range must not retrace
+            ref_leaf = stacked.get("input_ids", jax.tree_util.tree_leaves(stacked)[0])
+            keep = min(keep, int(np.shape(ref_leaf)[-1]))
+            if keep != self._ltd_current:
+                self.module.set_random_ltd(keep, self.random_ltd_scheduler.random_ltd_layer_id)
+                for name in ("train_batch", "offload_grads", "micro"):
+                    self._compiled.pop(name, None)  # new static keep -> retrace
+                self._ltd_current = keep
         stacked = self._shard_batch(stacked, leading_scan_dim=True)
 
         self.tput_timer.start()

@@ -1,0 +1,112 @@
+"""Random-LTD (layerwise token dropping) + data analyzer tests.
+
+Mirrors the reference's data-efficiency coverage
+(tests/unit/runtime/test_data_efficiency.py: schedule values advance, model
+trains with random-ltd enabled).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models import get_model
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+from deepspeed_tpu.runtime.data_pipeline.data_routing import RandomLTDScheduler
+
+
+def ltd_section(min_v=64, max_v=128, steps=4, per=16, layer_ids=(1, )):
+    return {
+        "enabled": True,
+        "random_ltd": {
+            "enabled": True,
+            "total_layer_num": 2,
+            "random_ltd_layer_num": len(layer_ids),
+            "random_ltd_layer_id": list(layer_ids),
+            "random_ltd_schedule": {
+                "min_value": min_v, "max_value": max_v,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"require_steps": steps, "seq_per_step": per},
+            },
+        },
+    }
+
+
+def test_scheduler_fixed_linear_values():
+    s = RandomLTDScheduler(ltd_section()["random_ltd"])
+    assert s.get_value(0) == 64
+    assert s.get_value(4) == 128  # full range at require_steps
+    vals = [s.get_value(t) for t in range(5)]
+    assert vals == sorted(vals)  # monotone
+    assert all((v - 64) % 16 == 0 for v in vals)  # seq_per_step granularity
+    s.update_seq(2)
+    sd = s.state_dict()
+    s2 = RandomLTDScheduler(ltd_section()["random_ltd"])
+    s2.load_state_dict(sd)
+    assert s2.get_current_seq() == s.get_current_seq()
+
+
+@pytest.mark.parametrize("scan", [False, True], ids=["unrolled", "scan"])
+def test_model_ltd_forward_changes_only_selected(scan):
+    """With keep < T the loss differs from baseline but stays finite; with
+    keep >= T the mechanism is inert and losses match exactly."""
+    model = get_model("tiny", scan_layers=scan)
+    params = model.init_params(jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 64)), jnp.int32)
+    batch = {"input_ids": ids}
+    rng = jax.random.key(1)
+    base = float(model.loss(params, batch, rng))
+
+    model.set_random_ltd(64, (1, ))  # keep == T: inert
+    assert float(model.loss(params, batch, rng)) == base
+
+    model.set_random_ltd(32, (1, ))
+    dropped = float(model.loss(params, batch, rng))
+    assert np.isfinite(dropped) and dropped != base
+
+
+def test_engine_random_ltd_trains_and_advances():
+    comm._state["mesh"] = None
+    model = get_model("tiny", scan_layers=False)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+        "data_efficiency": {"data_routing": ltd_section(min_v=32, max_v=128, steps=3, per=32)},
+    })
+    ids = np.random.default_rng(0).integers(0, 256, (16, 128)).astype(np.int32)
+    losses = [float(engine.train_batch(batch={"input_ids": ids})) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    # schedule reached full length -> LTD inert by the last step
+    assert engine.random_ltd_scheduler.get_current_seq() == 128
+    assert engine.module._ltd_keep == 128
+
+
+def test_engine_rejects_ltd_for_unsupporting_model():
+    from .simple_model import SimpleModel
+    comm._state["mesh"] = None
+    with pytest.raises(ValueError, match="random_ltd"):
+        deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=8), config={
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "data_efficiency": {"data_routing": ltd_section()},
+        })
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    data = [np.full((i + 1, ), i) for i in range(10)]  # sample i has length i+1
+    an = DataAnalyzer({"seqlen": lambda s: len(s)}, save_path=str(tmp_path), num_workers=3)
+    result = an.run_map_reduce(data)
+    np.testing.assert_array_equal(result["seqlen"], np.arange(1, 11))
+    loaded = DataAnalyzer.load(str(tmp_path), "seqlen")
+    np.testing.assert_array_equal(loaded, np.arange(1, 11))
+    idx = np.load(tmp_path / "seqlen_index_to_sample.npy")
+    np.testing.assert_array_equal(idx, np.arange(10))  # already difficulty-sorted
+
+    # analyzer output feeds the curriculum sampler directly
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+    sampler = DeepSpeedDataSampler(loaded)
+    assert len(list(iter(sampler))) == 10
