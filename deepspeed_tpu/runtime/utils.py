@@ -1,0 +1,83 @@
+"""Runtime utilities: memory reporting and norm helpers.
+
+Counterpart of reference ``runtime/utils.py`` (``see_memory_usage`` :40,
+``get_global_norm`` / ``clip_grad_norm_`` :385, ``memory_status``): CUDA
+allocator counters become XLA ``device.memory_stats()`` and host RSS.
+"""
+
+import gc
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+
+def _device_mem_line(dev):
+    stats = dev.memory_stats() or {}
+    in_use = stats.get("bytes_in_use", 0)
+    peak = stats.get("peak_bytes_in_use", 0)
+    limit = stats.get("bytes_limit", 0)
+    return (f"{dev.platform}:{dev.id} in_use {in_use / 2**30:.2f}GB "
+            f"peak {peak / 2**30:.2f}GB limit {limit / 2**30:.2f}GB")
+
+
+def see_memory_usage(message, force=False, ranks=(0, )):
+    """Log device + host memory (reference prints CUDA allocated/cached and
+    host used; here XLA per-device stats and host RSS/available)."""
+    if not force:
+        return
+    if jax.process_index() not in ranks:
+        return
+    lines = [message]
+    for dev in jax.local_devices():
+        try:
+            lines.append("  " + _device_mem_line(dev))
+        except Exception:  # backends without memory_stats (CPU)
+            lines.append(f"  {dev.platform}:{dev.id} memory stats unavailable")
+    try:
+        import psutil
+        vm = psutil.virtual_memory()
+        lines.append(f"  host RSS {psutil.Process().memory_info().rss / 2**30:.2f}GB "
+                     f"avail {vm.available / 2**30:.2f}GB ({vm.percent}% used)")
+    except ImportError:
+        pass
+    logger.info("\n".join(lines))
+
+
+def memory_status(msg="", reset_max=False):
+    """Reference-shaped alias used by Megatron integrations."""
+    see_memory_usage(msg or "memory_status", force=True)
+    if reset_max:
+        gc.collect()
+
+
+def get_global_norm(norm_list=None, tensors=None):
+    """L2 norm across a list of norms (reference semantics) or a pytree
+    (same optax.global_norm the engine's clipping uses, fp32-accumulated)."""
+    if tensors is not None:
+        import optax
+        return optax.global_norm(jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32), tensors))
+    return float(sum(n**2 for n in norm_list))**0.5
+
+
+def get_grad_norm(tree):
+    """Global L2 norm of a gradient pytree (fp32 accumulate)."""
+    return get_global_norm(tensors=tree)
+
+
+def clip_grad_norm_(tree, max_norm):
+    """Scale the pytree so its global norm is <= max_norm; returns
+    (clipped tree, pre-clip norm) — functional, unlike the in-place torch
+    version."""
+    norm = get_grad_norm(tree)
+    coef = jnp.minimum(1.0, max_norm / (norm + 1e-6))
+    return jax.tree_util.tree_map(lambda x: (x.astype(jnp.float32) * coef).astype(x.dtype),
+                                  tree), norm
+
+
+def empty_cache():
+    """CUDA empty_cache parity: XLA owns HBM for the process; only host-side
+    garbage can be collected."""
+    gc.collect()

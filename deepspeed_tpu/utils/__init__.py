@@ -1,3 +1,5 @@
 from .logging import logger, log_dist, print_rank_0  # noqa: F401
 from .timer import SynchronizedWallClockTimer, ThroughputTimer, NoopTimer  # noqa: F401
 from . import groups  # noqa: F401
+from .tensor_fragment import (safe_get_full_fp32_param, safe_set_full_fp32_param,  # noqa: F401
+                              safe_get_full_grad, safe_get_full_optimizer_state)

@@ -1,0 +1,109 @@
+"""Debug accessors for full fp32 params / grads / optimizer state.
+
+Counterpart of reference ``deepspeed/utils/tensor_fragment.py``
+(``safe_get_full_fp32_param`` :123, ``safe_get_full_grad`` :147,
+``safe_get_full_optimizer_state`` :135, and the ``safe_set_*`` writers):
+where the reference stitches flattened ZeRO partitions back together, here
+every tensor in ``TrainState`` is already a *global logical* array (sharding
+is a jax placement), so each accessor is a tree lookup plus a device fetch.
+
+``path``: '/'-joined key path into the parameter pytree, e.g.
+``"layers/attn/q_proj/kernel"``.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .logging import logger
+
+
+def _lookup(tree, path):
+    node = tree
+    for part in path.split("/"):
+        if isinstance(node, (dict, )) and part in node:
+            node = node[part]
+        else:
+            raise KeyError(f"path {path!r}: segment {part!r} not found")
+    return node
+
+
+def _set(tree, path, value):
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = value
+
+
+def safe_get_full_fp32_param(engine, path):
+    """Full fp32 master parameter at ``path`` as host numpy."""
+    if getattr(engine, "offload_optimizer", False):
+        host = engine.host_opt
+        if host.master is None:  # NVMe tier keeps no DRAM tree
+            raise NotImplementedError("NVMe offload: use engine.host_opt.state_dict_arrays()")
+        return np.asarray(_lookup(host.master, path))
+    return np.asarray(jax.device_get(_lookup(engine.state.params, path)), np.float32)
+
+
+def safe_set_full_fp32_param(engine, path, value):
+    """Write a full fp32 master parameter (and refresh the device copy)."""
+    if getattr(engine, "offload_optimizer", False):
+        host = engine.host_opt
+        if host.master is None:
+            raise NotImplementedError("NVMe offload: load/modify/store via state_dict_arrays()")
+        dst = _lookup(host.master, path)
+        src = np.asarray(value, np.float32)
+        if src.shape != dst.shape:
+            raise ValueError(f"value shape {src.shape} != param shape {dst.shape}")
+        dst[...] = src
+        return
+    leaf = _lookup(engine.state.params, path)
+    new = jnp.asarray(value, leaf.dtype)
+    if new.shape != leaf.shape:
+        raise ValueError(f"value shape {new.shape} != param shape {leaf.shape}")
+    params = jax.tree_util.tree_map(lambda x: x, engine.state.params)  # shallow copy dicts
+    _set(params, path, jax.device_put(new, leaf.sharding))
+    engine.state = engine.state._replace(params=params)
+    engine._compiled.clear()  # donated buffers were replaced
+
+
+def safe_get_full_grad(engine, path):
+    """Accumulated gradient at ``path`` (3-call-facade path only; the fused
+    ``train_batch`` consumes gradients inside one compiled step and never
+    materializes them for the host — reference grads are likewise only
+    available between backward() and step())."""
+    acc = engine.state.grad_acc
+    if not acc:
+        logger.warning("safe_get_full_grad: no gradient accumulator live (fused train_batch "
+                       "path); use engine.backward()/step() facade to inspect grads")
+        return None
+    return np.asarray(jax.device_get(_lookup(acc, path)), np.float32)
+
+
+_STATE_KEYS = {"exp_avg": "mu", "exp_avg_sq": "nu"}
+
+
+def _find_adam_state(opt_state):
+    for part in jax.tree_util.tree_leaves(opt_state, is_leaf=lambda x: hasattr(x, "mu")):
+        if hasattr(part, "mu"):
+            return part
+    raise KeyError("no Adam-style (mu/nu) state found in opt_state")
+
+
+def safe_get_full_optimizer_state(engine, path, state_key):
+    """Optimizer moment (``exp_avg``/``exp_avg_sq``) at ``path``."""
+    if getattr(engine, "offload_optimizer", False):
+        host = engine.host_opt
+        if state_key not in ("exp_avg", "exp_avg_sq"):
+            raise KeyError(f"unknown optimizer state key {state_key!r}")
+        if host.m is None:  # NVMe tier keeps no DRAM tree
+            raise NotImplementedError("NVMe offload: use engine.host_opt.state_dict_arrays()")
+        tree = host.m if state_key == "exp_avg" else host.v
+        return np.asarray(_lookup(tree, path))
+    attr = _STATE_KEYS.get(state_key)
+    if attr is None:
+        raise KeyError(f"unknown optimizer state key {state_key!r}; valid: {sorted(_STATE_KEYS)}")
+    adam = _find_adam_state(engine.state.opt_state)
+    return np.asarray(jax.device_get(_lookup(getattr(adam, attr), path)), np.float32)

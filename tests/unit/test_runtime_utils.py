@@ -1,0 +1,114 @@
+"""Sparse tensors, memory/norm utils, tensor-fragment accessors.
+
+Mirrors reference coverage: tests/unit/runtime/sparse_tensor/test_sparse_grads.py
+(sparse allreduce equivalence), tests/unit/utils/test_get_optim_files +
+tensor-fragment accessors (tests/unit/runtime/zero/test_zero_tensor_fragment.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.runtime.sparse_tensor import SparseTensor, sparse_allreduce
+from deepspeed_tpu.runtime.utils import (clip_grad_norm_, get_global_norm, get_grad_norm,
+                                         see_memory_usage)
+from deepspeed_tpu.utils import (safe_get_full_fp32_param, safe_get_full_grad,
+                                 safe_get_full_optimizer_state, safe_set_full_fp32_param)
+
+from .simple_model import SimpleModel, random_batch
+
+HIDDEN = 64
+
+
+def test_sparse_tensor_roundtrip():
+    x = np.zeros((10, 4), np.float32)
+    x[2] = 1.5
+    x[7] = -2.0
+    sp = SparseTensor.from_dense(x)
+    np.testing.assert_array_equal(np.asarray(sp.indices), [2, 7])
+    np.testing.assert_array_equal(np.asarray(sp.to_dense()), x)
+    payload, dense = sp.sparse_size()
+    assert payload == 2 * 4 + 2 and dense == 40
+
+
+def test_sparse_allreduce_matches_dense():
+    mesh = comm.get_mesh() if comm.has_mesh() else comm.initialize_mesh()
+    world = mesh.shape["data"]
+    rows, cols = 16, 8
+    r = np.random.default_rng(0)
+    # each shard contributes the same number of sparse rows (SPMD static shape)
+    per = 2
+    idx = r.integers(0, rows, (world, per)).astype(np.int32)
+    vals = r.standard_normal((world, per, cols)).astype(np.float32)
+
+    def shard_fn(idx_s, vals_s):
+        sp = SparseTensor(idx_s[0], vals_s[0], (rows, cols))
+        return sparse_allreduce(sp, "data")[None]
+
+    out = jax.jit(jax.shard_map(shard_fn, mesh=mesh,
+                                in_specs=(P("data"), P("data")),
+                                out_specs=P("data")))(idx, vals)
+    dense = np.zeros((rows, cols), np.float32)
+    for w in range(world):
+        np.add.at(dense, idx[w], vals[w])
+    for w in range(world):  # every shard holds the full reduced result
+        np.testing.assert_allclose(np.asarray(out)[w], dense, rtol=1e-6)
+
+
+def test_norm_utils():
+    tree = {"a": jnp.full((4, ), 3.0), "b": jnp.full((9, ), 4.0)}
+    n = float(get_grad_norm(tree))
+    assert np.isclose(n, np.sqrt(4 * 9 + 9 * 16))
+    clipped, pre = clip_grad_norm_(tree, 1.0)
+    assert np.isclose(float(pre), n)
+    assert np.isclose(float(get_grad_norm(clipped)), 1.0, atol=1e-3)
+    assert np.isclose(get_global_norm(norm_list=[3.0, 4.0]), 5.0)
+
+
+def test_see_memory_usage_runs(caplog):
+    see_memory_usage("unit-test checkpoint", force=True)  # must not raise
+
+
+def engine_for_fragment_tests(offload=False):
+    comm._state["mesh"] = None
+    zero = {"stage": 2, "offload_optimizer": {"device": "cpu"}} if offload else {"stage": 1}
+    model = SimpleModel(hidden_dim=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_batch_size": 16,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+        "zero_optimization": zero,
+        "steps_per_print": 1000,
+    })
+    for i in range(2):
+        engine.train_batch(batch=random_batch(engine.train_batch_size(), HIDDEN, seed=i))
+    return engine
+
+
+@pytest.mark.parametrize("offload", [False, True], ids=["device", "cpu-offload"])
+def test_tensor_fragment_accessors(offload):
+    engine = engine_for_fragment_tests(offload)
+    path = "linear_0/kernel"
+    p = safe_get_full_fp32_param(engine, path)
+    assert p.shape == (HIDDEN, HIDDEN) and p.dtype == np.float32
+    m = safe_get_full_optimizer_state(engine, path, "exp_avg")
+    v = safe_get_full_optimizer_state(engine, path, "exp_avg_sq")
+    assert m.shape == p.shape and v.shape == p.shape and np.abs(v).sum() > 0
+
+    new = np.zeros_like(p)
+    safe_set_full_fp32_param(engine, path, new)
+    np.testing.assert_array_equal(safe_get_full_fp32_param(engine, path), new)
+
+    with pytest.raises(KeyError):
+        safe_get_full_optimizer_state(engine, path, "not_a_state")
+    with pytest.raises(KeyError):
+        safe_get_full_fp32_param(engine, "linear_0/not_there")
+
+
+def test_safe_get_full_grad_fused_path_returns_none():
+    engine = engine_for_fragment_tests(False)
+    assert safe_get_full_grad(engine, "linear_0/kernel") is None
