@@ -25,3 +25,7 @@ def _reset_global_mesh():
     from deepspeed_tpu.comm import comm
     comm._state["mesh"] = None
     comm._state["comms_logger"] = None
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running multi-process tests")
