@@ -1,0 +1,1 @@
+from . import op_builder  # noqa: F401

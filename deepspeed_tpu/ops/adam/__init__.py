@@ -1,1 +1,2 @@
 from .cpu_adam import DeepSpeedCPUAdam, cpu_adam_available  # noqa: F401
+from .onebit_adam import OneBitAdamState, onebit_adam, onebit_lamb  # noqa: F401

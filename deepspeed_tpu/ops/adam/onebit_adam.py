@@ -1,0 +1,119 @@
+"""1-bit Adam: error-compensated compressed-momentum data parallelism.
+
+Counterpart of reference ``runtime/fp16/onebit/adam.py`` (``OnebitAdam``,
+paper "1-bit Adam: communication efficient large-scale training with Adam's
+convergence speed"). Two phases:
+
+- warmup (``step < freeze_step``): exact dense Adam — gradients are averaged
+  across the data-parallel group and both moments update normally.
+- compression (``step >= freeze_step``): the variance ``v`` freezes; each
+  worker updates its *local* momentum and the group exchanges only the
+  1-bit-compressed momentum (sign plane + scalar scale, with error feedback
+  carried between steps — ``runtime/comm/compressed.onebit_all_reduce``).
+
+Expressed as an ``optax.GradientTransformation`` over per-shard (UNREDUCED)
+gradients inside ``shard_map`` with ``axis_name`` bound on the data axis —
+the TPU-native form of the reference's cupy/NCCL compressed allreduce. The
+engine's default pjit path lets XLA reduce gradients densely (the right call
+on ICI); this optimizer is for DCN-bound multislice loops where momentum
+bytes dominate.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ...runtime.comm.compressed import onebit_all_reduce
+
+
+class OneBitAdamState(NamedTuple):
+    count: jnp.ndarray
+    m: optax.Updates
+    v: optax.Updates
+    error: optax.Updates  # 1-bit compression error feedback, per worker
+
+
+def onebit_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
+                weight_decay=0.0, freeze_step=100):
+    """Build the transformation. ``learning_rate``: float or schedule(count).
+    Apply with per-shard gradients inside ``shard_map``; updates come out
+    replicated across ``axis_name`` (all workers apply the same step)."""
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+        return OneBitAdamState(count=jnp.zeros((), jnp.int32), m=zeros,
+                               v=jax.tree_util.tree_map(jnp.copy, zeros),
+                               error=jax.tree_util.tree_map(jnp.copy, zeros))
+
+    def _leaf_update(count, g, m, v, err):
+        g = g.astype(jnp.float32)
+
+        def warm(_):
+            g_avg = jax.lax.pmean(g, axis_name)
+            m2 = b1 * m + (1 - b1) * g_avg
+            v2 = b2 * v + (1 - b2) * jnp.square(g_avg)
+            return m2, v2, err
+
+        def compressed(_):
+            m_local = b1 * m + (1 - b1) * g
+            m2, err2 = onebit_all_reduce(m_local, err, axis_name)
+            return m2, v, err2  # v frozen
+
+        # compression begins at step >= freeze_step (paper schedule)
+        return jax.lax.cond(count < freeze_step, warm, compressed, None)
+
+    def update(grads, state, params=None):
+        if weight_decay and params is None:
+            raise ValueError("onebit_adam with weight_decay requires params in update()")
+        count = state.count + 1
+        flat_g, treedef = jax.tree_util.tree_flatten(grads)
+        flat_m = jax.tree_util.tree_leaves(state.m)
+        flat_v = jax.tree_util.tree_leaves(state.v)
+        flat_e = jax.tree_util.tree_leaves(state.error)
+        new_m, new_v, new_e, upd = [], [], [], []
+        lr = learning_rate(count) if callable(learning_rate) else learning_rate
+        flat_p = jax.tree_util.tree_leaves(params) if params is not None else [None] * len(flat_g)
+        for g, m, v, e, p in zip(flat_g, flat_m, flat_v, flat_e, flat_p):
+            m2, v2, e2 = _leaf_update(count, g, m, v, e)
+            mhat = m2 / (1 - b1**count.astype(jnp.float32))
+            vhat = v2 / (1 - b2**count.astype(jnp.float32))
+            step = mhat / (jnp.sqrt(vhat) + eps)
+            if weight_decay and p is not None:
+                step = step + weight_decay * p.astype(jnp.float32)
+            new_m.append(m2)
+            new_v.append(v2)
+            new_e.append(e2)
+            upd.append((-lr * step).astype(g.dtype))
+        unf = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        return unf(upd), OneBitAdamState(count=count, m=unf(new_m), v=unf(new_v),
+                                         error=unf(new_e))
+
+    return optax.GradientTransformation(init, update)
+
+
+def onebit_lamb(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
+                weight_decay=0.0, freeze_step=100, min_trust=0.01, max_trust=10.0):
+    """1-bit LAMB (reference ``runtime/fp16/onebit/lamb.py``): the 1-bit Adam
+    step followed by a per-layer trust-ratio rescale."""
+    inner = onebit_adam(1.0, axis_name, b1=b1, b2=b2, eps=eps,
+                        weight_decay=weight_decay, freeze_step=freeze_step)
+
+    def init(params):
+        return inner.init(params)
+
+    def update(grads, state, params=None):
+        raw, new_state = inner.update(grads, state, params)
+        lr = learning_rate(new_state.count) if callable(learning_rate) else learning_rate
+
+        def scaled(u, p):
+            pn = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+            un = jnp.linalg.norm(u.astype(jnp.float32).reshape(-1))
+            trust = jnp.clip(pn / jnp.maximum(un, 1e-12), min_trust, max_trust)
+            trust = jnp.where(pn == 0, 1.0, trust)
+            return (lr * trust * u.astype(jnp.float32)).astype(u.dtype)
+
+        return jax.tree_util.tree_map(scaled, raw, params), new_state
+
+    return optax.GradientTransformation(init, update)

@@ -476,8 +476,15 @@ class DeepSpeedEngine:
         if name == LION_OPTIMIZER:
             return optax.lion(lr, b1=betas[0], b2=betas[1], weight_decay=wd)
         if name in (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
-            logger.warning(f"{name}: error-compensated compressed-communication optimizers map to dense "
-                           f"XLA collectives on ICI (bandwidth-rich); using uncompressed Adam math")
+            # The engine's pjit step hands the optimizer globally-reduced
+            # gradients (XLA's dense reduce-scatter — the right call on
+            # bandwidth-rich ICI), so the compressed-momentum exchange has
+            # nothing to compress here. The real error-compensated optimizers
+            # (ops/adam/onebit_adam.py: onebit_adam / onebit_lamb) run in
+            # shard_map loops over per-worker gradients — DCN-bound setups.
+            logger.warning(f"{name}: using dense Adam math inside the pjit step; for actual "
+                           f"1-bit compressed momentum use deepspeed_tpu.ops.adam.onebit_adam "
+                           f"in a shard_map training loop (see its tests)")
             return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
         raise ValueError(f"Unknown optimizer type {cfg.type}")
 

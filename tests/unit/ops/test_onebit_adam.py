@@ -1,0 +1,100 @@
+"""1-bit Adam/LAMB: warmup exactness + compressed-phase convergence.
+
+Mirrors the reference's onebit coverage (tests/unit/ops/adam +
+tests/unit/runtime/half_precision/onebit/test_onebit.py: compressed training
+tracks dense training).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.ops.adam.onebit_adam import onebit_adam, onebit_lamb
+
+DIM = 16
+
+
+def make_problem(seed=0, dim=DIM, n=64, zero_init=True):
+    r = np.random.default_rng(seed)
+    w_true = jnp.asarray(r.standard_normal((dim, 1)), jnp.float32)
+    X = jnp.asarray(r.standard_normal((n, dim)), jnp.float32)
+    y = X @ w_true + 0.01 * jnp.asarray(r.standard_normal((n, 1)), jnp.float32)
+    w0 = np.zeros((dim, 1)) if zero_init else r.standard_normal((dim, 1))
+    params = {"w": jnp.asarray(w0, jnp.float32)}
+    return X, y, params
+
+
+def loss_fn(params, X, y):
+    pred = X @ params["w"]
+    return jnp.mean(jnp.square(pred - y))
+
+
+def run_sharded(tx, X, y, params, steps):
+    """Data-parallel shard_map loop: per-shard grads feed the transformation.
+
+    The optimizer state rides the data axis (leading world dim): the error-
+    feedback leaves genuinely differ per worker — replicated out_specs would
+    silently collapse them to one worker's values."""
+    mesh = comm.get_mesh() if comm.has_mesh() else comm.initialize_mesh()
+    world = mesh.shape["data"]
+    dim = X.shape[1]
+    Xs = X.reshape(world, -1, dim)
+    ys = y.reshape(world, -1, 1)
+    state = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (world, ) + x.shape), tx.init(params))
+
+    def step(params, state, Xs, ys):
+        def shard(p, s, Xl, yl):
+            s_local = jax.tree_util.tree_map(lambda x: x[0], s)
+            g = jax.grad(loss_fn)(p, Xl[0], yl[0])
+            upd, s2 = tx.update(g, s_local, p)
+            return upd, jax.tree_util.tree_map(lambda x: x[None], s2)
+        upd, state = jax.shard_map(
+            shard, mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P("data")),
+            out_specs=(P(), P("data")),
+            check_vma=False)(params, state, Xs, ys)
+        return optax.apply_updates(params, upd), state
+
+    step = jax.jit(step)
+    for _ in range(steps):
+        params, state = step(params, state, Xs, ys)
+    return params, float(loss_fn(params, X, y))
+
+
+def test_warmup_matches_dense_adam():
+    X, y, params = make_problem()
+    tx = onebit_adam(1e-2, "data", freeze_step=1000)  # never leaves warmup
+    p1, _ = run_sharded(tx, X, y, params, steps=10)
+
+    dense = optax.adam(1e-2)
+    st = dense.init(params)
+    p2 = params
+    for _ in range(10):
+        g = jax.grad(loss_fn)(p2, X, y)  # full batch == mean of shard grads
+        upd, st = dense.update(g, st, p2)
+        p2 = optax.apply_updates(p2, upd)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]), rtol=1e-4, atol=1e-6)
+
+
+def test_compressed_phase_converges():
+    """Paper regime: freeze after the momentum stabilizes, dims large enough
+    that sign noise averages out — the compressed phase then tracks Adam."""
+    X, y, params = make_problem(1, dim=128, n=512)
+    start = float(loss_fn(params, X, y))
+    tx = onebit_adam(1e-1, "data", freeze_step=100)
+    _, loss_1bit = run_sharded(tx, X, y, params, steps=400)
+    assert loss_1bit < 1e-3 * start, f"1-bit Adam failed to converge: {loss_1bit} vs {start}"
+
+
+def test_onebit_lamb_converges():
+    X, y, params = make_problem(2, dim=128, n=512)
+    start = float(loss_fn(params, X, y))
+    tx = onebit_lamb(5e-2, "data", freeze_step=100)
+    _, loss_l = run_sharded(tx, X, y, params, steps=400)
+    assert loss_l < 0.01 * start, f"1-bit LAMB failed to converge: {loss_l} vs start {start}"

@@ -81,9 +81,16 @@ def test_two_process_training_matches_single(tmp_path):
                                       env=env, cwd=test_dir, stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT, text=True))
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        outs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:  # a dead peer leaves the other hung on the rendezvous
+            if p.poll() is None:
+                p.kill()
+                out, _ = p.communicate()
+                outs.append(out)
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
 
