@@ -61,6 +61,10 @@ class TransformerConfig:
     # 0 = always dense logits; N = chunk rows of N
     ce_chunk_size: Optional[int] = None
     attention_impl: str = "xla"  # "xla" | "flash"
+    # under sequence_parallel_size > 1: "ulysses" re-shards heads (all-to-all,
+    # full sequence per head on-chip); "ring" keeps O(T/n) per chip and
+    # rotates KV over ICI (ops/pallas/ring_attention; requires flash + causal)
+    sequence_parallel_impl: str = "ulysses"  # "ulysses" | "ring"
     attention_block_q: int = 512
     attention_block_kv: int = 512
     decode_block_kv: int = 256  # KV block per decode-kernel step
@@ -68,6 +72,11 @@ class TransformerConfig:
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash"):
             raise ValueError(f"attention_impl must be 'xla' or 'flash', got {self.attention_impl!r}")
+        if self.sequence_parallel_impl not in ("ulysses", "ring"):
+            raise ValueError(f"sequence_parallel_impl must be 'ulysses' or 'ring', "
+                             f"got {self.sequence_parallel_impl!r}")
+        if self.sequence_parallel_impl == "ring" and self.attention_impl != "flash":
+            raise ValueError("sequence_parallel_impl='ring' requires attention_impl='flash'")
         if self.attention_impl == "flash":
             import importlib.util
             if importlib.util.find_spec("deepspeed_tpu.ops.pallas.flash_attention") is None:
@@ -422,28 +431,37 @@ class Attention(nn.Module):
         else:
             new_cache = None
             use_flash = cfg.attention_impl == "flash" and T >= 128 and attn_mask is None
-            if nkv != nh and not use_flash:  # the flash kernel is GQA-native
-                k = jnp.repeat(k, nh // nkv, axis=1)
-                v = jnp.repeat(v, nh // nkv, axis=1)
-            S = k.shape[2]
-            ulysses = _ulysses_specs(B, nh)
-            if ulysses is not None:
-                heads_spec, seq_spec = ulysses
-                q = _constrain(q, heads_spec)
-                if k.shape[1] == nh:
-                    k, v = _constrain(k, heads_spec), _constrain(v, heads_spec)
-            if use_flash:
-                from ..ops.pallas.flash_attention import sharded_flash_attention
-                out = sharded_flash_attention(q, k, v, causal=True,
-                                              block_q=cfg.attention_block_q,
-                                              block_kv=cfg.attention_block_kv)
+            use_ring = (use_flash and cfg.sequence_parallel_impl == "ring"
+                        and dist.has_mesh() and not dist.in_manual_region()
+                        and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
+            if use_ring:
+                from ..ops.pallas.ring_attention import ring_attention
+                out = ring_attention(q, k, v, causal=True,
+                                     block_q=cfg.attention_block_q,
+                                     block_kv=cfg.attention_block_kv)
             else:
-                bias = jnp.where(jnp.tril(jnp.ones((T, S), dtype=bool)), 0.0, -1e30)[None, None]
-                if attn_mask is not None:
-                    bias = bias + jnp.where(attn_mask, 0.0, -1e30)[:, None, None, :].astype(jnp.float32)
-                out = _sdpa_xla(q, k, v, bias, cfg.dtype)
-            if ulysses is not None:
-                out = _constrain(out, seq_spec)
+                if nkv != nh and not use_flash:  # the flash kernel is GQA-native
+                    k = jnp.repeat(k, nh // nkv, axis=1)
+                    v = jnp.repeat(v, nh // nkv, axis=1)
+                S = k.shape[2]
+                ulysses = _ulysses_specs(B, nh)
+                if ulysses is not None:
+                    heads_spec, seq_spec = ulysses
+                    q = _constrain(q, heads_spec)
+                    if k.shape[1] == nh:
+                        k, v = _constrain(k, heads_spec), _constrain(v, heads_spec)
+                if use_flash:
+                    from ..ops.pallas.flash_attention import sharded_flash_attention
+                    out = sharded_flash_attention(q, k, v, causal=True,
+                                                  block_q=cfg.attention_block_q,
+                                                  block_kv=cfg.attention_block_kv)
+                else:
+                    bias = jnp.where(jnp.tril(jnp.ones((T, S), dtype=bool)), 0.0, -1e30)[None, None]
+                    if attn_mask is not None:
+                        bias = bias + jnp.where(attn_mask, 0.0, -1e30)[:, None, None, :].astype(jnp.float32)
+                    out = _sdpa_xla(q, k, v, bias, cfg.dtype)
+                if ulysses is not None:
+                    out = _constrain(out, seq_spec)
 
         out = OutProjection(H, use_bias, cfg.dtype, name="o_proj")(out)
         return out, new_cache

@@ -104,6 +104,8 @@ def onebit_lamb(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
         return inner.init(params)
 
     def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("onebit_lamb requires params in update() (trust ratio needs |w|)")
         raw, new_state = inner.update(grads, state, params)
         lr = learning_rate(new_state.count) if callable(learning_rate) else learning_rate
 

@@ -257,6 +257,10 @@ def _flash_fwd(q, k, v, causal, block_q, block_kv, scale):
 
 
 def _flash_bwd(causal, block_q, block_kv, scale, res, g_out):
+    return _flash_bwd_impl(causal, block_q, block_kv, scale, res, g_out)
+
+
+def _flash_bwd_impl(causal, block_q, block_kv, scale, res, g_out, delta_shift=None):
     qp, kp, vp, out_p, lse = res
     B, H, Tq, D = qp.shape
     Hkv = kp.shape[1]
@@ -271,6 +275,8 @@ def _flash_bwd(causal, block_q, block_kv, scale, res, g_out):
 
     delta = jnp.einsum("bhtd,bhtd->bht", dop.astype(jnp.float32),
                        out_p.astype(jnp.float32))[..., None]  # (B, H, Tq, 1)
+    if delta_shift is not None:
+        delta = delta - delta_shift.astype(jnp.float32)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale_v, block_kv=bkv, causal=causal, seq_len=T),
@@ -317,6 +323,40 @@ def _flash_bwd(causal, block_q, block_kv, scale, res, g_out):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
+    """Flash attention that also returns the per-row log-sum-exp —
+    the merge currency of ring attention (``ops/pallas/ring_attention.py``):
+    two attention results over disjoint KV sets combine exactly from their
+    (out, lse) pairs. lse shape (B, H, T); rows that attend nothing are -inf.
+    """
+    out, lse = _flash_lse_fwd(q, k, v, causal, block_q, block_kv, scale)[0]
+    return out, lse
+
+
+def _flash_lse_fwd(q, k, v, causal, block_q, block_kv, scale):
+    T = q.shape[2]
+    out_p, lse, (qp, kp, vp, Tq, Tkv) = _flash_call(q, k, v, causal, block_q, block_kv, scale)
+    return (out_p[:, :, :T], lse[:, :, :T, 0]), (qp, kp, vp, out_p, lse)
+
+
+def _flash_lse_bwd(causal, block_q, block_kv, scale, res, g):
+    """The lse cotangent folds into the existing dq/dkv kernels: with
+    s-gradient ds = p∘(dp − delta), and dlse/ds = p, the combined cotangent
+    is ds = p∘(dp − (delta − g_lse)) — so shifting delta by −g_lse reuses
+    both kernels unchanged."""
+    g_out, g_lse = g
+    qp, kp, vp, out_p, lse = res
+    T = g_out.shape[2]
+    Tq = qp.shape[2]
+    g_lse_p = jnp.pad(g_lse, ((0, 0), (0, 0), (0, Tq - T))) if Tq != T else g_lse
+    return _flash_bwd_impl(causal, block_q, block_kv, scale, res, g_out,
+                           delta_shift=g_lse_p[..., None])
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def sharded_flash_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):

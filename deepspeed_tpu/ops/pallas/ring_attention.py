@@ -1,0 +1,131 @@
+"""Ring attention: sequence parallelism for contexts beyond one chip.
+
+The long-context half of the SP story (SURVEY §2.3 first-class requirement;
+the v0.9.2 reference's long-sequence surface is block-sparse attention —
+``deepspeed/ops/sparse_attention`` — and this framework also ships Ulysses
+head-scatter in ``models/transformer._ulysses_specs``). Ulysses re-gathers
+the full sequence per head, so VMEM/HBM still see O(T); ring attention keeps
+every chip at O(T/n): each chip holds one sequence chunk of Q/K/V, KV chunks
+rotate around the ``seq`` ring via ``ppermute`` (ICI neighbor traffic), and
+each step's local flash-attention result merges into a running (out, lse)
+pair — the online-softmax identity across chips instead of across blocks.
+
+Causal scheduling: at ring step ``s`` chip ``i`` holds KV chunk ``i−s`` mod
+``n``. Step 0 is the causal diagonal; step ``s≥1`` is a full (non-causal)
+block that only chips ``i >= s`` keep (wrapped chunks are future context —
+their result is discarded by an lse=−inf merge). This is the simple
+unbalanced schedule: ~half the non-diagonal block computations are masked
+away; the zig-zag balanced variant can land behind the same API.
+
+Differentiable end-to-end: the per-step kernel is
+``flash_attention_with_lse`` (custom VJP with the lse cotangent folded into
+the dq/dkv kernels) and the merge/ppermute are plain JAX; each step is
+``jax.checkpoint``-ed so backward recomputes block attention instead of
+storing n per-step residuals.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .flash_attention import flash_attention_with_lse
+
+_NEG_INF = -jnp.inf
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Combine two normalized attention results over disjoint KV sets.
+    -inf lse means 'attended nothing'; fully guarded against nan grads."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    w1 = jnp.where(jnp.isfinite(lse1), jnp.exp(jnp.minimum(lse1 - m_safe, 0.0)), 0.0)
+    w2 = jnp.where(jnp.isfinite(lse2), jnp.exp(jnp.minimum(lse2 - m_safe, 0.0)), 0.0)
+    denom = w1 + w2
+    denom_safe = jnp.where(denom == 0, 1.0, denom)
+    out = (o1.astype(jnp.float32) * w1[..., None] + o2.astype(jnp.float32) * w2[..., None]) / \
+        denom_safe[..., None]
+    lse = jnp.where(denom == 0, _NEG_INF, m_safe + jnp.log(denom_safe))
+    return out.astype(o1.dtype), lse
+
+
+def ring_attention_local(q, k, v, axis_name="seq", causal=True, block_q=512, block_kv=512,
+                         scale=None):
+    """Per-chip body — call inside ``shard_map`` with ``axis_name`` bound.
+
+    q: (B, H, Tc, D); k/v: (B, Hkv, Tc, D) — this chip's sequence chunk
+    (global position = chip index * Tc + local). Returns (B, H, Tc, D)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, Tc, D = q.shape
+
+    def attend(kv, causal_flag):
+        kk, vv = kv
+        return flash_attention_with_lse(q, kk, vv, causal_flag, block_q, block_kv, scale)
+
+    # step 0: the causal diagonal chunk
+    out, lse = jax.checkpoint(functools.partial(attend, causal_flag=causal))((k, v))
+
+    if n == 1:
+        return out
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(s, carry):
+        out, lse, kv = carry
+        kv = jax.tree_util.tree_map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
+        o_s, lse_s = jax.checkpoint(functools.partial(attend, causal_flag=False))(kv)
+        if causal:
+            # chip i now sees chunk (i - s) mod n; wrapped chunks are future
+            keep = (idx >= s)[None, None, None]
+            lse_s = jnp.where(keep, lse_s, _NEG_INF)
+        out, lse = _merge(out, lse, o_s, lse_s)
+        return out, lse, kv
+
+    out, lse, _ = jax.lax.fori_loop(1, n, body, (out, lse, (k, v)))
+    return out
+
+
+def ring_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
+    """Mesh-level entry: q (B, H, T, D), k/v (B, Hkv, T, D) sequence-sharded
+    over the ``seq`` axis, batch over data axes, heads over ``tensor`` (when
+    divisible). Runs the ring inside ``shard_map``; falls back to a plain
+    flash call on a trivial mesh."""
+    from ...comm import comm as dist
+
+    if dist.in_manual_region():
+        # already inside someone's shard_map: run the ring only if the seq
+        # axis is actually bound there
+        if dist.SEQ_AXIS in dist._state["manual_axes"]:
+            return ring_attention_local(q, k, v, dist.SEQ_AXIS, causal, block_q, block_kv, scale)
+        return _dense_fallback(q, k, v, causal, block_q, block_kv, scale)
+    if not dist.has_mesh() or dist.get_mesh().shape[dist.SEQ_AXIS] == 1:
+        return _dense_fallback(q, k, v, causal, block_q, block_kv, scale)
+
+    mesh = dist.get_mesh()
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
+    dp_axes, _ = dist.attention_partition_axes(B, H)
+    # heads ride the tensor axis so TP shards attention instead of
+    # regathering it (the auto partitioner cannot split a pallas_call)
+    tdeg = mesh.shape[dist.TENSOR_AXIS]
+    head_axis = dist.TENSOR_AXIS if (tdeg > 1 and H % tdeg == 0) else None
+    if head_axis and Hkv % tdeg != 0:  # GQA narrower than TP: expand KV heads
+        k = jnp.repeat(k, H // Hkv, axis=1)
+        v = jnp.repeat(v, H // Hkv, axis=1)
+    spec = P(dp_axes or None, head_axis, dist.SEQ_AXIS, None)
+    axes = set(dp_axes) | {dist.SEQ_AXIS} | ({head_axis} if head_axis else set())
+
+    def fn(q, k, v):
+        return ring_attention_local(q, k, v, dist.SEQ_AXIS, causal, block_q, block_kv, scale)
+
+    with dist.manual_axes(axes):
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                             axis_names=axes, check_vma=False)(q, k, v)
+
+
+def _dense_fallback(q, k, v, causal, block_q, block_kv, scale):
+    from .flash_attention import sharded_flash_attention
+    return sharded_flash_attention(q, k, v, causal, block_q, block_kv, scale)

@@ -72,3 +72,32 @@ def test_sp2_batch_places_seq_dim():
     placed = engine._shard_batch({"input_ids": rng.integers(0, 256, (16, 64)).astype(np.int32)})
     spec = placed["input_ids"].sharding.spec
     assert "seq" in str(spec), f"sequence dim not sharded: {spec}"
+
+
+def test_sp2_ring_matches_dense():
+    """Ring attention under seq=2: O(T/n) per shard, same numerics."""
+    base = run_losses(T=128, attention_impl="flash", steps=2)
+    ring = run_losses({"sequence_parallel_size": 2}, T=128, attention_impl="flash",
+                      sequence_parallel_impl="ring", steps=2)
+    assert np.allclose(base, ring, rtol=2e-4), f"{base} vs {ring}"
+
+
+def test_sp4_ring_matches_dense():
+    base = run_losses(T=256, attention_impl="flash", max_seq_len=256, steps=2)
+    ring = run_losses({"sequence_parallel_size": 4}, T=256, attention_impl="flash",
+                      max_seq_len=256, sequence_parallel_impl="ring", steps=2)
+    assert np.allclose(base, ring, rtol=2e-4), f"{base} vs {ring}"
+
+
+def test_ring_requires_flash():
+    import pytest
+    with pytest.raises(ValueError, match="requires attention_impl='flash'"):
+        get_model("tiny", sequence_parallel_impl="ring", attention_impl="xla")
+
+
+def test_sp2_tp2_ring_matches_dense():
+    """Ring + tensor parallel: heads shard over tensor inside the ring."""
+    base = run_losses(T=128, attention_impl="flash", steps=2)
+    ring = run_losses({"sequence_parallel_size": 2, "tensor_parallel_size": 2}, T=128,
+                      attention_impl="flash", sequence_parallel_impl="ring", steps=2)
+    assert np.allclose(base, ring, rtol=2e-4), f"{base} vs {ring}"
