@@ -83,6 +83,11 @@ def in_manual_region():
     return bool(_state["manual_axes"])
 
 
+def get_manual_axes():
+    """Axis names bound by enclosing ``manual_axes`` regions (frozenset)."""
+    return _state["manual_axes"]
+
+
 def attention_partition_axes(batch_size, num_heads):
     """Mesh placement for an attention computation on (B, T, H, D) tensors:
     batch over the data axes, heads over (seq, tensor) — the Ulysses-style

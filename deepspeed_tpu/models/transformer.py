@@ -434,6 +434,13 @@ class Attention(nn.Module):
             use_ring = (use_flash and cfg.sequence_parallel_impl == "ring"
                         and dist.has_mesh() and not dist.in_manual_region()
                         and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
+            if (cfg.sequence_parallel_impl == "ring" and not use_ring and dist.has_mesh()
+                    and not dist.in_manual_region()
+                    and dist.get_mesh().shape[dist.SEQ_AXIS] > 1):
+                from ..utils.logging import warning_once
+                warning_once("sequence_parallel_impl='ring' requested but this attention "
+                             "call cannot use it (needs the flash path: T >= 128 and no "
+                             "attention_mask) — falling back to full-sequence attention")
             if use_ring:
                 from ..ops.pallas.ring_attention import ring_attention
                 out = ring_attention(q, k, v, causal=True,

@@ -85,7 +85,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
         s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # rows whose every visited entry is masked exist only when the
+        # sequence is padded (causal rows always see the diagonal): only then
+        # pay for the explicit zero that yields l=0 -> zero output, -inf lse
+        # (otherwise exp(MASK - m_new) underflows to 0 on its own)
+        if seq_len % block_kv or seq_len % block_q:
+            p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        else:
+            p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jax.lax.dot_general(p.astype(v.dtype), v, (((1, ), (0, )), ((), ())),
@@ -96,7 +103,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
 
     l_safe = jnp.where(l == 0, 1.0, l)
     o_ref[0, 0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)  # (bq, 1)
+    lse_ref[0, 0] = jnp.where(l == 0, -jnp.inf, m + jnp.log(l_safe))  # (bq, 1)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_kv, causal,
@@ -108,7 +115,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
 
     q = q_ref[0, 0]
     do = do_ref[0, 0]
-    lse = lse_ref[0, 0]  # (bq, 1)
+    # -inf marks attended-nothing (padding) rows; neutralize so exp(s - lse)
+    # stays finite — their dq is sliced away / masked out downstream
+    lse = jnp.where(jnp.isfinite(lse_ref[0, 0]), lse_ref[0, 0], 0.0)  # (bq, 1)
     delta = delta_ref[0, 0]  # (bq, 1)
 
     num_kv = pl.cdiv(k_ref.shape[2], block_kv)
@@ -162,7 +171,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
         q_start = i * block_q
         q = q_ref[0, 0, pl.ds(q_start, block_q), :]
         do = do_ref[0, 0, pl.ds(q_start, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(q_start, block_q), :]  # (bq, 1)
+        lse_raw = lse_ref[0, 0, pl.ds(q_start, block_q), :]  # (bq, 1)
+        lse = jnp.where(jnp.isfinite(lse_raw), lse_raw, 0.0)
         delta = delta_ref[0, 0, pl.ds(q_start, block_q), :]  # (bq, 1)
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),

@@ -38,7 +38,10 @@ _NEG_INF = -jnp.inf
 
 def _merge(o1, lse1, o2, lse2):
     """Combine two normalized attention results over disjoint KV sets.
-    -inf lse means 'attended nothing'; fully guarded against nan grads."""
+    -inf lse means 'attended nothing'; fully guarded against nan grads.
+    Returns fp32 — the ring carry stays fp32 so only the final result
+    rounds to the model dtype (n-1 intermediate roundings would otherwise
+    accumulate in bf16)."""
     m = jnp.maximum(lse1, lse2)
     m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
     w1 = jnp.where(jnp.isfinite(lse1), jnp.exp(jnp.minimum(lse1 - m_safe, 0.0)), 0.0)
@@ -48,7 +51,7 @@ def _merge(o1, lse1, o2, lse2):
     out = (o1.astype(jnp.float32) * w1[..., None] + o2.astype(jnp.float32) * w2[..., None]) / \
         denom_safe[..., None]
     lse = jnp.where(denom == 0, _NEG_INF, m_safe + jnp.log(denom_safe))
-    return out.astype(o1.dtype), lse
+    return out, lse
 
 
 def ring_attention_local(q, k, v, axis_name="seq", causal=True, block_q=512, block_kv=512,
@@ -65,11 +68,12 @@ def ring_attention_local(q, k, v, axis_name="seq", causal=True, block_q=512, blo
         kk, vv = kv
         return flash_attention_with_lse(q, kk, vv, causal_flag, block_q, block_kv, scale)
 
-    # step 0: the causal diagonal chunk
-    out, lse = jax.checkpoint(functools.partial(attend, causal_flag=causal))((k, v))
+    # step 0: the causal diagonal chunk (fp32 carry; one rounding at the end)
+    out0, lse = jax.checkpoint(functools.partial(attend, causal_flag=causal))((k, v))
+    out = out0.astype(jnp.float32)
 
     if n == 1:
-        return out
+        return out.astype(q.dtype)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -85,7 +89,7 @@ def ring_attention_local(q, k, v, axis_name="seq", causal=True, block_q=512, blo
         return out, lse, kv
 
     out, lse, _ = jax.lax.fori_loop(1, n, body, (out, lse, (k, v)))
-    return out
+    return out.astype(q.dtype)
 
 
 def ring_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
@@ -98,7 +102,7 @@ def ring_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
     if dist.in_manual_region():
         # already inside someone's shard_map: run the ring only if the seq
         # axis is actually bound there
-        if dist.SEQ_AXIS in dist._state["manual_axes"]:
+        if dist.SEQ_AXIS in dist.get_manual_axes():
             return ring_attention_local(q, k, v, dist.SEQ_AXIS, causal, block_q, block_kv, scale)
         return _dense_fallback(q, k, v, causal, block_q, block_kv, scale)
     if not dist.has_mesh() or dist.get_mesh().shape[dist.SEQ_AXIS] == 1:

@@ -1,0 +1,105 @@
+"""Activation checkpointing API (reference
+``runtime/activation_checkpointing/checkpointing.py``: ``checkpoint`` :708,
+``configure`` :789, ``is_configured`` :871, ``CheckpointFunction`` :474).
+
+Design translation: the reference reimplements torch autograd checkpointing
+with partitioned + CPU-offloaded activations and RNG bookkeeping (~900 LoC).
+Under XLA every piece collapses into ``jax.checkpoint``:
+
+- recompute-in-backward  -> ``jax.checkpoint`` itself (policy-driven),
+- partition_activations  -> saved residuals keep their sharding; XLA SPMD
+  already stores each shard's slice only — nothing to partition by hand,
+- cpu_checkpointing      -> ``jax.checkpoint`` + host offload of residuals is
+  a placement policy (``save_and_offload_only_these_names``),
+- contiguous_memory_optimization / synchronize / profile -> allocator and
+  scheduler concerns XLA owns.
+
+``checkpoint(function, *args)`` therefore IS ``jax.checkpoint`` with the
+configured policy; models built from ``deepspeed_tpu.models`` normally use
+the ``activation_checkpointing`` config section instead (engine applies the
+remat policy to the layer stack), and this module serves code written
+against the reference's functional API.
+"""
+
+import jax
+
+from ...utils.logging import logger
+
+_config = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "number_checkpoints": None,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+    "configured": False,
+    "policy": None,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None, checkpoint_in_cpu=None,
+              synchronize=None, profile=None):
+    """Record the reference knobs; the ones with no XLA meaning warn once.
+    ``deepspeed_config``: dict (or object with ``raw_config``) whose
+    ``activation_checkpointing`` section seeds the keyword defaults, exactly
+    as the reference reads its json."""
+    _config["configured"] = True
+    if deepspeed_config is not None:
+        raw = getattr(deepspeed_config, "raw_config", deepspeed_config)
+        sec = dict(dict(raw).get("activation_checkpointing", {}))
+        if partition_activations is None:
+            partition_activations = sec.get("partition_activations")
+        if contiguous_checkpointing is None:
+            contiguous_checkpointing = sec.get("contiguous_memory_optimization")
+        if num_checkpoints is None:
+            num_checkpoints = sec.get("number_checkpoints")
+        if checkpoint_in_cpu is None:
+            checkpoint_in_cpu = sec.get("cpu_checkpointing")
+        if synchronize is None:
+            synchronize = sec.get("synchronize_checkpoint_boundary")
+        if profile is None:
+            profile = sec.get("profile")
+    if partition_activations is not None:
+        _config["partition_activations"] = partition_activations
+    if num_checkpoints is not None:
+        _config["number_checkpoints"] = num_checkpoints
+    if checkpoint_in_cpu:
+        _config["cpu_checkpointing"] = True
+        _config["policy"] = jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[], names_which_can_be_offloaded=[],
+            offload_src="device", offload_dst="pinned_host")
+    for name, val in (("contiguous_checkpointing", contiguous_checkpointing),
+                      ("synchronize", synchronize), ("profile", profile)):
+        if val:
+            logger.warning(f"activation checkpointing: {name} has no XLA equivalent "
+                           f"(allocator/scheduler owned); accepted as a no-op")
+
+
+def is_configured():
+    return _config["configured"]
+
+
+def reset():
+    _config["configured"] = False
+    _config["policy"] = None
+
+
+def checkpoint(function, *args):
+    """Recompute ``function``'s activations in backward (``jax.checkpoint``)."""
+    return jax.checkpoint(function, policy=_config["policy"])(*args)
+
+
+def model_parallel_cuda_manual_seed(seed):
+    """Reference RNG bookkeeping shim: JAX threads explicit PRNG keys, so a
+    global device seed has nothing to set; returns the key for callers that
+    want one."""
+    return jax.random.key(seed)
+
+
+class CheckpointFunction:
+    """Reference-shaped alias: ``CheckpointFunction.apply(fn, *args)``."""
+
+    @staticmethod
+    def apply(function, *args):
+        return checkpoint(function, *args)

@@ -112,3 +112,26 @@ def test_tensor_fragment_accessors(offload):
 def test_safe_get_full_grad_fused_path_returns_none():
     engine = engine_for_fragment_tests(False)
     assert safe_get_full_grad(engine, "linear_0/kernel") is None
+
+
+def test_activation_checkpointing_api():
+    """Reference-shaped functional API maps onto jax.checkpoint."""
+    from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+    ckpt.reset()
+    assert not ckpt.is_configured()
+    ckpt.configure(partition_activations=True, num_checkpoints=2)
+    assert ckpt.is_configured()
+
+    def f(x):
+        return jnp.sum(jnp.tanh(x @ x.T))
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((8, 8)), jnp.float32)
+    out = ckpt.checkpoint(f, x)
+    np.testing.assert_allclose(float(out), float(f(x)), rtol=1e-6)
+    g1 = jax.grad(lambda x: ckpt.checkpoint(f, x))(x)
+    g2 = jax.grad(f)(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+    assert ckpt.CheckpointFunction.apply(f, x) == out
+    key = ckpt.model_parallel_cuda_manual_seed(17)
+    assert key is not None
+    ckpt.reset()
