@@ -71,6 +71,21 @@ def _run(model_name, micro_bs, steps, seq=1024):
     return cfg, tokens / dt, dt / steps, final_loss, global_bs
 
 
+def _decode_bench(model_name="gpt2-large", bs=8, prompt=32, new=64):
+    """Inference decode throughput (tokens/s) — the serving half of the
+    tracked configs (reference kernel-injected inference)."""
+    import deepspeed_tpu
+    engine = deepspeed_tpu.init_inference(model_name, config={"dtype": "bf16",
+                                                              "max_out_tokens": 512})
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, 50257, (bs, prompt)).astype(np.int32)
+    engine.generate(prompts, max_new_tokens=new)  # compile + warm
+    t0 = time.perf_counter()
+    out = engine.generate(prompts, max_new_tokens=new)
+    dt = time.perf_counter() - t0
+    return sum(len(r) for r in out) / dt
+
+
 def main():
     import jax
     from deepspeed_tpu.accelerator import get_accelerator
@@ -84,6 +99,7 @@ def main():
 
     cfg_s, tok_s, step_s, loss_s, bs_s = _run("gpt2-125m", micro_bs=16, steps=60, seq=seq)
     mfu_s = _mfu(cfg_s, tok_s / n_chips, seq, peak)
+    decode_tps = _decode_bench()
 
     print(json.dumps({
         "metric": f"gpt2-large(774M) train MFU (bf16, seq{seq}, bs{bs_l}, fp32 Adam on-chip)",
@@ -97,6 +113,7 @@ def main():
             "gpt2_125m_tokens_per_sec_chip": round(tok_s / n_chips, 1),
             "gpt2_125m_mfu": round(mfu_s, 4),
             "gpt2_125m_ms_per_step": round(step_s * 1000, 1),
+            "gpt2_large_decode_tokens_per_sec": round(decode_tps, 1),
             "nominal_peak_tflops": round(peak / 1e12, 1),
             "n_chips": n_chips,
             # ZeRO-Offload capacity (measured offline, not re-run here: the

@@ -118,11 +118,19 @@ class InferenceEngine:
             return init(jax.random.key(0))
 
     def _load_checkpoint_host(self, path):
-        """Load weights from a ``save_16bit_model`` msgpack export or a
-        training checkpoint dir (reference ``inference/engine.py:419``
-        checkpoint loading, minus torch state_dict zoo)."""
+        """Load weights from a ``save_16bit_model`` msgpack export, a
+        training checkpoint dir, or a Megatron 'checkpoint json' description
+        (reference ``inference/engine.py:419`` -> ``SDLoaderFactory``)."""
         import os
         import flax.serialization
+        if isinstance(path, dict) or (isinstance(path, str) and path.endswith(".json")):
+            from ..module_inject.policy import MegatronPolicy
+            from ..module_inject.replace_module import _check_tree
+            from ..runtime.state_dict_factory import SDLoaderFactory
+            sd = SDLoaderFactory.get_sd_loader_json(path).load()
+            params = MegatronPolicy().convert(sd.__getitem__, self.model_config)
+            _check_tree(self.module, params)
+            return params
         if os.path.isfile(path):
             template = jax.eval_shape(self.module.init_params, jax.random.key(0))
             template = jax.tree_util.tree_map(lambda s: np.zeros(s.shape, s.dtype), template)

@@ -431,12 +431,11 @@ class Attention(nn.Module):
         else:
             new_cache = None
             use_flash = cfg.attention_impl == "flash" and T >= 128 and attn_mask is None
-            use_ring = (use_flash and cfg.sequence_parallel_impl == "ring"
-                        and dist.has_mesh() and not dist.in_manual_region()
-                        and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
-            if (cfg.sequence_parallel_impl == "ring" and not use_ring and dist.has_mesh()
-                    and not dist.in_manual_region()
-                    and dist.get_mesh().shape[dist.SEQ_AXIS] > 1):
+            ring_possible = (cfg.sequence_parallel_impl == "ring" and dist.has_mesh()
+                             and not dist.in_manual_region()
+                             and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
+            use_ring = use_flash and ring_possible
+            if ring_possible and not use_flash:
                 from ..utils.logging import warning_once
                 warning_once("sequence_parallel_impl='ring' requested but this attention "
                              "call cannot use it (needs the flash path: T >= 128 and no "

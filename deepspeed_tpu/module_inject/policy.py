@@ -279,12 +279,87 @@ class OPTPolicy(InjectionPolicy):
         return self._assemble(cfg, top, layer)
 
 
-replace_policies = [LlamaPolicy, MixtralPolicy, GPT2Policy, OPTPolicy]
+class MegatronPolicy(InjectionPolicy):
+    """Megatron-LM GPT checkpoints (reference ``containers/megatron_gpt.py`` +
+    ``MegatronSDLoader``'s key conventions): fused blocked [q;k;v] attention
+    weight, ``dense_h_to_4h``/``dense_4h_to_h`` MLP, learned positions,
+    pre-norm layernorm, tied embeddings. Unlike the HF policies this one
+    converts against an *existing* ``TransformerConfig`` (Megatron state
+    dicts carry no config.json), via :meth:`convert`.
+
+    The fused QKV must be in the blocked layout ``[q; k; v]`` along dim 0 —
+    what ``runtime/state_dict_factory.MegatronSDLoader`` produces after its
+    version-aware merge.
+    """
+
+    architectures = ("MegatronGPT", )
+    model_types = ("megatron", )
+
+    def build_config(self, hf, **overrides):
+        raise ValueError(
+            "Megatron checkpoints carry no config.json to derive a model from; pass the "
+            "model explicitly and route the checkpoint through init_inference(model, "
+            "config={'checkpoint': {'type': 'Megatron', 'checkpoints': [...], "
+            "'version': ...}})")
+
+    _PREFIXES = ("transformer.", "")  # checkpoint families differ
+
+    def _resolve(self, get, *names):
+        for name in names:
+            for pre in self._PREFIXES:
+                try:
+                    return get(pre + name)
+                except KeyError:
+                    continue
+        raise KeyError(f"none of {names} found in Megatron state dict")
+
+    def convert(self, get, cfg):
+        nh, hd, H = cfg.num_heads, cfg.head_size, cfg.hidden_size
+
+        def layer(i):
+            def g(name):
+                return self._resolve(get, f"layers.{i}.{name}")
+
+            qkv_w = g("attention.query_key_value.weight")  # (3H, H) blocked
+            qkv_b = g("attention.query_key_value.bias")
+            wq, wk, wv = np.split(qkv_w, 3, axis=0)
+            bq, bk, bv = np.split(qkv_b, 3)
+            return {
+                "attn_norm": {"scale": g("input_layernorm.weight"),
+                              "bias": g("input_layernorm.bias")},
+                "mlp_norm": {"scale": g("post_attention_layernorm.weight"),
+                             "bias": g("post_attention_layernorm.bias")},
+                "attn": {
+                    "q_proj": {"kernel": _heads_in(_t(wq), nh, hd), "bias": bq.reshape(nh, hd)},
+                    "k_proj": {"kernel": _heads_in(_t(wk), nh, hd), "bias": bk.reshape(nh, hd)},
+                    "v_proj": {"kernel": _heads_in(_t(wv), nh, hd), "bias": bv.reshape(nh, hd)},
+                    "o_proj": {"kernel": _heads_out(_t(g("attention.dense.weight")), nh, hd),
+                               "bias": g("attention.dense.bias")},
+                },
+                "mlp": {
+                    "up_proj": {"kernel": _t(g("mlp.dense_h_to_4h.weight")),
+                                "bias": g("mlp.dense_h_to_4h.bias")},
+                    "down_proj": {"kernel": _t(g("mlp.dense_4h_to_h.weight")),
+                                  "bias": g("mlp.dense_4h_to_h.bias")},
+                },
+            }
+
+        top = {
+            "embed": {"embedding": self._resolve(get, "word_embeddings.weight")[:cfg.vocab_size]},
+            "pos_embed": self._resolve(get, "position_embeddings.weight"),
+            "final_norm": {"scale": self._resolve(get, "final_layernorm.weight"),
+                           "bias": self._resolve(get, "final_layernorm.bias")},
+        }
+        return self._assemble(cfg, top, layer)
+
+
+replace_policies = [LlamaPolicy, MixtralPolicy, GPT2Policy, OPTPolicy, MegatronPolicy]
 
 
 def get_policy(hf_config):
-    # Mixtral before Llama: both match model_type prefixes via architectures
-    for cls in (MixtralPolicy, LlamaPolicy, GPT2Policy, OPTPolicy):
+    # Mixtral before Llama: both match model_type prefixes via architectures;
+    # MegatronPolicy last — it matches only to raise its routing explanation
+    for cls in (MixtralPolicy, LlamaPolicy, GPT2Policy, OPTPolicy, MegatronPolicy):
         if cls.matches(hf_config):
             return cls()
     raise ValueError(

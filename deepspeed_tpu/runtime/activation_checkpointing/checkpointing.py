@@ -66,8 +66,11 @@ def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
         _config["number_checkpoints"] = num_checkpoints
     if checkpoint_in_cpu:
         _config["cpu_checkpointing"] = True
+        # offload the residuals this codebase names via checkpoint_name (the
+        # flash kernel outputs — the big per-layer activations worth hosting)
         _config["policy"] = jax.checkpoint_policies.save_and_offload_only_these_names(
-            names_which_can_be_saved=[], names_which_can_be_offloaded=[],
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=["flash_out", "flash_lse"],
             offload_src="device", offload_dst="pinned_host")
     for name, val in (("contiguous_checkpointing", contiguous_checkpointing),
                       ("synchronize", synchronize), ("profile", profile)):

@@ -296,12 +296,12 @@ def test_inert_config_section_warns(caplog):
     ds_logger.propagate = True  # let caplog's root handler see records
     try:
         with caplog.at_level(logging.WARNING, logger="DeepSpeedTPU"):
-            DeepSpeedConfig({"train_batch_size": 8, "data_efficiency": {"enabled": True}}, world_size=1)
-        assert any("data_efficiency" in r.message and "NO effect" in r.message for r in caplog.records)
+            DeepSpeedConfig({"train_batch_size": 8, "amp": {"enabled": True}}, world_size=1)
+        assert any("amp" in r.message and "NO effect" in r.message for r in caplog.records)
         caplog.clear()
         with caplog.at_level(logging.WARNING, logger="DeepSpeedTPU"):
-            DeepSpeedConfig({"train_batch_size": 8, "data_efficiency": {}}, world_size=1)
-        assert not any("data_efficiency" in r.message for r in caplog.records)
+            DeepSpeedConfig({"train_batch_size": 8, "amp": {}}, world_size=1)
+        assert not any("amp" in r.message for r in caplog.records)
     finally:
         ds_logger.propagate = False
 
