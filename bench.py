@@ -73,10 +73,12 @@ def _run(model_name, micro_bs, steps, seq=1024):
 
 def _decode_bench(model_name="gpt2-large", bs=8, prompt=32, new=64):
     """Inference decode throughput (tokens/s) — the serving half of the
-    tracked configs (reference kernel-injected inference)."""
+    tracked configs (reference kernel-injected inference; kernel injection =
+    the Pallas decode-attention path)."""
     import deepspeed_tpu
     engine = deepspeed_tpu.init_inference(model_name, config={"dtype": "bf16",
-                                                              "max_out_tokens": 512})
+                                                              "max_out_tokens": 512,
+                                                              "replace_with_kernel_inject": True})
     rng = np.random.default_rng(0)
     prompts = rng.integers(0, 50257, (bs, prompt)).astype(np.int32)
     engine.generate(prompts, max_new_tokens=new)  # compile + warm

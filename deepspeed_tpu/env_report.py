@@ -6,7 +6,6 @@ console entry. Reports framework versions, the visible accelerator(s), and
 the native/kernel feature matrix (host cpu_adam build, Pallas kernels)."""
 
 import importlib
-import shutil
 import sys
 
 
@@ -23,28 +22,19 @@ def _version(mod_name):
 
 
 def op_compatibility():
-    """(name, installable, status_detail) per native/kernel feature —
-    the analogue of the reference's op table (op_builder ``is_compatible``)."""
+    """(name, installable, status_detail) per registered op — driven by the
+    op-builder registry (``ops/op_builder``), the analogue of the reference's
+    ``op_builder`` ``is_compatible`` table."""
+    from .ops.op_builder import ALL_OPS
     rows = []
-
-    cc = shutil.which("cc") or shutil.which("gcc")
-    try:
-        from .ops.adam.cpu_adam import cpu_adam_available
-        built = cpu_adam_available()
-    except Exception:
-        built = False
-    rows.append(("cpu_adam (host C, AVX via -march=native)", bool(cc), "built" if built else "not built"))
-
-    try:
-        importlib.import_module("deepspeed_tpu.ops.pallas.flash_attention")
-        rows.append(("flash_attention (Pallas)", True, "importable"))
-    except Exception as e:
-        rows.append(("flash_attention (Pallas)", False, str(e)))
-    try:
-        importlib.import_module("deepspeed_tpu.ops.pallas.decode_attention")
-        rows.append(("decode_attention (Pallas)", True, "importable"))
-    except Exception as e:
-        rows.append(("decode_attention (Pallas)", False, str(e)))
+    for name, builder in ALL_OPS.items():
+        try:
+            builder.load()
+            rows.append((f"{name} [{builder.MODULE.rsplit('.', 1)[-1]}]", True,
+                         "built" if name in ("cpu_adam", "cpu_adagrad", "async_io")
+                         else "importable"))
+        except Exception as e:
+            rows.append((name, False, str(e)[:60]))
     return rows
 
 

@@ -127,8 +127,21 @@ class InferenceEngine:
             from ..module_inject.policy import MegatronPolicy
             from ..module_inject.replace_module import _check_tree
             from ..runtime.state_dict_factory import SDLoaderFactory
-            sd = SDLoaderFactory.get_sd_loader_json(path).load()
-            params = MegatronPolicy().convert(sd.__getitem__, self.model_config)
+            desc = path if isinstance(path, dict) else None
+            if desc is None:
+                import json as _json
+                with open(path) as f:
+                    desc = _json.load(f)
+            version = desc.get("version")
+            layout = desc.get("qkv_layout")
+            if layout != "blocked" and version not in (0, 0.0):
+                raise ValueError(
+                    f"Megatron checkpoint version {version!r}: v1.0/2.0 fused QKV is head/"
+                    f"rank-interleaved and cannot be split into projections; only version 0 "
+                    f"(blocked [q;k;v]) converts — or add 'qkv_layout': 'blocked' to the "
+                    f"description if this checkpoint is known-blocked")
+            sd = SDLoaderFactory.get_sd_loader_json(desc).load()
+            params = MegatronPolicy(version=version or 0).convert(sd.__getitem__, self.model_config)
             _check_tree(self.module, params)
             return params
         if os.path.isfile(path):

@@ -288,12 +288,23 @@ class MegatronPolicy(InjectionPolicy):
     dicts carry no config.json), via :meth:`convert`.
 
     The fused QKV must be in the blocked layout ``[q; k; v]`` along dim 0 —
-    what ``runtime/state_dict_factory.MegatronSDLoader`` produces after its
-    version-aware merge.
+    what the loader's version-0 merge produces (and what single-rank blocked
+    exports store). Megatron's v1.0/2.0 fused layouts are head- or
+    rank-interleaved, which cannot be split into separate projections without
+    partition metadata the checkpoint does not carry — the reference never
+    needs the split because its injected kernels consume fused QKV. Pass
+    ``qkv_layout='blocked'`` to assert your checkpoint is blocked regardless
+    of its version tag.
     """
 
     architectures = ("MegatronGPT", )
     model_types = ("megatron", )
+
+    def __init__(self, qkv_layout="blocked", version=0):
+        self.qkv_layout = qkv_layout
+        self.version = version
+        if qkv_layout != "blocked":
+            raise ValueError(f"unsupported qkv_layout {qkv_layout!r} (only 'blocked')")
 
     def build_config(self, hf, **overrides):
         raise ValueError(

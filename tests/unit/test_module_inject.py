@@ -202,3 +202,20 @@ def test_megatron_checkpoint_into_inference(tmp_path):
     got = np.asarray(engine.forward(ids))
     ref = np.asarray(model.apply(params, ids))
     np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_megatron_v1_checkpoint_rejected(tmp_path):
+    """v1.0/2.0 fused-QKV layouts are interleaved and cannot be split; the
+    engine must refuse rather than serve silently-wrong weights."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.models import get_model
+    comm._state["mesh"] = None
+    model = get_model("tiny", num_kv_heads=4, norm="layernorm", activation="gelu",
+                      pos_embedding="learned", scan_layers=False, dtype=jnp.float32)
+    p = str(tmp_path / "mp_rank_00.pt")
+    torch.save({"module": {}}, p)
+    with pytest.raises(ValueError, match="version"):
+        deepspeed_tpu.init_inference(model, config={
+            "dtype": "fp32",
+            "checkpoint": {"type": "Megatron", "checkpoints": [p], "version": 1.0}})
