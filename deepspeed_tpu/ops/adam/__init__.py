@@ -1,2 +1,3 @@
 from .cpu_adam import DeepSpeedCPUAdam, cpu_adam_available  # noqa: F401
-from .onebit_adam import OneBitAdamState, onebit_adam, onebit_lamb  # noqa: F401
+from .onebit_adam import (OneBitAdamState, onebit_adam, onebit_lamb,  # noqa: F401
+                          zero_one_adam)

@@ -98,3 +98,45 @@ def test_onebit_lamb_converges():
     tx = onebit_lamb(5e-2, "data", freeze_step=100)
     _, loss_l = run_sharded(tx, X, y, params, steps=400)
     assert loss_l < 0.01 * start, f"1-bit LAMB failed to converge: {loss_l} vs start {start}"
+
+
+def test_zero_one_adam_converges():
+    from deepspeed_tpu.ops.adam.onebit_adam import zero_one_adam
+    X, y, params = make_problem(4, dim=128, n=512)
+    start = float(loss_fn(params, X, y))
+    tx = zero_one_adam(1e-1, "data", var_freeze_step=100, var_update_scaler=4)
+    _, loss_z = run_sharded(tx, X, y, params, steps=400)
+    assert loss_z < 1e-2 * start, f"0/1 Adam failed to converge: {loss_z} vs {start}"
+
+
+def test_zero_one_adam_variance_freezes():
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.adam.onebit_adam import zero_one_adam
+    X, y, params = make_problem(5)
+    tx = zero_one_adam(1e-2, "data", var_freeze_step=5, var_update_scaler=2)
+    mesh = comm.get_mesh() if comm.has_mesh() else comm.initialize_mesh()
+    world = mesh.shape["data"]
+    state = jax.tree_util.tree_map(
+        lambda x: jnp.broadcast_to(x[None], (world, ) + x.shape), tx.init(params))
+    Xs, ys = X.reshape(world, -1, DIM), y.reshape(world, -1, 1)
+
+    def step(p, s):
+        def shard(p, s, Xl, yl):
+            sl = jax.tree_util.tree_map(lambda x: x[0], s)
+            g = jax.grad(loss_fn)(p, Xl[0], yl[0])
+            u, s2 = tx.update(g, sl, p)
+            return u, jax.tree_util.tree_map(lambda x: x[None], s2)
+        u, s = jax.shard_map(shard, mesh=mesh,
+                             in_specs=(P(), P("data"), P("data"), P("data")),
+                             out_specs=(P(), P("data")), check_vma=False)(p, s, Xs, ys)
+        return optax.apply_updates(p, u), s
+
+    step = jax.jit(step)
+    p = dict(params)
+    v_snapshots = []
+    for i in range(10):
+        p, state = step(p, state)
+        v_snapshots.append(np.asarray(state.v["w"][0]).copy())
+    # after var_freeze_step=5 the variance never changes again
+    for later in v_snapshots[5:]:
+        np.testing.assert_array_equal(later, v_snapshots[4])
