@@ -480,7 +480,7 @@ class DeepSpeedEngine:
             # gradients (XLA's dense reduce-scatter — the right call on
             # bandwidth-rich ICI), so the compressed-momentum exchange has
             # nothing to compress here. The real error-compensated optimizers
-            # (ops/adam/onebit_adam.py: onebit_adam / onebit_lamb) run in
+            # (ops/adam/onebit_adam.py: onebit_adam / onebit_lamb / zero_one_adam) run in
             # shard_map loops over per-worker gradients — DCN-bound setups.
             logger.warning(f"{name}: using dense Adam math inside the pjit step; for actual "
                            f"1-bit compressed momentum use deepspeed_tpu.ops.adam.onebit_adam "
