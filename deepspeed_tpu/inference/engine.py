@@ -132,6 +132,11 @@ class InferenceEngine:
                 import json as _json
                 with open(path) as f:
                     desc = _json.load(f)
+            if desc.get("type") not in ("Megatron", "ds_model", "bloom"):
+                raise ValueError(
+                    f"checkpoint description dict has unsupported type {desc.get('type')!r}; "
+                    f"expected one of 'Megatron'/'ds_model'/'bloom' with keys "
+                    f"{{'type','checkpoints','version'}}, or pass a file/dir path instead")
             version = desc.get("version")
             layout = desc.get("qkv_layout")
             if layout != "blocked" and version not in (0, 0.0):
@@ -140,8 +145,15 @@ class InferenceEngine:
                     f"rank-interleaved and cannot be split into projections; only version 0 "
                     f"(blocked [q;k;v]) converts — or add 'qkv_layout': 'blocked' to the "
                     f"description if this checkpoint is known-blocked")
+            if layout == "blocked":
+                # The flag asserts every per-rank tensor is blocked [q;k;v]; the
+                # v1+ merge rule (plain rank concat) would interleave ranks, so
+                # force the version-0 regrouping merge regardless of the tag
+                # (a missing version key defaults to 1.0 in MegatronSDLoader,
+                # which would silently scramble Q/K/V the same way).
+                desc = {**desc, "version": 0}
             sd = SDLoaderFactory.get_sd_loader_json(desc).load()
-            params = MegatronPolicy(version=version or 0).convert(sd.__getitem__, self.model_config)
+            params = MegatronPolicy().convert(sd.__getitem__, self.model_config)
             _check_tree(self.module, params)
             return params
         if os.path.isfile(path):

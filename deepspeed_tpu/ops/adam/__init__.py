@@ -1,3 +1,3 @@
 from .cpu_adam import DeepSpeedCPUAdam, cpu_adam_available  # noqa: F401
-from .onebit_adam import (OneBitAdamState, onebit_adam, onebit_lamb,  # noqa: F401
-                          zero_one_adam)
+from .onebit_adam import (OneBitAdamState, ZeroOneAdamState,  # noqa: F401
+                          onebit_adam, onebit_lamb, zero_one_adam)

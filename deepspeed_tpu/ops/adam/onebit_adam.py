@@ -35,6 +35,14 @@ class OneBitAdamState(NamedTuple):
     error: optax.Updates  # 1-bit compression error feedback, per worker
 
 
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray
+    v_count: jnp.ndarray  # number of actual v updates (exponentially spaced)
+    m: optax.Updates
+    v: optax.Updates
+    error: optax.Updates
+
+
 def _init_onebit_state(params):
     zeros = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
     return OneBitAdamState(count=jnp.zeros((), jnp.int32), m=zeros,
@@ -115,7 +123,10 @@ def zero_one_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
     is implemented faithfully."""
     del local_step_scaler, local_step_clipper  # parity knobs; see docstring
 
-    init = _init_onebit_state
+    def init(params):
+        base = _init_onebit_state(params)
+        return ZeroOneAdamState(count=base.count, v_count=jnp.zeros((), jnp.int32),
+                                m=base.m, v=base.v, error=base.error)
 
     def _v_update_due(count):
         # doubling intervals: update at k, k + 2k, + 4k, ... until freeze
@@ -131,6 +142,7 @@ def zero_one_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
             raise ValueError("zero_one_adam with weight_decay requires params in update()")
         count = state.count + 1
         due = _v_update_due(count)
+        v_count = state.v_count + due.astype(jnp.int32)
         flat_g, treedef = jax.tree_util.tree_flatten(grads)
         flat_m = jax.tree_util.tree_leaves(state.m)
         flat_v = jax.tree_util.tree_leaves(state.v)
@@ -149,7 +161,9 @@ def zero_one_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
                 lambda vg: b2 * vg[0] + (1 - b2) * jnp.square(jax.lax.pmean(vg[1], axis_name)),
                 lambda vg: vg[0], (v, g))
             mhat = m2 / (1 - b1**count.astype(jnp.float32))
-            vhat = v2 / (1 - b2**jnp.minimum(count, var_freeze_step).astype(jnp.float32))
+            # bias-correct v by the number of times it actually updated (the
+            # exponentially-spaced schedule), not the step count
+            vhat = v2 / (1 - b2**jnp.maximum(v_count, 1).astype(jnp.float32))
             step = mhat / (jnp.sqrt(vhat) + eps)
             if weight_decay and p is not None:
                 step = step + weight_decay * p.astype(jnp.float32)
@@ -158,8 +172,8 @@ def zero_one_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
             new_e.append(e2)
             upd.append((-lr * step).astype(g.dtype))
         unf = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
-        return unf(upd), OneBitAdamState(count=count, m=unf(new_m), v=unf(new_v),
-                                         error=unf(new_e))
+        return unf(upd), ZeroOneAdamState(count=count, v_count=v_count, m=unf(new_m),
+                                          v=unf(new_v), error=unf(new_e))
 
     return optax.GradientTransformation(init, update)
 

@@ -139,8 +139,11 @@ class AsyncIOHandle:
                         with open(filename, "rb") as f:
                             f.seek(off)
                             data = f.read(view.nbytes)
+                        if len(data) != view.nbytes:
+                            raise OSError(f"short read from {filename}: got {len(data)} of "
+                                          f"{view.nbytes} bytes at offset {off}")
                         view[:] = np.frombuffer(data, np.uint8)
-                except OSError as e:
+                except Exception as e:  # always-drain invariant: no failure may wedge the handle
                     first_err = first_err or e
             # always drain: a failed request must not wedge the handle
             self._pending_sync.clear()

@@ -179,6 +179,7 @@ class DeepSpeedEngine:
 
         # ---- optimizer ---------------------------------------------------
         self.lr_schedule_fn, self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+        self._onebit = None  # set when a 1-bit/0-1 optimizer is configured
         self.tx = self._configure_optimizer(optimizer)
 
         # ---- state + shardings -------------------------------------------
@@ -365,6 +366,15 @@ class DeepSpeedEngine:
 
         if self.offload_optimizer:
             opt_state, opt_shardings = {}, {}
+        elif self._onebit:
+            # per-worker state (error feedback differs across DP ranks): every
+            # leaf carries a leading dp dim, sharded over the data axis
+            dp = self.mesh.shape[dist.DATA_AXIS]
+            base = jax.eval_shape(self.tx.init, params)
+            opt_state = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct((dp, ) + x.shape, x.dtype), base)
+            opt_shardings = jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P(dist.DATA_AXIS)), opt_state)
         else:
             opt_state = jax.eval_shape(self.tx.init, params)
             opt_shardings = self.planner.opt_state_shardings(opt_state, params)
@@ -379,11 +389,20 @@ class DeepSpeedEngine:
             skipped_steps=scalar,
         )
 
+        def init_opt(p):
+            if self.offload_optimizer:
+                return {}
+            if self._onebit:
+                dp = self.mesh.shape[dist.DATA_AXIS]
+                return jax.tree_util.tree_map(
+                    lambda x: jnp.broadcast_to(x[None], (dp, ) + x.shape), self.tx.init(p))
+            return self.tx.init(p)
+
         init_fn = jax.jit(
             lambda p: TrainState(
                 step=jnp.zeros((), jnp.int32),
                 params=p,
-                opt_state={} if self.offload_optimizer else self.tx.init(p),
+                opt_state=init_opt(p),
                 grad_acc={},
                 micro_step=jnp.zeros((), jnp.int32),
                 loss_scale=self.loss_scaler.init_state(),
@@ -476,16 +495,44 @@ class DeepSpeedEngine:
         if name == LION_OPTIMIZER:
             return optax.lion(lr, b1=betas[0], b2=betas[1], weight_decay=wd)
         if name in (ONEBIT_ADAM_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
-            # The engine's pjit step hands the optimizer globally-reduced
-            # gradients (XLA's dense reduce-scatter — the right call on
-            # bandwidth-rich ICI), so the compressed-momentum exchange has
-            # nothing to compress here. The real error-compensated optimizers
-            # (ops/adam/onebit_adam.py: onebit_adam / onebit_lamb / zero_one_adam) run in
-            # shard_map loops over per-worker gradients — DCN-bound setups.
-            logger.warning(f"{name}: using dense Adam math inside the pjit step; for actual "
-                           f"1-bit compressed momentum use deepspeed_tpu.ops.adam.onebit_adam "
-                           f"in a shard_map training loop (see its tests)")
-            return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+            # Error-compensated compressed-communication optimizers (reference
+            # fp16/onebit/adam.py:13 via _configure_basic_optimizer
+            # engine.py:1197). The train step switches to a shard_map over the
+            # data axis where gradients stay per-shard and the optimizer's
+            # 1-bit momentum exchange is the only cross-DP wire traffic
+            # (_build_onebit_train_fn). Momentum/variance/error-feedback are
+            # per-worker full-size, so ZeRO sharding of optimizer state does
+            # not apply.
+            from ..ops.adam import onebit_adam, onebit_lamb, zero_one_adam
+            if self._config.zero_optimization.stage > 0:
+                raise ValueError(f"{cfg.type} is incompatible with ZeRO stage "
+                                 f"{self._config.zero_optimization.stage}: its momentum/error-"
+                                 f"feedback state is per-worker full-size (reference 1-bit Adam "
+                                 f"likewise requires stage 0); set zero stage 0")
+            if self.offload_optimizer:
+                raise ValueError(f"{cfg.type} does not compose with offload_optimizer")
+            for ax in (dist.PIPE_AXIS, dist.EXPERT_AXIS, dist.SEQ_AXIS, dist.TENSOR_AXIS):
+                if self.mesh.shape[ax] > 1:
+                    raise ValueError(f"{cfg.type} supports pure data-parallel meshes only "
+                                     f"(mesh axis {ax!r}={self.mesh.shape[ax]})")
+            if self._config.gradient_clipping:
+                logger.warning(f"{cfg.type}: gradient clipping uses the proxy norm "
+                               f"sqrt(mean_dp ||g_shard||^2) — an upper bound on the true "
+                               f"averaged-gradient norm (the dense norm would need the dense "
+                               f"allreduce the optimizer exists to avoid)")
+            self._onebit = name
+            common = dict(b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+            if name == ONEBIT_ADAM_OPTIMIZER:
+                return onebit_adam(lr, dist.DATA_AXIS,
+                                   freeze_step=p.get("freeze_step", 100), **common)
+            if name == ONEBIT_LAMB_OPTIMIZER:
+                return onebit_lamb(lr, dist.DATA_AXIS,
+                                   freeze_step=p.get("freeze_step", 100),
+                                   min_trust=p.get("min_coeff", 0.01),
+                                   max_trust=p.get("max_coeff", 10.0), **common)
+            return zero_one_adam(lr, dist.DATA_AXIS,
+                                 var_freeze_step=p.get("var_freeze_step", 100),
+                                 var_update_scaler=p.get("var_update_scaler", 16), **common)
         raise ValueError(f"Unknown optimizer type {cfg.type}")
 
     # ------------------------------------------------------------------ step math
@@ -505,13 +552,24 @@ class DeepSpeedEngine:
         grads, (loss, aux) = jax.grad(scaled_loss, has_aux=True)(params)
         return loss, grads
 
+    def _grad_denom(self, scale):
+        """Loss-scale x gas (x predivide) unscaling denominator."""
+        denom = scale * self._config.gradient_accumulation_steps
+        if self._config.prescale_gradients:
+            denom = denom * self._config.gradient_predivide_factor
+        return denom
+
+    def _clip_coef(self, gnorm):
+        """Gradient-clipping coefficient, or None when clipping is off."""
+        clip = self._config.gradient_clipping
+        if clip and clip > 0:
+            return jnp.minimum(1.0, clip / (gnorm + 1e-6))
+        return None
+
     def _apply_grads(self, state, grads, loss_mean):
         """Unscale, clip, update, handle overflow — shared by both paths."""
-        cfg = self._config
         scale = state.loss_scale.cur_scale
-        denom = scale * cfg.gradient_accumulation_steps
-        if cfg.prescale_gradients:
-            denom = denom * cfg.gradient_predivide_factor
+        denom = self._grad_denom(scale)
         grads = jax.tree_util.tree_map(lambda g: (g / denom).astype(jnp.float32), grads)
         # stage>=2: pin gradients to their scattered sharding
         grads = jax.lax.with_sharding_constraint(
@@ -519,9 +577,8 @@ class DeepSpeedEngine:
 
         gnorm = optax.global_norm(grads)
         overflow = ~jnp.isfinite(gnorm)
-        clip = cfg.gradient_clipping
-        if clip and clip > 0:
-            coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+        coef = self._clip_coef(gnorm)
+        if coef is not None:
             grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
 
         updates, new_opt = self.tx.update(grads, state.opt_state, state.params)
@@ -577,6 +634,106 @@ class DeepSpeedEngine:
         return jax.jit(train_step,
                        donate_argnums=(0, ),
                        in_shardings=(self.state_shardings, self._batch_shardings_cache()),
+                       out_shardings=(self.state_shardings, NamedSharding(self.mesh, P())))
+
+    def _build_onebit_train_fn(self):
+        """1-bit / 0-1 Adam fused step (reference ``fp16/onebit/adam.py:13``
+        wired through ``engine.py:1197``): the whole step runs in a
+        ``shard_map`` over the data axis. Gradients are computed and kept
+        per-DP-shard — the error-compensated compressed-momentum exchange
+        inside the optimizer (``runtime/comm/compressed.onebit_all_reduce``)
+        is the ONLY cross-DP communication, so past ``freeze_step`` the wire
+        carries ~1/32 of a dense allreduce's bytes (sign plane + scale)."""
+        gas = self._config.gradient_accumulation_steps
+        axis = dist.DATA_AXIS
+        dp = self.mesh.shape[axis]
+        compute_dtype = self.compute_dtype
+        loss_fn = self.loss_fn
+        tx = self.tx
+        base_rng = self._base_rng
+
+        def shard_fn(params, opt_state, scale, step, batch_shard):
+            opt_local = jax.tree_util.tree_map(lambda x: x[0], opt_state)
+            rng = jax.random.fold_in(jax.random.fold_in(base_rng, step),
+                                     jax.lax.axis_index(axis))
+
+            def scaled_loss(p, mb, r):
+                p_c = jax.tree_util.tree_map(lambda x: jnp.asarray(x, compute_dtype), p)
+                out = loss_fn(p_c, mb, r)
+                loss = out[0] if isinstance(out, tuple) else out
+                return loss.astype(jnp.float32) * scale, loss
+
+            def micro(carry, mb):
+                acc, loss_sum, i = carry
+                grads, loss = jax.grad(scaled_loss, has_aux=True)(params, mb,
+                                                                  jax.random.fold_in(rng, i))
+                acc = jax.tree_util.tree_map(lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return (acc, loss_sum + loss.astype(jnp.float32), i + 1), None
+
+            zero_acc = jax.tree_util.tree_map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
+            (grads, loss_sum, _), _ = jax.lax.scan(
+                micro, (zero_acc, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+                batch_shard)
+
+            denom = self._grad_denom(scale)
+            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            sumsq = sum(jnp.sum(jnp.square(g)) for g in jax.tree_util.tree_leaves(grads))
+            mean_sq = jax.lax.psum(sumsq, axis) / dp
+            overflow = ~jnp.isfinite(mean_sq)
+            # proxy norm (see _configure_optimizer warning): upper bound on
+            # the averaged-gradient norm without a dense allreduce
+            gnorm = jnp.sqrt(mean_sq)
+            coef = self._clip_coef(gnorm)
+            if coef is not None:
+                grads = jax.tree_util.tree_map(lambda g: g * coef, grads)
+            # overflow: feed zeros through the exchange (keeps it finite),
+            # then discard every result below
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.where(overflow, jnp.zeros_like(g), g), grads)
+            updates, new_opt = tx.update(grads, opt_local, params)
+            new_params = optax.apply_updates(params, updates)
+
+            def sel(new, old):
+                return jax.tree_util.tree_map(lambda n, o: jnp.where(overflow, o, n), new, old)
+
+            new_params = sel(new_params, params)
+            new_opt = sel(new_opt, opt_local)
+            loss_mean = jax.lax.pmean(loss_sum, axis) / gas
+            return (new_params, jax.tree_util.tree_map(lambda x: x[None], new_opt),
+                    loss_mean, gnorm, overflow)
+
+        def train_step(state, batch):
+            # dim 0 is the gas scan dim; dim 1 (when present) is the batch dim
+            # sharded over data; rank-1 leaves (e.g. __pld_theta__) replicate
+            batch_specs = jax.tree_util.tree_map(
+                lambda x: P(*(([None, axis] + [None] * max(x.ndim - 2, 0))[:x.ndim])), batch)
+            opt_specs = jax.tree_util.tree_map(lambda _: P(axis), state.opt_state)
+            new_params, new_opt, loss_mean, gnorm, overflow = jax.shard_map(
+                shard_fn, mesh=self.mesh,
+                in_specs=(P(), opt_specs, P(), P(), batch_specs),
+                out_specs=(P(), opt_specs, P(), P(), P()),
+                check_vma=False)(state.params, state.opt_state, state.loss_scale.cur_scale,
+                                 state.step, batch)
+            new_scale = self.loss_scaler.update(state.loss_scale, overflow)
+            new_state = state._replace(
+                step=state.step + jnp.where(overflow, 0, 1),
+                params=new_params,
+                opt_state=new_opt,
+                micro_step=jnp.zeros((), jnp.int32),
+                loss_scale=new_scale,
+                skipped_steps=state.skipped_steps + overflow.astype(jnp.int32),
+            )
+            metrics = {
+                "loss": loss_mean,
+                "grad_norm": gnorm,
+                "lr": self.lr_schedule_fn(state.step.astype(jnp.float32)),
+                "overflow": overflow,
+                "loss_scale": state.loss_scale.cur_scale,
+            }
+            return new_state, metrics
+
+        return jax.jit(train_step,
+                       donate_argnums=(0, ),
                        out_shardings=(self.state_shardings, NamedSharding(self.mesh, P())))
 
     def _build_train_batch_fn(self):
@@ -863,7 +1020,8 @@ class DeepSpeedEngine:
         if self.offload_optimizer:
             metrics = self._offload_train_batch(stacked)
         else:
-            fn = self._get("train_batch", self._build_train_batch_fn)
+            fn = self._get("train_batch", self._build_onebit_train_fn if self._onebit
+                           else self._build_train_batch_fn)
             with self.mesh:
                 self.state, metrics = fn(self.state, stacked)
         self.global_steps += 1
@@ -890,6 +1048,10 @@ class DeepSpeedEngine:
         if self.offload_optimizer:
             raise RuntimeError("the forward/backward/step facade is not supported with "
                                "offload_optimizer; use train_batch()")
+        if self._onebit:
+            raise RuntimeError("the forward/backward/step facade is not supported with 1-bit "
+                               "optimizers (the compressed exchange lives inside the fused "
+                               "shard_map step); use train_batch()")
         if self.wall_clock_breakdown:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         self._ensure_grad_acc()

@@ -219,3 +219,86 @@ def test_megatron_v1_checkpoint_rejected(tmp_path):
         deepspeed_tpu.init_inference(model, config={
             "dtype": "fp32",
             "checkpoint": {"type": "Megatron", "checkpoints": [p], "version": 1.0}})
+
+
+def test_megatron_blocked_override_forces_v0_merge(tmp_path):
+    """A multi-rank checkpoint tagged v2.0 but asserted 'qkv_layout':
+    'blocked' must merge with the version-0 regrouping rule — a plain rank
+    concat would interleave [q0;k0;v0;q1;k1;v1] and MegatronPolicy's
+    thirds-split would serve scrambled Q/K/V (ADVICE r2, medium)."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.models import get_model
+
+    comm._state["mesh"] = None
+    model = get_model("tiny", num_kv_heads=4, norm="layernorm", activation="gelu",
+                      pos_embedding="learned", tie_embeddings=True, scan_layers=False,
+                      dtype=jnp.float32)
+    cfg = model.cfg
+    params = model.init_params(jax.random.key(1))
+    nh, hd, H = cfg.num_heads, cfg.head_size, cfg.hidden_size
+
+    def rank_sd(r):
+        sd = {}
+        half = lambda w, axis: np.split(np.asarray(w, np.float32), 2, axis=axis)[r]
+        emb = np.asarray(params["embed"]["embedding"], np.float32)
+        sd["word_embeddings.weight"] = half(emb, 0)
+        sd["position_embeddings.weight"] = np.asarray(params["pos_embed"], np.float32)
+        for i in range(cfg.num_layers):
+            lp = params[f"layer_{i}"]
+            pre = f"transformer.layers.{i}."
+            qkv = np.concatenate([
+                np.asarray(lp["attn"][f"{n}_proj"]["kernel"], np.float32).reshape(H, nh * hd).T
+                for n in ("q", "k", "v")])
+            qkv_b = np.concatenate([np.asarray(lp["attn"][f"{n}_proj"]["bias"]).reshape(-1)
+                                    for n in ("q", "k", "v")])
+            sd[pre + "attention.query_key_value.weight"] = np.concatenate(
+                [half(c, 0) for c in np.split(qkv, 3)])
+            sd[pre + "attention.query_key_value.bias"] = np.concatenate(
+                [half(c, 0) for c in np.split(qkv_b, 3)])
+            o_k = np.asarray(lp["attn"]["o_proj"]["kernel"], np.float32).reshape(nh * hd, H).T
+            sd[pre + "attention.dense.weight"] = half(o_k, 1)
+            sd[pre + "attention.dense.bias"] = np.asarray(lp["attn"]["o_proj"]["bias"])
+            sd[pre + "input_layernorm.weight"] = np.asarray(lp["attn_norm"]["scale"])
+            sd[pre + "input_layernorm.bias"] = np.asarray(lp["attn_norm"]["bias"])
+            sd[pre + "post_attention_layernorm.weight"] = np.asarray(lp["mlp_norm"]["scale"])
+            sd[pre + "post_attention_layernorm.bias"] = np.asarray(lp["mlp_norm"]["bias"])
+            up = np.asarray(lp["mlp"]["up_proj"]["kernel"], np.float32).T
+            down = np.asarray(lp["mlp"]["down_proj"]["kernel"], np.float32).T
+            sd[pre + "mlp.dense_h_to_4h.weight"] = half(up, 0)
+            sd[pre + "mlp.dense_h_to_4h.bias"] = half(
+                np.asarray(lp["mlp"]["up_proj"]["bias"], np.float32), 0)
+            sd[pre + "mlp.dense_4h_to_h.weight"] = half(down, 1)
+            sd[pre + "mlp.dense_4h_to_h.bias"] = np.asarray(lp["mlp"]["down_proj"]["bias"])
+        sd["transformer.final_layernorm.weight"] = np.asarray(params["final_norm"]["scale"])
+        sd["transformer.final_layernorm.bias"] = np.asarray(params["final_norm"]["bias"])
+        return sd
+
+    paths = []
+    for r in range(2):
+        p = str(tmp_path / f"mp_rank_{r:02d}_model_states.pt")
+        torch.save({"module": {k: torch.tensor(v) for k, v in rank_sd(r).items()}}, p)
+        paths.append(p)
+
+    engine = deepspeed_tpu.init_inference(
+        model, config={"dtype": "fp32",
+                       "checkpoint": {"type": "Megatron", "checkpoints": paths,
+                                      "version": 2.0, "qkv_layout": "blocked"}})
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 256, (2, 16)), jnp.int32)
+    got = np.asarray(engine.forward(ids))
+    ref = np.asarray(model.apply(params, ids))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_non_megatron_checkpoint_dict_rejected():
+    """A checkpoint dict of unknown type must fail with a clear message, not
+    a misleading Megatron-version error (ADVICE r2, low)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.models import get_model
+    comm._state["mesh"] = None
+    model = get_model("tiny", scan_layers=False, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="unsupported type"):
+        deepspeed_tpu.init_inference(model, config={
+            "dtype": "fp32", "checkpoint": {"weights": "somewhere"}})
