@@ -132,7 +132,7 @@ class InferenceEngine:
                 import json as _json
                 with open(path) as f:
                     desc = _json.load(f)
-            if desc.get("type") not in ("Megatron", "ds_model", "bloom"):
+            if str(desc.get("type", "")).lower() not in ("megatron", "ds_model", "bloom"):
                 raise ValueError(
                     f"checkpoint description dict has unsupported type {desc.get('type')!r}; "
                     f"expected one of 'Megatron'/'ds_model'/'bloom' with keys "

@@ -333,9 +333,11 @@ class DeepSpeedEngine:
 
     def _init_host_optimizer(self, params_f32):
         """ZeRO-Offload: move fp32 master + moments to host DRAM (or NVMe —
-        ZeRO-Infinity), return the compute-dtype device params that replace
-        them in TrainState. HBM afterwards holds only ~2 bytes/param instead
-        of 16 (and with NVMe, host DRAM holds only a rotating leaf window)."""
+        ZeRO-Infinity), PARTITIONED per host over the DP axes, and return the
+        compute-dtype device params that replace them in TrainState. HBM
+        afterwards holds only ~2 bytes/param instead of 16, host DRAM holds
+        12 bytes/param ÷ dp_world (and with NVMe, only a rotating block
+        window)."""
         from .zero.offload import HostOffloadOptimizer
         off = self._config.zero_optimization.offload_optimizer
         if off.device == "nvme":
@@ -348,15 +350,22 @@ class DeepSpeedEngine:
             self.host_opt.compute_dtype = self.compute_dtype
         else:
             self.host_opt = HostOffloadOptimizer(self._config.optimizer, self.lr_schedule_fn)
-        self.host_opt.init_from_device(params_f32)
-        shardings = self.planner.shardings(self.planner.master_specs(params_f32))
+        # lay the master out in the offload sharding (scattered over DP even
+        # at stage 0) so each host pulls exactly its partition
+        off_shardings = self.planner.shardings(self.planner.offload_specs(params_f32))
+        reshard = jax.jit(lambda p: p, donate_argnums=(0, ), out_shardings=off_shardings)
+        with self.mesh:
+            params_off = reshard(params_f32)
+        self.host_opt.init_from_device(params_off)
+        shardings = self.planner.shardings(self.planner.master_specs(params_off))
         cast = jax.jit(lambda p: jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype), p),
                        donate_argnums=(0, ), out_shardings=shardings)
         with self.mesh:
-            compute_params = cast(params_f32)
+            compute_params = cast(params_off)
         tier = "NVMe" if off.device == "nvme" else "host DRAM"
         log_dist(f"ZeRO-Offload: {self.host_opt.num_params():,} params' optimizer state on {tier} "
-                 f"(native cpu_adam), {jnp.dtype(self.compute_dtype).name} compute copy in HBM", [0])
+                 f"(this host's partition, native cpu_adam), "
+                 f"{jnp.dtype(self.compute_dtype).name} compute copy in HBM", [0])
         return compute_params
 
     def _init_state(self, params):
@@ -808,7 +817,10 @@ class DeepSpeedEngine:
             return grads_out, {"loss_sum": loss_sum, "gnorm_raw": gnorm_raw}
 
         scalar = NamedSharding(self.mesh, P())
-        grad_shardings = self.planner.shardings(self.planner.grad_specs(self.state.params))
+        # grads leave the device reduce-scattered into the offload layout so
+        # each host fetches only its partition's shards (reference
+        # stage_1_and_2.py:1031; fixes the fetch-the-world gather)
+        grad_shardings = self.planner.shardings(self.planner.offload_specs(self.state.params))
         return jax.jit(grad_step,
                        in_shardings=(self.state_shardings, self._batch_shardings_cache()),
                        out_shardings=(grad_shardings,
@@ -1218,9 +1230,10 @@ class DeepSpeedEngine:
         # buffers, engine.py:3012)
         _save(save_dir, tag, self.state._replace(grad_acc={}), client_sd, save_latest=save_latest,
               use_async=self._config.checkpoint.async_save)
-        if self.offload_optimizer and jax.process_index() == 0:
-            # offloaded master/moments ride next to the device state (npz for
-            # the DRAM tier; streamed file copies for the NVMe tier)
+        if self.offload_optimizer:
+            # every host saves ITS partition of the offloaded master/moments
+            # (streamed block npz, shared by both tiers); the loader
+            # reassembles across rank files, so resume survives mesh resize
             self.host_opt.save_to(os.path.join(save_dir, str(tag)))
         log_dist(f"saved checkpoint {save_dir}/{tag}", [0])
         return True

@@ -1,17 +1,21 @@
-"""ZeRO-Infinity optimizer tier: fp32 master + Adam moments on NVMe.
+"""ZeRO-Infinity optimizer tier: fp32 master + Adam moments on NVMe,
+partitioned across DP ranks/hosts.
 
 Counterpart of the reference's ``partitioned_optimizer_swapper.py:40`` /
 ``pipelined_optimizer_swapper.py:164`` + the libaio engine. Host DRAM holds
-only a small rotating window of leaves; everything else lives in three flat
-files per leaf (master/m/v) under ``nvme_path``. The step pipeline is
+only a small rotating window of blocks; everything else lives in three flat
+files per owned block (master/m/v) under ``nvme_path``. Each host owns only
+the blocks its devices hold in the offload sharding (reference
+``stage3.py:463 _configure_tensor_swapping`` swaps per-rank subgroups), so
+NVMe capacity scales with the number of feeding hosts. The step pipeline is
 
-    read[i+1] in flight  |  C AdamW on leaf i  |  write[i-1] in flight
+    read[i+1] in flight  |  C AdamW on block i  |  write[i-1] in flight
 
-using two AsyncIOHandle pools (reads / writes) so a leaf's write-back
-overlaps the next leaf's read AND the compute — the reference's
+using two AsyncIOHandle pools (reads / writes) so a block's write-back
+overlaps the next block's read AND the compute — the reference's
 "pipelined read/write" mode (``pipeline_read``/``pipeline_write``).
 
-DRAM high-water mark is O(3 largest-leaf buffers x 2) + the transient bf16
+DRAM high-water mark is O(3 largest-block buffers x 2) + the transient bf16
 compute copy, independent of model size — how a model whose optimizer state
 exceeds both HBM *and* host DRAM still steps (ZeRO-Infinity's pitch,
 reference blog "10x bigger models").
@@ -23,116 +27,96 @@ import numpy as np
 
 import jax
 
-from ...ops.adam.cpu_adam import f32_to_bf16
 from ...ops.aio import AsyncIOHandle
 from ...utils.logging import log_dist
 from ..zero.offload import HostOffloadOptimizer, _TRANSFER_POOL
 
 
-class _LeafStore:
-    """Three flat fp32 files per leaf under ``dir_``."""
-
-    def __init__(self, dir_, index, shape):
-        self.shape = shape
-        self.paths = {kind: os.path.join(dir_, f"leaf{index:05d}.{kind}") for kind in ("master", "m", "v")}
-
-    def nbytes(self):
-        return int(np.prod(self.shape, dtype=np.int64)) * 4
-
-
 class NVMeOffloadOptimizer(HostOffloadOptimizer):
-    """Drop-in for HostOffloadOptimizer with NVMe-resident state."""
+    """Drop-in for HostOffloadOptimizer with NVMe-resident block state."""
 
     def __init__(self, optimizer_config, lr_schedule_fn, nvme_path, aio_config=None,
                  pipeline_read=True, pipeline_write=True):
         super().__init__(optimizer_config, lr_schedule_fn)
         from .aio_config import get_aio_config
         aio = aio_config if aio_config is not None else get_aio_config({})
-        # two pools so write-back of leaf i-1 overlaps the read of leaf i+1;
+        # two pools so write-back of block i-1 overlaps the read of block i+1;
         # per-pool threads double the configured count for the same reason
         # the reference's overlap_events mode uses separate submit/complete
         # threads
         handle_kw = dict(block_size=aio["block_size"], queue_depth=aio["queue_depth"],
                          single_submit=aio["single_submit"], overlap_events=aio["overlap_events"],
                          thread_count=max(1, aio["thread_count"]) * 2)
-        self.swap_dir = os.path.join(nvme_path, "zero_stage_opt_swap")
+        # rank-scoped so hosts sharing one NVMe namespace never collide
+        self.swap_dir = os.path.join(nvme_path,
+                                     f"zero_stage_opt_swap_rank{jax.process_index():05d}")
         os.makedirs(self.swap_dir, exist_ok=True)
         self._read_h = AsyncIOHandle(**handle_kw)
         self._write_h = AsyncIOHandle(**handle_kw)
         self.pipeline_read = pipeline_read
         self.pipeline_write = pipeline_write
-        self._stores = None  # list[_LeafStore]
-        self._treedef = None
         self._out = None  # transient compute-dtype leaves produced by step()
         self.compute_dtype = None  # set by the engine before the first step
 
+    def _paths(self, i):
+        return {kind: os.path.join(self.swap_dir, f"blk{i:05d}.{kind}")
+                for kind in ("master", "m", "v")}
+
     # -- state lifecycle -------------------------------------------------
-    def init_from_device(self, params_f32):
-        leaves, treedef = jax.tree_util.tree_flatten(params_f32)
-        self._treedef = treedef
-        self._stores = []
-        zeros = np.zeros(max(int(np.prod(l.shape)) for l in leaves), np.float32)
+    def init_from_device(self, params_off):
+        self._record_layout(params_off)
+        pairs = self._discover_blocks(jax.tree_util.tree_leaves(params_off))
         window = 0
-        for i, leaf in enumerate(leaves):
-            host = np.array(jax.device_get(leaf), dtype=np.float32, copy=True)
-            store = _LeafStore(self.swap_dir, i, host.shape)
-            self._write_h.async_pwrite(host, store.paths["master"])  # keepalive pins host
+        zeros = np.zeros(max(blk.size for blk, _ in pairs), np.float32)
+        for i, (blk, data) in enumerate(pairs):
+            host = np.array(jax.device_get(data), np.float32, copy=True).reshape(-1)
+            paths = self._paths(i)
+            self._write_h.async_pwrite(host, paths["master"])  # keepalive pins host
             for kind in ("m", "v"):
-                self._write_h.async_pwrite(zeros[:host.size], store.paths[kind])
-            self._stores.append(store)
+                self._write_h.async_pwrite(zeros[:host.size], paths[kind])
             window += 1
-            if window >= 4:  # bound pinned DRAM to a few leaves, keep IO deep
+            if window >= 4:  # bound pinned DRAM to a few blocks, keep IO deep
                 self._write_h.wait()
                 window = 0
         self._write_h.wait()
-        total = sum(int(np.prod(s.shape)) for s in self._stores)
-        log_dist(f"ZeRO-Infinity: {total:,} params' optimizer state on NVMe "
-                 f"({3 * total * 4 / 2**30:.2f} GiB under {self.swap_dir})", ranks=[0])
         # master/m/v intentionally stay None: all access goes through files
-
-    def num_params(self):
-        return sum(int(np.prod(s.shape)) for s in self._stores)
+        total = self.num_params()
+        log_dist(f"ZeRO-Infinity: {total:,} params' optimizer state on NVMe "
+                 f"({3 * total * 4 / 2**30:.2f} GiB under {self.swap_dir}, this host's "
+                 f"partition)", ranks=[0])
 
     # -- the pipelined step ----------------------------------------------
-    def _read_leaf(self, store):
-        bufs = {kind: np.empty(int(np.prod(store.shape)), np.float32) for kind in ("master", "m", "v")}
+    def _read_block(self, i):
+        blk = self.blocks[i]
+        paths = self._paths(i)
+        bufs = {kind: np.empty(blk.size, np.float32) for kind in ("master", "m", "v")}
         for kind, buf in bufs.items():
-            self._read_h.async_pread(buf, store.paths[kind])
+            self._read_h.async_pread(buf, paths[kind])
         if not self.pipeline_read:
             self._read_h.wait()
         return bufs
 
-    def _cast_out(self, master_flat, shape):
-        """Updated master -> one compute-dtype leaf (bf16 via the native
-        round-to-nearest-even kernel; anything else via numpy astype)."""
-        import ml_dtypes
-        dt = np.dtype(self.compute_dtype) if self.compute_dtype is not None \
-            else np.dtype(ml_dtypes.bfloat16)
-        if dt == np.dtype(ml_dtypes.bfloat16):
-            return f32_to_bf16(master_flat).reshape(shape)
-        return master_flat.astype(dt).reshape(shape)
-
-    def step(self, grads, grad_coef, lr):
+    def step(self, grad_blocks, grad_coef, lr):
         self.t += 1
-        gleaves = jax.tree_util.tree_leaves(grads)
-        assert len(gleaves) == len(self._stores), "grad tree does not match optimizer state"
-        self._out = [None] * len(gleaves)
+        assert len(grad_blocks) == len(self.blocks), "grad blocks do not match optimizer state"
+        self._out = [None] * len(self.blocks)
 
         pending_write = None  # bufs kept alive until their write completes
-        nxt = self._read_leaf(self._stores[0])
-        for i, store in enumerate(self._stores):
+        nxt = self._read_block(0)
+        for i, blk in enumerate(self.blocks):
             bufs = nxt
-            self._read_h.wait()  # leaf i resident
-            if i + 1 < len(self._stores):
-                nxt = self._read_leaf(self._stores[i + 1])  # overlap next read
-            g = np.asarray(gleaves[i]).reshape(-1)
+            self._read_h.wait()  # block i resident
+            if i + 1 < len(self.blocks):
+                nxt = self._read_block(i + 1)  # overlap next read
+            g = np.asarray(grad_blocks[i]).reshape(-1)
             self.opt.step(bufs["master"], bufs["m"], bufs["v"], g, self.t,
                           lr=lr, grad_coef=grad_coef)
-            self._out[i] = self._cast_out(bufs["master"], store.shape)
+            self._out[i] = self._cast(bufs["master"], self.compute_dtype).reshape(blk.shape)
             if pending_write is not None:
                 self._write_h.wait()
+            paths = self._paths(i)
             for kind in ("master", "m", "v"):
-                self._write_h.async_pwrite(bufs[kind], store.paths[kind])
+                self._write_h.async_pwrite(bufs[kind], paths[kind])
             if not self.pipeline_write:
                 self._write_h.wait()
                 pending_write = None
@@ -140,106 +124,69 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
                 pending_write = bufs
         self._write_h.wait()
 
+    def _block_data(self, kind, i):
+        """Serial file read of one owned block (debug/full-leaf accessors;
+        must run on the caller thread — the AIO handles are not re-entrant)."""
+        blk = self.blocks[i]
+        buf = np.empty(blk.size, np.float32)
+        self._read_h.async_pread(buf, self._paths(i)[kind])
+        self._read_h.wait()
+        return buf
+
+    def _block_out(self, i, compute_dtype):
+        return self._out[i]
+
     def compute_params(self, compute_dtype, shardings):
-        """Push the compute-dtype leaves produced during step(); outside a
-        step (checkpoint restore) stream the master back from NVMe."""
         if self._out is None:
-            self._out = []
-            for store in self._stores:
-                buf = np.empty(int(np.prod(store.shape)), np.float32)
-                self._read_h.async_pread(buf, store.paths["master"])
-                self._read_h.wait()
-                self._out.append(self._cast_out(buf, store.shape))
-        s_leaves = jax.tree_util.tree_flatten(shardings)[0]
-        srcs = [b if b.dtype == np.dtype(compute_dtype) else b.astype(np.dtype(compute_dtype))
-                for b in self._out]
-        out_leaves = list(_TRANSFER_POOL.map(lambda ms: jax.device_put(ms[0], ms[1]),
-                                             zip(srcs, s_leaves)))
-        out = jax.tree_util.tree_unflatten(self._treedef, out_leaves)
-        jax.block_until_ready(out)
+            # checkpoint restore: materialize the compute blocks SERIALLY
+            # before the (thread-pooled) assembly — the AIO handles are not
+            # safe to drive from multiple _TRANSFER_POOL threads
+            self._out = [self._cast(self._block_data("master", i),
+                                    compute_dtype).reshape(blk.shape)
+                         for i, blk in enumerate(self.blocks)]
+        out = super().compute_params(compute_dtype, shardings)
         self._out = None  # free the transient window
         return out
 
-    # -- checkpoint -------------------------------------------------------
-    def save_to(self, tag_dir):
-        """Stream the swap files into the checkpoint directory (chunked file
-        copy — never materializes the full state in DRAM, preserving the
-        bounded-memory invariant; reference pipelined swapper checkpoints the
-        same way, by file)."""
-        import json
-        import shutil
-        out = os.path.join(tag_dir, "nvme_optimizer")
-        os.makedirs(out, exist_ok=True)
-        meta = {"step": int(self.t), "leaves": [list(map(int, s.shape)) for s in self._stores]}
-        with open(os.path.join(out, "meta.json"), "w") as f:
-            json.dump(meta, f)
-        self._write_h.wait()  # no in-flight writes while copying
-        for store in self._stores:
-            for kind, src in store.paths.items():
-                shutil.copyfile(src, os.path.join(out, os.path.basename(src)))
+    # -- checkpoint: stream blocks through the shared npz format ----------
+    def _iter_state_blocks(self):
+        for kind in ("master", "m", "v"):
+            for i, blk in enumerate(self.blocks):
+                buf = np.empty(blk.size, np.float32)
+                self._read_h.async_pread(buf, self._paths(i)[kind])
+                self._read_h.wait()
+                yield kind, i, buf
 
-    def load_from(self, tag_dir):
-        """Restore from ``save_to`` output, or from a host-DRAM-tier
-        ``host_optimizer.npz`` (cross-tier resume). False when neither
-        exists."""
-        import json
-        import shutil
-        nv = os.path.join(tag_dir, "nvme_optimizer")
-        if os.path.isdir(nv):
-            with open(os.path.join(nv, "meta.json")) as f:
-                meta = json.load(f)
-            shapes = [tuple(s) for s in meta["leaves"]]
-            ours = [tuple(map(int, s.shape)) for s in self._stores]
-            if shapes != ours:
-                raise ValueError(f"nvme optimizer checkpoint has {len(shapes)} leaves "
-                                 f"{shapes[:3]}... but the model expects {ours[:3]}...")
-            for store in self._stores:
-                for kind, dst in store.paths.items():
-                    shutil.copyfile(os.path.join(nv, os.path.basename(dst)), dst)
-            self.t = int(meta["step"])
-            return True
-        npz = os.path.join(tag_dir, "host_optimizer.npz")
-        if os.path.isfile(npz):
-            with np.load(npz) as arrays:
-                self.load_state_dict_arrays(arrays)
-            return True
-        return False
+    def save_to(self, tag_dir):
+        self._write_h.wait()  # no in-flight writes while reading back
+        super().save_to(tag_dir)
+
+    def _set_block(self, kind, i, data):
+        self._write_h.async_pwrite(np.ascontiguousarray(data, np.float32).reshape(-1),
+                                   self._paths(i)[kind])
+        self._write_h.wait()
 
     def reset_from_params(self, params, step):
         """Rewrite master files from (already-loaded) device params, zero
-        moments — streamed per leaf like init_from_device."""
-        self.init_from_device(params)
+        moments — streamed per block like init_from_device."""
+        import jax.numpy as jnp
+        reshard = jax.jit(lambda t: jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), t),
+                          out_shardings=jax.tree_util.tree_unflatten(self._treedef,
+                                                                     self._off_shardings))
+        from ..zero.offload import _norm_index
+        leaves = jax.tree_util.tree_leaves(reshard(params))
+        by_key = {}
+        for li, arr in enumerate(leaves):
+            for shard in arr.addressable_shards:
+                by_key.setdefault((li, _norm_index(shard.index, arr.shape)), shard.data)
+        zeros = np.zeros(max(b.size for b in self.blocks), np.float32)
+        for i, blk in enumerate(self.blocks):
+            host = np.asarray(jax.device_get(by_key[(blk.leaf, blk.index)]),
+                              np.float32).reshape(-1)
+            paths = self._paths(i)
+            self._write_h.async_pwrite(host, paths["master"])
+            self._write_h.wait()  # host buffer reused next iteration
+            for kind in ("m", "v"):
+                self._write_h.async_pwrite(zeros[:blk.size], paths[kind])
+            self._write_h.wait()
         self.t = step
-
-    def _tree_from_files(self, kind):
-        leaves = []
-        for store in self._stores:
-            buf = np.empty(int(np.prod(store.shape)), np.float32)
-            self._read_h.async_pread(buf, store.paths[kind])
-            self._read_h.wait()
-            leaves.append(buf.reshape(store.shape))
-        return jax.tree_util.tree_unflatten(self._treedef, leaves)
-
-    def state_dict_arrays(self):
-        out = {"__step__": np.asarray(self.t, np.int64)}
-        for kind, prefix in (("master", "master"), ("m", "m"), ("v", "v")):
-            tree = self._tree_from_files(kind)
-            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-            for path, leaf in flat:
-                out[prefix + "/" + jax.tree_util.keystr(path)] = leaf
-        return out
-
-    def load_state_dict_arrays(self, arrays):
-        self.t = int(arrays["__step__"])
-        # reconstruct file contents leaf-by-leaf in tree order
-        example = jax.tree_util.tree_unflatten(
-            self._treedef, [np.empty(s.shape, np.float32) for s in self._stores])
-        flat, _ = jax.tree_util.tree_flatten_with_path(example)
-        for kind in ("master", "m", "v"):
-            for (path, leaf), store in zip(flat, self._stores):
-                key = kind + "/" + jax.tree_util.keystr(path)
-                src = np.ascontiguousarray(arrays[key], np.float32)
-                if src.shape != tuple(store.shape):
-                    raise ValueError(f"offload state {key}: shape {src.shape} != {store.shape}")
-                self._write_h.async_pwrite(src, store.paths[kind])
-                self._write_h.wait()

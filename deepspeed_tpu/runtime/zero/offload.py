@@ -1,27 +1,41 @@
 """ZeRO-Offload: optimizer state in host DRAM, stepped by the native CPU
-optimizer.
+optimizer — PARTITIONED across DP ranks/hosts.
 
 TPU-native analogue of the reference's ZeRO-Offload tier (optimizer-state
-CPU offload: ``runtime/zero/stage_1_and_2.py:1031`` async CPU accumulation +
-``csrc/adam/cpu_adam.cpp``; config surface ``zero/offload_config.py:94``).
-Design translation (SURVEY §7): instead of hook-driven swap of partitioned
-torch tensors, the engine keeps only the compute-dtype (bf16) parameters and
-activations in HBM; fp32 master parameters and Adam moments live in host
-numpy buffers owned by this class. One training step is:
+CPU offload: ``runtime/zero/stage_1_and_2.py:1031`` async CPU accumulation of
+*this rank's partition* + ``csrc/adam/cpu_adam.cpp``; config surface
+``zero/offload_config.py:94``). Design translation (SURVEY §7): instead of
+hook-driven swap of partitioned torch tensors, the engine keeps only the
+compute-dtype (bf16) parameters and activations in HBM; fp32 master
+parameters and Adam moments live in host numpy buffers owned by this class.
+One training step is:
 
-  device: fwd+bwd (one pjit) -> compute-dtype grads, loss, grad-norm
-  host:   fetch grads -> fused C AdamW over (master, m, v) -> cast bf16
-  device: push updated compute params back into their sharded layout
+  device: fwd+bwd (one pjit) -> reduce-scattered compute-dtype grads, loss
+  host:   fetch THIS HOST's grad shards -> fused C AdamW over its
+          (master, m, v) shards -> cast bf16
+  device: push the shards back; XLA re-gathers to the compute layout
+
+Partitioning model: every leaf is laid out in the planner's *offload
+sharding* (scattered over the DP axes — ``ShardingPlanner.offload_spec``).
+A host owns exactly the shards its local devices hold (deduplicated when an
+axis replicates within the host, stepped redundantly when replication spans
+hosts — correct either way since Adam is elementwise). At 70B scale the
+840 GB of fp32 master+moments therefore spans the aggregate DRAM of all
+feeding hosts instead of replicating per host.
 
 HBM cost drops from 16 bytes/param (fp32 master + 2 moments + bf16 copy)
-to ~4 (bf16 params + transient grads) — how a 1.5B-param model trains on a
-single 16 GB chip (the reference's "10x bigger models" ZeRO-Offload pitch).
+to ~4 (bf16 params + transient grads); host DRAM cost is 12 bytes/param
+/ dp_world — how a 1.5B-param model trains on a single 16 GB chip and a
+70B-param model's optimizer spans a pod's hosts.
 
 The push uses ``jax.block_until_ready`` before the next in-place host step:
 ``device_put`` is asynchronous and may read the numpy buffer after return
 (same aliasing hazard as donated buffers).
 """
 
+import io
+import os
+import zipfile
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -38,13 +52,63 @@ from ...utils.logging import logger, log_dist
 _TRANSFER_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="offload-io")
 
 
-class HostOffloadOptimizer:
-    """fp32 master params + Adam moments on the host, per-leaf.
+def _slash_path(path):
+    """'/'-joined key path (same format as tensor_fragment accessors)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
 
-    Each feeding process owns the state for the parameters it pushes —
-    with a single controller that is the full model; under multi-host DP
-    each host steps the same global state redundantly (grads are already
-    reduced on-device), trading host FLOPs for zero extra communication.
+
+def _norm_index(index, shape):
+    """Normalize a Shard.index (tuple of slices) to ((start, stop), ...)."""
+    out = []
+    for s, extent in zip(index, shape):
+        out.append((int(s.start or 0), int(extent if s.stop is None else s.stop)))
+    return tuple(out)
+
+
+def _index_str(norm):
+    return ";".join(f"{a}:{b}" for a, b in norm)
+
+
+def _parse_index_str(s):
+    return tuple(tuple(map(int, part.split(":"))) for part in s.split(";"))
+
+
+def _slices(norm):
+    return tuple(slice(a, b) for a, b in norm)
+
+
+class _Block:
+    """One owned shard of one leaf: its global index + the local devices
+    holding it."""
+
+    __slots__ = ("leaf", "index", "shape", "devices")
+
+    def __init__(self, leaf, index, shape, devices):
+        self.leaf = leaf  # leaf ordinal in tree order
+        self.index = index  # normalized ((start, stop), ...) per dim
+        self.shape = shape  # block shape
+        self.devices = devices  # local devices holding this block
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape, dtype=np.int64))
+
+
+class HostOffloadOptimizer:
+    """fp32 master params + Adam moments on the host, partitioned per-block.
+
+    ``master``/``m``/``v`` are lists (aligned with ``blocks``) of flat fp32
+    numpy arrays — this host's partition of the global state.
     """
 
     def __init__(self, optimizer_config, lr_schedule_fn):
@@ -56,122 +120,302 @@ class HostOffloadOptimizer:
                                     adamw_mode=p.get("adam_w_mode", True)
                                     if (optimizer_config.type or "").lower() != "adamw" else True)
         self.lr_schedule_fn = lr_schedule_fn
-        self.master = None  # pytree of fp32 np arrays
+        self.blocks = None  # list[_Block], this host's partition
+        self.master = None  # list of flat fp32 arrays aligned with blocks
         self.m = None
         self.v = None
         self.t = 0  # 1-based inside step()
+        self._treedef = None
+        self._leaf_shapes = None  # global leaf shapes, tree order
+        self._leaf_paths = None  # keystr per leaf, tree order
+        self._off_shardings = None  # per-leaf NamedSharding (offload layout)
 
-    def init_from_device(self, params_f32):
-        """Pull fp32 master copies (parallel per-leaf fetches)."""
-        leaves, treedef = jax.tree_util.tree_flatten(params_f32)
-        fetch = lambda leaf: np.array(jax.device_get(leaf), dtype=np.float32, copy=True)
-        host = list(_TRANSFER_POOL.map(fetch, leaves))
-        self.master = jax.tree_util.tree_unflatten(treedef, host)
-        self.m = jax.tree_util.tree_map(np.zeros_like, self.master)
-        self.v = jax.tree_util.tree_map(np.zeros_like, self.master)
+    # -- partition discovery ----------------------------------------------
+    def _discover_blocks(self, leaves_off):
+        """Read this process's addressable shards of the offload-sharded
+        device arrays; one _Block per unique shard index."""
+        self.blocks = []
+        per_leaf_data = []
+        for li, arr in enumerate(leaves_off):
+            seen = {}
+            for shard in arr.addressable_shards:
+                key = _norm_index(shard.index, arr.shape)
+                if key in seen:
+                    seen[key].devices.append(shard.device)
+                else:
+                    blk = _Block(li, key, tuple(shard.data.shape), [shard.device])
+                    seen[key] = blk
+                    self.blocks.append(blk)
+                    per_leaf_data.append((blk, shard.data))
+        return per_leaf_data
+
+    def init_from_device(self, params_off):
+        """Build the host partition from offload-sharded fp32 device params
+        (parallel per-block fetches)."""
+        self._record_layout(params_off)
+        pairs = self._discover_blocks(jax.tree_util.tree_leaves(params_off))
+        fetch = lambda bd: np.array(jax.device_get(bd[1]), np.float32, copy=True).reshape(-1)
+        self.master = list(_TRANSFER_POOL.map(fetch, pairs))
+        self.m = [np.zeros_like(b) for b in self.master]
+        self.v = [np.zeros_like(b) for b in self.master]
+
+    def _record_layout(self, params_off):
+        leaves, treedef = jax.tree_util.tree_flatten(params_off)
+        flat_paths = jax.tree_util.tree_flatten_with_path(params_off)[0]
+        self._treedef = treedef
+        self._leaf_shapes = [tuple(x.shape) for x in leaves]
+        self._leaf_paths = [_slash_path(path) for path, _ in flat_paths]
+        self._off_shardings = [x.sharding for x in leaves]
+        self._reshard_cache = {}
 
     def num_params(self):
-        return sum(x.size for x in jax.tree_util.tree_leaves(self.master))
+        """Number of parameters whose optimizer state THIS host owns."""
+        return sum(b.size for b in self.blocks)
 
-    def step(self, grads, grad_coef, lr):
-        """Fused host AdamW over every leaf. ``grads``: pytree of host numpy
-        arrays (fp32 or bfloat16); ``grad_coef`` folds loss-scale unscale,
-        grad-accum averaging and clipping."""
+    # -- hot path ----------------------------------------------------------
+    def fetch_grads(self, grads_off):
+        """Offload-sharded device grads -> this host's blocks (parallel)."""
+        leaves = jax.tree_util.tree_leaves(grads_off)
+        by_key = {}
+        for li, arr in enumerate(leaves):
+            for shard in arr.addressable_shards:
+                by_key.setdefault((li, _norm_index(shard.index, arr.shape)), shard.data)
+        datas = [by_key[(b.leaf, b.index)] for b in self.blocks]
+        fetch = lambda d: np.asarray(jax.device_get(d)).reshape(-1)
+        return list(_TRANSFER_POOL.map(fetch, datas))
+
+    def step(self, grad_blocks, grad_coef, lr):
+        """Fused host AdamW over every owned block. ``grad_blocks``: flat
+        host arrays aligned with ``self.blocks``; ``grad_coef`` folds
+        loss-scale unscale, grad-accum averaging and clipping."""
         self.t += 1
-        for g, p, m, v in zip(jax.tree_util.tree_leaves(grads),
-                              jax.tree_util.tree_leaves(self.master),
-                              jax.tree_util.tree_leaves(self.m),
-                              jax.tree_util.tree_leaves(self.v)):
-            self.opt.step(p.reshape(-1), m.reshape(-1), v.reshape(-1), g.reshape(-1),
-                          self.t, lr=lr, grad_coef=grad_coef)
+        for g, p, m, v in zip(grad_blocks, self.master, self.m, self.v):
+            self.opt.step(p, m, v, g, self.t, lr=lr, grad_coef=grad_coef)
 
-    def fetch_grads(self, grads):
-        """Device grads -> host numpy, parallel per-leaf."""
-        leaves, treedef = jax.tree_util.tree_flatten(grads)
-        host = list(_TRANSFER_POOL.map(lambda a: np.asarray(jax.device_get(a)), leaves))
-        return jax.tree_util.tree_unflatten(treedef, host)
+    def _cast(self, flat, compute_dtype):
+        if np.dtype(compute_dtype) == np.dtype(jnp.bfloat16):
+            return f32_to_bf16(flat)
+        return flat.astype(np.dtype(compute_dtype))
+
+    def _block_out(self, i, compute_dtype):
+        """Updated master for block i as a compute-dtype host array."""
+        return self._cast(self.master[i], compute_dtype).reshape(self.blocks[i].shape)
 
     def compute_params(self, compute_dtype, shardings):
-        """Push the updated master as compute-dtype device arrays in their
-        sharded layout (parallel per-leaf)."""
-        cast = (lambda x: f32_to_bf16(x)) if compute_dtype == jnp.bfloat16 else \
-            (lambda x: x.astype(np.dtype(compute_dtype)))
+        """Push this host's updated shards; XLA reshards to the compute
+        layout (the stage-1/2 'allgather updated partitions' step tail,
+        reference ``stage_1_and_2.py``)."""
+        blocks_by_leaf = {}
+        for i, blk in enumerate(self.blocks):
+            blocks_by_leaf.setdefault(blk.leaf, []).append(i)
 
-        m_leaves, treedef = jax.tree_util.tree_flatten(self.master)
-        s_leaves = jax.tree_util.tree_flatten(shardings)[0]
-        out_leaves = list(_TRANSFER_POOL.map(lambda ms: jax.device_put(cast(ms[0]), ms[1]),
-                                             zip(m_leaves, s_leaves)))
-        out = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        def assemble(li):
+            arrays = []
+            for i in blocks_by_leaf[li]:
+                blk = self.blocks[i]
+                host = self._block_out(i, compute_dtype)
+                for d in blk.devices:
+                    arrays.append(jax.device_put(host, d))
+            return jax.make_array_from_single_device_arrays(
+                self._leaf_shapes[li], self._off_shardings[li], arrays)
+
+        off_leaves = list(_TRANSFER_POOL.map(assemble, range(len(self._leaf_shapes))))
+        off_tree = jax.tree_util.tree_unflatten(self._treedef, off_leaves)
+        # cache the jitted reshard per (dtype, out layout): a fresh jit wrapper
+        # each step would retrace the full param tree every train step
+        key = (np.dtype(compute_dtype).str,
+               tuple(jax.tree_util.tree_leaves(shardings)))
+        reshard = self._reshard_cache.get(key)
+        if reshard is None:
+            reshard = jax.jit(lambda t: t, donate_argnums=(0, ), out_shardings=shardings)
+            self._reshard_cache[key] = reshard
+        out = reshard(off_tree)
         # the host buffers are mutated in place next step; the async transfer
         # must have consumed them by then
         jax.block_until_ready(out)
         return out
 
-    # ---- checkpoint ------------------------------------------------------
-    def save_to(self, tag_dir):
-        """Persist master/m/v next to the device checkpoint."""
-        import os
-        np.savez(os.path.join(tag_dir, "host_optimizer.npz"), **self.state_dict_arrays())
+    # -- checkpoint ---------------------------------------------------------
+    # Every process writes its partition to host_optimizer.rank{r}.npz; the
+    # loader reassembles full leaves from all rank files and re-slices into
+    # the current partition, so resume works across process/mesh layouts
+    # (the universal-checkpoint property, reference checkpoint/ reshape).
+    def _iter_state_blocks(self):
+        """Yield (kind, block_ordinal, flat fp32 array) for this partition."""
+        for kind, store in (("master", self.master), ("m", self.m), ("v", self.v)):
+            for i, flat in enumerate(store):
+                yield kind, i, flat
 
-    def load_from(self, tag_dir):
-        """Restore from ``save_to`` output — this tier's npz, or an NVMe-tier
-        ``nvme_optimizer/`` directory (cross-tier resume works both ways);
-        False when the checkpoint carries no offloaded optimizer state."""
+    def _block_key(self, kind, i):
+        blk = self.blocks[i]
+        return f"{kind}/{self._leaf_paths[blk.leaf]}|{_index_str(blk.index)}"
+
+    def save_to(self, tag_dir):
+        """Persist this host's partition next to the device checkpoint
+        (streamed into the npz one block at a time — bounded DRAM)."""
+        path = os.path.join(tag_dir, f"host_optimizer.rank{jax.process_index():05d}.npz")
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+            buf = io.BytesIO()
+            np.lib.format.write_array(buf, np.asarray(self.t, np.int64))
+            zf.writestr("__step__.npy", buf.getvalue())
+            for kind, i, flat in self._iter_state_blocks():
+                buf = io.BytesIO()
+                np.lib.format.write_array(buf, flat)
+                zf.writestr(self._block_key(kind, i) + ".npy", buf.getvalue())
+
+    def _saved_piece_index(self, tag_dir):
+        """Scan rank files (+ legacy formats) -> {(kind, leaf_path):
+        [(norm_index or None, load_fn), ...]}, plus the saved step."""
+        import glob
         import json
-        import os
-        p = os.path.join(tag_dir, "host_optimizer.npz")
-        if os.path.isfile(p):
-            with np.load(p) as arrays:
-                self.load_state_dict_arrays(arrays)
-            return True
+        files = sorted(glob.glob(os.path.join(tag_dir, "host_optimizer.rank*.npz")))
+        legacy = os.path.join(tag_dir, "host_optimizer.npz")
+        if os.path.isfile(legacy):
+            files.append(legacy)
+        index, step = {}, 0
+        self._open_npzs = [np.load(f) for f in files]
+        for nz in self._open_npzs:
+            for key in nz.files:
+                if key == "__step__":
+                    step = int(nz[key])
+                    continue
+                kind, rest = key.split("/", 1)
+                if "|" in rest:
+                    leaf_path, idxstr = rest.rsplit("|", 1)
+                    norm = _parse_index_str(idxstr)
+                else:
+                    leaf_path, norm = rest, None  # legacy full-leaf entry
+                index.setdefault((kind, leaf_path), []).append(
+                    (norm, lambda nz=nz, key=key: np.asarray(nz[key], np.float32)))
+        # legacy NVMe-tier dir: per-leaf flat files in tree order
         nv = os.path.join(tag_dir, "nvme_optimizer")
         if os.path.isdir(nv):
             with open(os.path.join(nv, "meta.json")) as f:
                 meta = json.load(f)
-            trees = {"master": self.master, "m": self.m, "v": self.v}
-            for kind, tree in trees.items():
-                leaves = jax.tree_util.tree_leaves(tree)
-                if len(leaves) != len(meta["leaves"]):
-                    raise ValueError(f"nvme optimizer checkpoint has {len(meta['leaves'])} "
-                                     f"leaves; the model expects {len(leaves)}")
-                for i, leaf in enumerate(leaves):
-                    path = os.path.join(nv, f"leaf{i:05d}.{kind}")
-                    data = np.fromfile(path, dtype=np.float32)
-                    if data.size != leaf.size:
-                        raise ValueError(f"{path}: {data.size} values != leaf size {leaf.size}")
-                    leaf[...] = data.reshape(leaf.shape)
-            self.t = int(meta["step"])
+            step = step or int(meta.get("step", 0))
+            for li, shape in enumerate(meta.get("leaves", [])):
+                if li >= len(self._leaf_paths):
+                    break
+                for kind in ("master", "m", "v"):
+                    path = os.path.join(nv, f"leaf{li:05d}.{kind}")
+                    if os.path.isfile(path):
+                        index.setdefault((kind, self._leaf_paths[li]), []).append(
+                            (None, lambda path=path: np.fromfile(path, np.float32)))
+        if not index:
+            return None, 0
+        return index, step
+
+    def _set_block(self, kind, i, data):
+        {"master": self.master, "m": self.m, "v": self.v}[kind][i][...] = data.reshape(-1)
+
+    def load_from(self, tag_dir):
+        """Restore this partition from ``save_to`` output (any rank/mesh
+        layout whose pieces cover our blocks); False when the checkpoint
+        carries no offloaded optimizer state."""
+        index, step = self._saved_piece_index(tag_dir)
+        if index is None:
+            return False
+        try:
+            blocks_by_leaf = {}
+            for i, blk in enumerate(self.blocks):
+                blocks_by_leaf.setdefault(blk.leaf, []).append(i)
+            for li, block_ids in blocks_by_leaf.items():
+                shape = self._leaf_shapes[li]
+                leaf_path = self._leaf_paths[li]
+                for kind in ("master", "m", "v"):
+                    pieces = index.get((kind, leaf_path))
+                    if not pieces:
+                        raise ValueError(f"offload checkpoint misses {kind} for {leaf_path}")
+                    full = np.empty(shape, np.float32)
+                    covered = np.zeros(shape, bool)
+                    for norm, load in pieces:
+                        data = load()
+                        if norm is None:
+                            if data.size != int(np.prod(shape, dtype=np.int64)):
+                                raise ValueError(f"{kind}/{leaf_path}: full-leaf entry size "
+                                                 f"{data.size} != leaf {shape}")
+                            full[...] = data.reshape(shape)
+                            covered[...] = True
+                        else:
+                            sl = _slices(norm)
+                            full[sl] = data.reshape(full[sl].shape)
+                            covered[sl] = True
+                    if not covered.all():
+                        raise ValueError(f"offload checkpoint pieces do not cover "
+                                         f"{kind}/{leaf_path} (partial copy, or mesh-resize "
+                                         f"with mismatched partition boundaries?)")
+                    for i in block_ids:
+                        self._set_block(kind, i, full[_slices(self.blocks[i].index)])
+            self.t = step
             return True
-        return False
+        finally:
+            for nz in getattr(self, "_open_npzs", []):
+                nz.close()
+            self._open_npzs = []
 
     def reset_from_params(self, params, step):
         """Rebuild fp32 master from (already-loaded) device params with
         fresh moments — the fallback when a checkpoint was saved without
-        offload."""
-        for dst, src in zip(jax.tree_util.tree_leaves(self.master),
-                            jax.tree_util.tree_leaves(params)):
-            dst[...] = np.asarray(jax.device_get(src), dtype=np.float32)
-        for t in (self.m, self.v):
-            for leaf in jax.tree_util.tree_leaves(t):
-                leaf[...] = 0
+        offload. ``params`` may be in any sharding; resharded on device."""
+        reshard = jax.jit(lambda t: jax.tree_util.tree_map(lambda x: x.astype(jnp.float32), t),
+                          out_shardings=jax.tree_util.tree_unflatten(self._treedef,
+                                                                     self._off_shardings))
+        leaves = jax.tree_util.tree_leaves(reshard(params))
+        by_key = {}
+        for li, arr in enumerate(leaves):
+            for shard in arr.addressable_shards:
+                by_key.setdefault((li, _norm_index(shard.index, arr.shape)), shard.data)
+        for i, blk in enumerate(self.blocks):
+            self._set_block("master", i, np.asarray(jax.device_get(by_key[(blk.leaf, blk.index)]),
+                                                    np.float32))
+            self.m[i][...] = 0
+            self.v[i][...] = 0
         self.t = step
 
     def state_dict_arrays(self):
-        """Flat {path: np.ndarray} for np.savez (checkpoint sidecar)."""
+        """Flat {key: np.ndarray} of this partition (tests/debug aid)."""
         out = {"__step__": np.asarray(self.t, np.int64)}
-        for prefix, tree in (("master", self.master), ("m", self.m), ("v", self.v)):
-            flat = jax.tree_util.tree_flatten_with_path(tree)[0]
-            for path, leaf in flat:
-                out[prefix + "/" + jax.tree_util.keystr(path)] = leaf
+        for kind, i, flat in self._iter_state_blocks():
+            out[self._block_key(kind, i)] = flat
         return out
 
-    def load_state_dict_arrays(self, arrays):
-        self.t = int(arrays["__step__"])
-        for prefix, tree in (("master", self.master), ("m", self.m), ("v", self.v)):
-            flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-            for path, leaf in flat:
-                key = prefix + "/" + jax.tree_util.keystr(path)
-                src = arrays[key]
-                if src.shape != leaf.shape:
-                    raise ValueError(f"offload state {key}: shape {src.shape} != {leaf.shape}")
-                leaf[...] = src
+    # -- full-leaf accessors (tensor_fragment debug API) --------------------
+    def _leaf_index(self, path):
+        try:
+            return self._leaf_paths.index(path)
+        except ValueError:
+            raise KeyError(f"path {path!r}: no such parameter; known leaves include "
+                           f"{self._leaf_paths[:5]}...") from None
+
+    def _block_data(self, kind, i):
+        """Flat fp32 data of owned block i (host tier: in-memory)."""
+        return {"master": self.master, "m": self.m, "v": self.v}[kind][i]
+
+    def get_full(self, kind, path):
+        """Assemble the full leaf at ``path`` from this host's blocks.
+        Raises if this host owns only part of it (multi-host partition)."""
+        li = self._leaf_index(path)
+        shape = self._leaf_shapes[li]
+        full = np.empty(shape, np.float32)
+        covered = np.zeros(shape, bool)
+        for i, blk in enumerate(self.blocks):
+            if blk.leaf != li:
+                continue
+            sl = _slices(blk.index)
+            full[sl] = self._block_data(kind, i).reshape(blk.shape)
+            covered[sl] = True
+        if not covered.all():
+            raise ValueError(f"{path}: this host owns only part of the leaf (offload state "
+                             f"is partitioned across hosts); gather via checkpoint instead")
+        return full
+
+    def set_full(self, kind, path, value):
+        """Write this host's blocks of the full leaf value at ``path``."""
+        li = self._leaf_index(path)
+        shape = self._leaf_shapes[li]
+        src = np.asarray(value, np.float32)
+        if src.shape != shape:
+            raise ValueError(f"value shape {src.shape} != param shape {shape}")
+        for i, blk in enumerate(self.blocks):
+            if blk.leaf == li:
+                self._set_block(kind, i, src[_slices(blk.index)])

@@ -211,6 +211,18 @@ class ShardingPlanner:
             spec = self._apply_dp(spec, shape, path_str)
         return spec
 
+    def offload_spec(self, path_str, shape):
+        """PartitionSpec for *offloaded* optimizer state and the gradients
+        feeding it: always scattered over the ZeRO dp axes regardless of
+        stage. ZeRO-Offload partitions optimizer state per DP rank so each
+        host steps only its shard (reference ``stage_1_and_2.py:1031`` CPU
+        accumulation of this rank's partition; ``stage3.py:463``)."""
+        ndim = len(shape)
+        spec = self.tp_rules.match(path_str, ndim) or P(*([None] * ndim))
+        spec = self._validate(spec, shape, path_str)
+        spec = self._apply_pipe(spec, shape, path_str)
+        return self._apply_dp(spec, shape, path_str)
+
     # -- pytree planning -----------------------------------------------------
     def _tree_specs(self, params, leaf_fn):
         def plan(path, leaf):
@@ -227,6 +239,9 @@ class ShardingPlanner:
 
     def grad_specs(self, params):
         return self._tree_specs(params, self.grad_spec)
+
+    def offload_specs(self, params):
+        return self._tree_specs(params, self.offload_spec)
 
     def shardings(self, specs):
         return jax.tree_util.tree_map(lambda s: NamedSharding(self.mesh, s),

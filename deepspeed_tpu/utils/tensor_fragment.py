@@ -38,26 +38,18 @@ def _set(tree, path, value):
 
 
 def safe_get_full_fp32_param(engine, path):
-    """Full fp32 master parameter at ``path`` as host numpy."""
+    """Full fp32 master parameter at ``path`` as host numpy (both offload
+    tiers serve it from their partition's blocks; raises if this host owns
+    only part of the leaf under multi-host partitioned offload)."""
     if getattr(engine, "offload_optimizer", False):
-        host = engine.host_opt
-        if host.master is None:  # NVMe tier keeps no DRAM tree
-            raise NotImplementedError("NVMe offload: use engine.host_opt.state_dict_arrays()")
-        return np.asarray(_lookup(host.master, path))
+        return engine.host_opt.get_full("master", path)
     return np.asarray(jax.device_get(_lookup(engine.state.params, path)), np.float32)
 
 
 def safe_set_full_fp32_param(engine, path, value):
     """Write a full fp32 master parameter (and refresh the device copy)."""
     if getattr(engine, "offload_optimizer", False):
-        host = engine.host_opt
-        if host.master is None:
-            raise NotImplementedError("NVMe offload: load/modify/store via state_dict_arrays()")
-        dst = _lookup(host.master, path)
-        src = np.asarray(value, np.float32)
-        if src.shape != dst.shape:
-            raise ValueError(f"value shape {src.shape} != param shape {dst.shape}")
-        dst[...] = src
+        engine.host_opt.set_full("master", path, value)
         return
     leaf = _lookup(engine.state.params, path)
     new = jnp.asarray(value, leaf.dtype)
@@ -95,13 +87,9 @@ def _find_adam_state(opt_state):
 def safe_get_full_optimizer_state(engine, path, state_key):
     """Optimizer moment (``exp_avg``/``exp_avg_sq``) at ``path``."""
     if getattr(engine, "offload_optimizer", False):
-        host = engine.host_opt
         if state_key not in ("exp_avg", "exp_avg_sq"):
             raise KeyError(f"unknown optimizer state key {state_key!r}")
-        if host.m is None:  # NVMe tier keeps no DRAM tree
-            raise NotImplementedError("NVMe offload: use engine.host_opt.state_dict_arrays()")
-        tree = host.m if state_key == "exp_avg" else host.v
-        return np.asarray(_lookup(tree, path))
+        return engine.host_opt.get_full("m" if state_key == "exp_avg" else "v", path)
     attr = _STATE_KEYS.get(state_key)
     if attr is None:
         raise KeyError(f"unknown optimizer state key {state_key!r}; valid: {sorted(_STATE_KEYS)}")

@@ -115,3 +115,140 @@ def test_two_process_training_matches_single(tmp_path):
     })
     ref = [float(engine.train_batch(batch=random_batch(8, 32, seed=100 + i))) for i in range(3)]
     np.testing.assert_allclose(per_rank[0], ref, rtol=1e-5)
+
+
+_OFFLOAD_WORKER = r"""
+import os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+
+proc_id = int(sys.argv[1])
+ckpt_dir = sys.argv[2]
+
+sys.path.insert(0, os.getcwd())
+from unit.simple_model import SimpleModel, random_batch
+
+deepspeed_tpu.init_distributed()
+assert jax.process_count() == 2, jax.process_count()
+
+HIDDEN = 32
+engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=HIDDEN), config={
+    "train_batch_size": 8,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+    "zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}},
+    "steps_per_print": 1000,
+})
+total = sum(int(np.prod(s)) for s in engine.host_opt._leaf_shapes)
+print("OWN", proc_id, engine.host_opt.num_params(), total)
+losses = []
+for i in range(3):
+    full = random_batch(8, HIDDEN, seed=100 + i)  # same global batch everywhere
+    share = jax.tree_util.tree_map(lambda x: x[proc_id * 4:(proc_id + 1) * 4], full)
+    losses.append(float(engine.train_batch(batch=share)))
+print("LOSSES", proc_id, ",".join(f"{l:.8f}" for l in losses))
+engine.host_opt.save_to(ckpt_dir)  # each rank writes its partition
+"""
+
+
+@pytest.mark.slow
+def test_two_process_partitioned_offload(tmp_path):
+    """ZeRO-Offload partitioning (VERDICT r2 item 1): each host holds ~1/N of
+    the fp32 master+moments, numerics match the single-process path, and the
+    per-rank partition files reassemble onto a different (8-device) layout."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_OFFLOAD_WORKER)
+    ckpt = tmp_path / "hostopt"
+    ckpt.mkdir()
+    port = _free_port()
+    test_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_root = os.path.dirname(test_dir)
+
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update({
+            "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+            "MASTER_ADDR": "127.0.0.1",
+            "MASTER_PORT": str(port),
+            "WORLD_SIZE": "2",
+            "RANK": str(rank),
+        })
+        procs.append(subprocess.Popen([sys.executable, str(worker), str(rank), str(ckpt)],
+                                      env=env, cwd=test_dir, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                out, _ = p.communicate()
+                outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-3000:]}"
+
+    per_rank_losses, per_rank_own = {}, {}
+    total = None
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("LOSSES"):
+                _, rank, vals = line.split(" ", 2)
+                per_rank_losses[int(rank)] = [float(v) for v in vals.split(",")]
+            elif line.startswith("OWN"):
+                _, rank, own, tot = line.split()
+                per_rank_own[int(rank)] = int(own)
+                total = int(tot)
+    assert set(per_rank_losses) == {0, 1}
+    np.testing.assert_allclose(per_rank_losses[0], per_rank_losses[1], rtol=1e-7)
+
+    # each host provably holds ~1/2 of the state (the (1,) head bias stays
+    # replicated; everything else splits)
+    for rank in (0, 1):
+        assert per_rank_own[rank] < 0.55 * total, \
+            f"rank {rank} owns {per_rank_own[rank]}/{total} — state not partitioned"
+    assert per_rank_own[0] + per_rank_own[1] >= total  # full coverage
+
+    # both rank partition files exist
+    files = sorted(os.listdir(ckpt))
+    assert files == ["host_optimizer.rank00000.npz", "host_optimizer.rank00001.npz"], files
+
+    # single-process reference (8-device mesh) on the same global batches:
+    # partitioned numerics == replicated-path numerics
+    from deepspeed_tpu.comm import comm
+    from .simple_model import SimpleModel, random_batch
+    import deepspeed_tpu
+
+    def one_proc_engine():
+        comm._state["mesh"] = None
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=SimpleModel(hidden_dim=32), config={
+                "train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+                "zero_optimization": {"stage": 2, "offload_optimizer": {"device": "cpu"}},
+                "steps_per_print": 1000,
+            })
+        return engine
+
+    ref = one_proc_engine()
+    ref_losses = [float(ref.train_batch(batch=random_batch(8, 32, seed=100 + i)))
+                  for i in range(3)]
+    np.testing.assert_allclose(per_rank_losses[0], ref_losses, rtol=1e-5)
+
+    # the 2-rank partition reassembles onto the 8-device single-process
+    # layout (mesh-resize resume across host counts)
+    fresh = one_proc_engine()
+    assert fresh.host_opt.load_from(str(ckpt))
+    assert fresh.host_opt.t == ref.host_opt.t == 3
+    # dp=2 vs dp=8 gradient summation order costs a few ulp per step
+    for got, want in zip(fresh.host_opt.master, ref.host_opt.master):
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    for got, want in zip(fresh.host_opt.m, ref.host_opt.m):
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-7)

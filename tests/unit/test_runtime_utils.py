@@ -74,9 +74,14 @@ def test_see_memory_usage_runs(caplog):
     see_memory_usage("unit-test checkpoint", force=True)  # must not raise
 
 
-def engine_for_fragment_tests(offload=False):
+def engine_for_fragment_tests(offload=False, tmp_path=None):
     comm._state["mesh"] = None
-    zero = {"stage": 2, "offload_optimizer": {"device": "cpu"}} if offload else {"stage": 1}
+    if offload == "nvme":
+        zero = {"stage": 2, "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path)}}
+    elif offload:
+        zero = {"stage": 2, "offload_optimizer": {"device": "cpu"}}
+    else:
+        zero = {"stage": 1}
     model = SimpleModel(hidden_dim=HIDDEN)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
         "train_batch_size": 16,
@@ -89,9 +94,10 @@ def engine_for_fragment_tests(offload=False):
     return engine
 
 
-@pytest.mark.parametrize("offload", [False, True], ids=["device", "cpu-offload"])
-def test_tensor_fragment_accessors(offload):
-    engine = engine_for_fragment_tests(offload)
+@pytest.mark.parametrize("offload", [False, True, "nvme"],
+                         ids=["device", "cpu-offload", "nvme-offload"])
+def test_tensor_fragment_accessors(offload, tmp_path):
+    engine = engine_for_fragment_tests(offload, tmp_path)
     path = "linear_0/kernel"
     p = safe_get_full_fp32_param(engine, path)
     assert p.shape == (HIDDEN, HIDDEN) and p.dtype == np.float32

@@ -101,8 +101,8 @@ def test_nvme_offload_matches_cpu_offload(tmp_path):
         zero_optimization={"stage": 2, "offload_optimizer": {"device": "cpu"}})))
     nvme = train_losses(make_engine(nvme_config(tmp_path)))
     np.testing.assert_allclose(cpu, nvme, rtol=1e-6)  # same C AdamW, same math
-    # state actually lives under nvme_path
-    swap = os.path.join(str(tmp_path), "zero_stage_opt_swap")
+    # state actually lives under nvme_path (rank-scoped swap dir)
+    swap = os.path.join(str(tmp_path), "zero_stage_opt_swap_rank00000")
     files = os.listdir(swap)
     assert any(f.endswith(".master") for f in files)
     assert any(f.endswith(".m") for f in files) and any(f.endswith(".v") for f in files)
