@@ -71,21 +71,38 @@ def _run(model_name, micro_bs, steps, seq=1024):
     return cfg, tokens / dt, dt / steps, final_loss, global_bs
 
 
-def _decode_bench(model_name="gpt2-large", bs=8, prompt=32, new=64):
-    """Inference decode throughput (tokens/s) — the serving half of the
-    tracked configs (reference kernel-injected inference; kernel injection =
-    the Pallas decode-attention path)."""
+def _decode_bench(model_name="gpt2-large", bs=8, prompt=32):
+    """Inference decode: steady-state ms/token-step + HBM utilization — the
+    serving half of the tracked configs (reference kernel-injected inference:
+    ``pt_binding.cpp:1745`` softmax_context decode; here the Pallas decode
+    kernel + per-layer in-place KV cache). Two run lengths split the fixed
+    cost (prefill + dispatch + fetch RPC) from the marginal decode step; the
+    marginal step is the number that matters at serving lengths."""
     import deepspeed_tpu
     engine = deepspeed_tpu.init_inference(model_name, config={"dtype": "bf16",
                                                               "max_out_tokens": 512,
-                                                              "replace_with_kernel_inject": True})
+                                                              "kernel_inject": True})
     rng = np.random.default_rng(0)
     prompts = rng.integers(0, 50257, (bs, prompt)).astype(np.int32)
-    engine.generate(prompts, max_new_tokens=new)  # compile + warm
-    t0 = time.perf_counter()
-    out = engine.generate(prompts, max_new_tokens=new)
-    dt = time.perf_counter() - t0
-    return sum(len(r) for r in out) / dt
+    times = {}
+    for new in (16, 144):
+        engine.generate(prompts, max_new_tokens=new)  # compile + warm
+        trials = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = engine.generate(prompts, max_new_tokens=new)
+            trials.append(time.perf_counter() - t0)
+        times[new] = min(trials)
+    step = (times[144] - times[16]) / 128
+    # decode is weight-read bound: bf16 params per step vs nominal HBM BW
+    weight_bytes = 2 * engine.model_config.num_params()
+    hbm_bw = 819e9  # v5e nominal
+    return {
+        "decode_ms_per_token_step": step * 1e3,
+        "decode_tokens_per_sec_steady": bs / step,
+        "decode_tokens_per_sec_e2e": sum(len(r) for r in out) / times[144],
+        "decode_hbm_utilization": weight_bytes / step / hbm_bw,
+    }
 
 
 def main():
@@ -101,7 +118,7 @@ def main():
 
     cfg_s, tok_s, step_s, loss_s, bs_s = _run("gpt2-125m", micro_bs=16, steps=60, seq=seq)
     mfu_s = _mfu(cfg_s, tok_s / n_chips, seq, peak)
-    decode_tps = _decode_bench()
+    decode = _decode_bench()
 
     print(json.dumps({
         "metric": f"gpt2-large(774M) train MFU (bf16, seq{seq}, bs{bs_l}, fp32 Adam on-chip)",
@@ -115,7 +132,10 @@ def main():
             "gpt2_125m_tokens_per_sec_chip": round(tok_s / n_chips, 1),
             "gpt2_125m_mfu": round(mfu_s, 4),
             "gpt2_125m_ms_per_step": round(step_s * 1000, 1),
-            "gpt2_large_decode_tokens_per_sec": round(decode_tps, 1),
+            "gpt2_large_decode_tokens_per_sec": round(decode["decode_tokens_per_sec_steady"], 1),
+            "gpt2_large_decode_tokens_per_sec_e2e": round(decode["decode_tokens_per_sec_e2e"], 1),
+            "gpt2_large_ms_per_decode_step": round(decode["decode_ms_per_token_step"], 2),
+            "gpt2_large_decode_hbm_utilization": round(decode["decode_hbm_utilization"], 3),
             "nominal_peak_tflops": round(peak / 1e12, 1),
             "n_chips": n_chips,
             # ZeRO-Offload capacity (measured offline, not re-run here: the

@@ -88,6 +88,34 @@ def get_manual_axes():
     return _state["manual_axes"]
 
 
+def constrain(x, spec):
+    """``with_sharding_constraint`` that also works inside a PARTIAL-manual
+    shard_map region (e.g. the pipeline, manual over ``pipe`` only): entries
+    naming manually-partitioned axes are dropped and the constraint resolves
+    against the abstract mesh, whose axis types mark the manual split. A
+    spec left with no axes after dropping is a no-op."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    if not has_mesh():
+        return x
+    manual = _state["manual_axes"]
+    if manual:
+        entries = []
+        for e in spec:
+            if e is None:
+                entries.append(None)
+            elif isinstance(e, (tuple, list)):
+                kept = tuple(a for a in e if a not in manual)
+                entries.append(kept if kept else None)
+            else:
+                entries.append(None if e in manual else e)
+        if all(e is None for e in entries):
+            return x
+        am = jax.sharding.get_abstract_mesh()
+        return jax.lax.with_sharding_constraint(x, NamedSharding(am, PartitionSpec(*entries)))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(get_mesh(), spec))
+
+
 def attention_partition_axes(batch_size, num_heads):
     """Mesh placement for an attention computation on (B, T, H, D) tensors:
     batch over the data axes, heads over (seq, tensor) — the Ulysses-style
@@ -98,7 +126,11 @@ def attention_partition_axes(batch_size, num_heads):
     dp = tuple(a for a in (EXPERT_AXIS, DATA_AXIS) if mesh.shape[a] > 1)
     if dp and batch_size % int(np.prod([mesh.shape[a] for a in dp])) != 0:
         dp = ()
-    head = tuple(a for a in (SEQ_AXIS, TENSOR_AXIS) if mesh.shape[a] > 1)
+    # tensor-major head tiling: the projection side keeps heads sharded by
+    # tensor (Megatron-TP layout) and T by seq; the Ulysses all-to-all over
+    # seq then appends seq as the MINOR tiling on heads — (tensor, seq) is
+    # the only order the partitioner can reach in one collective
+    head = tuple(a for a in (TENSOR_AXIS, SEQ_AXIS) if mesh.shape[a] > 1)
     if head and num_heads % int(np.prod([mesh.shape[a] for a in head])) != 0:
         head = ()
     return dp, head

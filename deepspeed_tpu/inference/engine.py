@@ -75,8 +75,16 @@ class InferenceEngine:
 
         # dtype + kernel selection are model-config switches
         overrides = {"dtype": cfg.dtype, "decode_block_kv": cfg.decode_block_kv}
-        if cfg.kernel_inject:
+        if cfg.kernel_inject and hasattr(model.cfg, "scan_layers"):
             overrides["attention_impl"] = "flash"
+            # unrolled layers: the KV cache becomes per-layer tensors that
+            # alias in-place through the decode while-loop carry — a scanned
+            # model's stacked cache is rebuilt (full copy, ~2x cache bytes of
+            # HBM traffic) every token
+            overrides["scan_layers"] = False
+        # config families differ (e.g. BertConfig has no decode_block_kv)
+        known = {f.name for f in dataclasses.fields(model.cfg)}
+        overrides = {k: v for k, v in overrides.items() if k in known}
         self.module = type(model)(dataclasses.replace(model.cfg, **overrides))
         self.model_config = self.module.cfg
 
@@ -93,15 +101,37 @@ class InferenceEngine:
                                        expert_pattern=self.module.expert_pattern())
         self.params = self._materialize_params(params)
         self._compiled = {}
+        self._cache_pool = {}  # (B, S) -> reusable KV cache buffers
         log_dist(
             f"InferenceEngine ready: model dtype={jnp.dtype(self.model_config.dtype).name} "
             f"tp={self.mesh.shape[dist.TENSOR_AXIS]} kernel_inject={cfg.kernel_inject} "
             f"max_out_tokens={cfg.max_out_tokens}", [0])
 
     # ------------------------------------------------------------------ params
+    def _adapt_layout(self, params):
+        """Convert between stacked ('layers', scan form) and per-layer
+        ('layer_i', unrolled form) parameter trees so checkpoints/params from
+        either model layout serve under the other (kernel_inject runs
+        unrolled; training models usually scan)."""
+        scan = getattr(self.model_config, "scan_layers", None)
+        if params is None or scan is None or not isinstance(params, dict):
+            return params
+        L = self.model_config.num_layers
+        if not scan and "layers" in params:
+            params = dict(params)
+            stacked = params.pop("layers")
+            for i in range(L):
+                params[f"layer_{i}"] = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+        elif scan and "layer_0" in params:
+            params = dict(params)
+            layers = [params.pop(f"layer_{i}") for i in range(L)]
+            params["layers"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+        return params
+
     def _materialize_params(self, params):
         if params is None and self._config.checkpoint:
             params = self._load_checkpoint_host(self._config.checkpoint)
+        params = self._adapt_layout(params)
         shardings = self.planner.shardings(self.planner.master_specs(
             params if params is not None else jax.eval_shape(self.module.init_params, jax.random.key(0))))
         dtype = self.model_config.dtype
@@ -156,14 +186,34 @@ class InferenceEngine:
             params = MegatronPolicy().convert(sd.__getitem__, self.model_config)
             _check_tree(self.module, params)
             return params
+        def module_variants():
+            yield self.module
+            scan = getattr(self.model_config, "scan_layers", None)
+            if scan is not None:  # the file may carry the other layer layout
+                yield type(self.module)(dataclasses.replace(self.model_config,
+                                                            scan_layers=not scan))
+
         if os.path.isfile(path):
-            template = jax.eval_shape(self.module.init_params, jax.random.key(0))
-            template = jax.tree_util.tree_map(lambda s: np.zeros(s.shape, s.dtype), template)
             with open(path, "rb") as f:
-                return flax.serialization.from_bytes(template, f.read())
+                blob = f.read()
+            err = None
+            for mod in module_variants():
+                template = jax.eval_shape(mod.init_params, jax.random.key(0))
+                template = jax.tree_util.tree_map(lambda s: np.zeros(s.shape, s.dtype), template)
+                try:
+                    return flax.serialization.from_bytes(template, blob)
+                except Exception as e:
+                    err = e
+            raise ValueError(f"checkpoint {path} matches neither layer layout: {err}")
         from ..runtime.checkpoint_engine.engine import load_params_only
-        abstract = jax.eval_shape(self.module.init_params, jax.random.key(0))
-        return load_params_only(path, abstract_params=abstract)
+        err = None
+        for mod in module_variants():
+            abstract = jax.eval_shape(mod.init_params, jax.random.key(0))
+            try:
+                return load_params_only(path, abstract_params=abstract)
+            except Exception as e:
+                err = e
+        raise ValueError(f"checkpoint {path} matches neither layer layout: {err}")
 
     # ------------------------------------------------------------------ forward
     def forward(self, input_ids, attention_mask=None):
@@ -221,7 +271,10 @@ class InferenceEngine:
             cache, buf, done, t, rng, tok = jax.lax.while_loop(
                 cond, body, (cache, buf, done, jnp.zeros((), jnp.int32), rng, tok))
             n_tokens = jnp.minimum(max_new, max_gen)
-            return buf, n_tokens
+            # return the cache: the donated input then aliases an output
+            # (true in-place buffers) and the caller pools it for the next
+            # generate() call — no per-call allocation or init
+            return buf, n_tokens, cache
 
         return jax.jit(generate, donate_argnums=(1, ))
 
@@ -268,11 +321,19 @@ class InferenceEngine:
             self._compiled[key] = self._build_generate(B, P, S, W, max_gen, do_sample, temperature,
                                                        top_k, top_p, eos_token_id, pad_token_id,
                                                        padded)
-        cache = self._init_cache(B, S)
+        # reuse pooled cache buffers: stale contents are never attended (the
+        # causal position bias and per-row cache_mask gate every slot)
+        cache = self._cache_pool.pop((B, S), None)
+        if cache is None:
+            cache = self._init_cache(B, S)
         with self.mesh:
-            buf, _ = self._compiled[key](self.params, cache, jnp.asarray(ids), jnp.asarray(pads),
-                                         jnp.asarray(max_new_tokens, jnp.int32),
-                                         jax.random.key(seed))
+            buf, _, cache = self._compiled[key](self.params, cache, jnp.asarray(ids),
+                                                jnp.asarray(pads),
+                                                jnp.asarray(max_new_tokens, jnp.int32),
+                                                jax.random.key(seed))
+        self._cache_pool[(B, S)] = cache
+        while len(self._cache_pool) > 2:  # bound HBM held by idle cache buckets
+            self._cache_pool.pop(next(iter(self._cache_pool)))
         buf = np.asarray(jax.device_get(buf))[:, :max_new_tokens]
         out = []
         for i in range(B):
@@ -285,16 +346,27 @@ class InferenceEngine:
         return out
 
     def _init_cache(self, B, S):
-        nkv = self.model_config.kv_heads
-        spec_axes = [None, None, None, None, None]
-        if nkv % self.mesh.shape[dist.TENSOR_AXIS] == 0:
-            spec_axes[2] = dist.TENSOR_AXIS
-        from jax.sharding import NamedSharding, PartitionSpec as P_
-        sharding = NamedSharding(self.mesh, P_(*spec_axes))
-        init = jax.jit(lambda: self.module.init_cache(B, S),
-                       out_shardings=(sharding, sharding))
+        key = ("init_cache", B, S)
+        if key not in self._compiled:
+            from jax.sharding import NamedSharding, PartitionSpec as P_
+            nkv = self.model_config.kv_heads
+            shard_kv = nkv % self.mesh.shape[dist.TENSOR_AXIS] == 0
+
+            def spec_for(leaf):
+                # stacked (L, B, kv, S, hd) or per-layer (B, kv, S, hd)
+                axes = [None] * leaf.ndim
+                if shard_kv:
+                    axes[leaf.ndim - 3] = dist.TENSOR_AXIS
+                return NamedSharding(self.mesh, P_(*axes))
+
+            abstract = jax.eval_shape(lambda: self.module.init_cache(B, S))
+            shardings = jax.tree_util.tree_map(spec_for, abstract)
+            # cached: a fresh jit wrapper per call would retrace (+~0.7 s)
+            # on EVERY generate
+            self._compiled[key] = jax.jit(lambda: self.module.init_cache(B, S),
+                                          out_shardings=shardings)
         with self.mesh:
-            return init()
+            return self._compiled[key]()
 
     # ------------------------------------------------------------------ misc parity
     @property

@@ -1,0 +1,130 @@
+"""Bidirectional (BERT-family) encoder.
+
+Counterpart of the reference's BERT serving surface (``module_inject/
+containers/{bert,distil_bert}.py`` + the fused ``BertTransformerLayer``
+training kernels, ``csrc/transformer/ds_transformer_cuda.cpp``): a post-norm
+encoder whose forward matches HF ``BertModel`` exactly, so BERT/DistilBERT
+checkpoints convert through ``init_inference`` like the decoder families.
+
+TPU-first: same bhtd head-major projections as the causal zoo (the matmul
+output layout IS the attention layout), fp32 softmax/LayerNorm accumulation,
+pure-XLA attention (encoder workloads are single-pass; the flash kernel's
+causal streaming buys nothing here).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from ..comm import comm as dist
+from .transformer import HeadProjection, OutProjection, _sdpa_xla
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    layernorm_epsilon: float = 1e-12
+    activation: str = "gelu_exact"  # HF "gelu" = erf
+    dtype: Any = jnp.float32
+
+    @property
+    def head_size(self):
+        return self.hidden_size // self.num_heads
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask_bias):
+        cfg = self.cfg
+        nh, hd, H = cfg.num_heads, cfg.head_size, cfg.hidden_size
+        ln = lambda name: nn.LayerNorm(epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype,
+                                       param_dtype=jnp.float32, name=name)
+        q = HeadProjection(nh, hd, True, cfg.dtype, name="q_proj")(x)
+        k = HeadProjection(nh, hd, True, cfg.dtype, name="k_proj")(x)
+        v = HeadProjection(nh, hd, True, cfg.dtype, name="v_proj")(x)
+        attn = _sdpa_xla(q, k, v, mask_bias, cfg.dtype)
+        attn = OutProjection(H, True, cfg.dtype, name="o_proj")(attn)
+        x = ln("attn_norm")(x + attn)  # post-norm (BERT residual order)
+        dense = lambda feats, name: nn.Dense(feats, dtype=cfg.dtype, param_dtype=jnp.float32,
+                                             name=name)
+        h = dense(cfg.intermediate_size, "up_proj")(x)
+        h = nn.gelu(h, approximate=cfg.activation != "gelu_exact") \
+            if cfg.activation.startswith("gelu") else nn.relu(h)
+        h = dense(H, "down_proj")(h)
+        return ln("mlp_norm")(x + h)
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None):
+        cfg = self.cfg
+        B, T = input_ids.shape
+        emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                       embedding_init=nn.initializers.normal(0.02), name="embed")(input_ids)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
+        emb = emb + pos[:T].astype(cfg.dtype)
+        types = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
+        emb = emb + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                             embedding_init=nn.initializers.normal(0.02),
+                             name="type_embed")(types)
+        x = nn.LayerNorm(epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="embed_norm")(emb)
+        if attention_mask is not None:
+            mask_bias = jnp.where(attention_mask, 0.0, -1e30)[:, None, None, :].astype(jnp.float32)
+        else:
+            mask_bias = jnp.zeros((1, 1, 1, T), jnp.float32)
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(x, mask_bias)
+        pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+                                  name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+class BertEncoderModel:
+    """Engine-facing wrapper mirroring ``CausalLMModel``'s surface."""
+
+    def __init__(self, cfg: BertConfig):
+        self.cfg = cfg
+        self.module = BertEncoder(cfg)
+
+    def init_params(self, rng):
+        ids = jnp.zeros((2, min(self.cfg.max_seq_len, 16)), jnp.int32)
+        return self.module.init({"params": rng}, ids)["params"]
+
+    def apply(self, params, input_ids, attention_mask=None, token_type_ids=None):
+        """Returns (sequence_output, pooled_output) — HF BertModel parity."""
+        return self.module.apply({"params": params}, input_ids, attention_mask, token_type_ids)
+
+    def apply_with_cache(self, *a, **kw):
+        raise NotImplementedError("BERT is an encoder: no KV cache / generate path; "
+                                  "use forward()")
+
+    def init_cache(self, *a, **kw):
+        raise NotImplementedError("BERT is an encoder: no KV cache")
+
+    def tp_rules(self):
+        t = dist.TENSOR_AXIS
+        return [
+            (r"(q|k|v)_proj/kernel", (None, t, None)),  # (H, heads, hd)
+            (r"o_proj/kernel", (t, None, None)),  # (heads, hd, H)
+            (r"up_proj/kernel", (None, t)),
+            (r"down_proj/kernel", (t, None)),
+            (r"embed/embedding", (t, None)),
+        ]
+
+    def expert_pattern(self):
+        return None

@@ -40,11 +40,16 @@ class TransformerConfig:
     head_dim: Optional[int] = None
     max_seq_len: int = 1024
     # family switches
-    pos_embedding: str = "rope"  # "rope" | "learned" | "none"
+    pos_embedding: str = "rope"  # "rope" | "learned" | "none" | "alibi"
     norm: str = "rmsnorm"  # "rmsnorm" | "layernorm"
     activation: str = "swiglu"  # "swiglu" | "gelu" (tanh) | "gelu_exact" (erf) | "relu" | "geglu"
     tie_embeddings: bool = True
     rope_theta: float = 10000.0
+    rotary_dim: Optional[int] = None  # partial rotary (GPT-J/NeoX); None = full head
+    parallel_residual: bool = False  # x + attn(n1(x)) + mlp(n2(x)) (GPT-J/NeoX)
+    embed_norm: bool = False  # layernorm right after the embedding (BLOOM)
+    lm_head_bias: bool = False  # untied lm_head with bias (GPT-J)
+    attn_bias: Optional[bool] = None  # None = follow norm (layernorm -> biased); GPT-J: False
     layernorm_epsilon: float = 1e-5
     dropout: float = 0.0
     # MoE (0 experts = dense)
@@ -72,6 +77,9 @@ class TransformerConfig:
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash"):
             raise ValueError(f"attention_impl must be 'xla' or 'flash', got {self.attention_impl!r}")
+        if self.pos_embedding not in ("rope", "learned", "none", "alibi"):
+            raise ValueError(f"pos_embedding must be 'rope'/'learned'/'none'/'alibi', "
+                             f"got {self.pos_embedding!r}")
         if self.sequence_parallel_impl not in ("ulysses", "ring"):
             raise ValueError(f"sequence_parallel_impl must be 'ulysses' or 'ring', "
                              f"got {self.sequence_parallel_impl!r}")
@@ -256,6 +264,20 @@ def rope_table(head_size, max_len, theta):
     return jnp.sin(angles), jnp.cos(angles)
 
 
+def alibi_slopes(num_heads):
+    """Per-head ALiBi slopes (Press et al.; the HF BLOOM construction): for a
+    power-of-two head count, geometric series starting at 2^(-8/n); otherwise
+    the closest power of two's series plus interleaved extras."""
+    import math
+    n = 2**math.floor(math.log2(num_heads))
+    base = 2.0**(-(2.0**-(math.log2(n) - 3)))
+    slopes = [base**(i + 1) for i in range(n)]
+    if n < num_heads:
+        extra_base = 2.0**(-(2.0**-(math.log2(2 * n) - 3)))
+        slopes += [extra_base**(i + 1) for i in range(0, 2 * (num_heads - n), 2)]
+    return jnp.asarray(slopes, jnp.float32)
+
+
 def apply_rope(x, sin, cos):
     """x: (B, H, T, hd); tables (T, hd/2) shared across the batch or
     (B, T, hd/2) per-row (left-padded generation). Citation: the reference's
@@ -270,14 +292,21 @@ def apply_rope(x, sin, cos):
     return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 
 
-def _ulysses_specs(B, nh):
+def _ulysses_specs(B, nh, nkv=None):
     """Ulysses-style sequence parallelism as placement (DeepSpeed-Ulysses;
     absent in the v0.9.2 reference — SURVEY §2.3 makes SP a build
     requirement): inside attention, re-shard from sequence-split activations
     to head-split q/k/v — XLA inserts the all-to-alls over ICI — and back.
-    Returns (heads_spec, seq_spec) for bhtd tensors, or None when the mesh
-    cannot split this shape."""
-    if not dist.has_mesh() or dist.in_manual_region():
+
+    Returns (heads_spec, seq_q_spec, seq_kv_spec) for bhtd tensors, or None
+    when the mesh cannot split this shape. The projection-side seq specs
+    keep heads sharded by ``tensor`` (the Megatron-TP layout the projection
+    kernels already produce) and T by ``seq``: each boundary reshard then
+    moves exactly ONE axis (the seq all-to-all) — a combined move is an
+    involuntary full rematerialization in the SPMD partitioner."""
+    # a PARTIAL manual region (pipeline: manual over pipe only) still wants
+    # these constraints — dist.constrain resolves them over the auto axes
+    if not dist.has_mesh() or dist.SEQ_AXIS in dist.get_manual_axes():
         return None
     mesh = dist.get_mesh()
     if mesh.shape[dist.SEQ_AXIS] == 1:
@@ -286,30 +315,70 @@ def _ulysses_specs(B, nh):
     if dist.SEQ_AXIS not in head_axes:
         return None  # heads not divisible: leave sequence-sharded (all-gather)
     heads = P(dp_axes or None, head_axes, None, None)
-    seq = P(dp_axes or None, None, dist.SEQ_AXIS, None)
-    return heads, seq
+    t = mesh.shape[dist.TENSOR_AXIS]
+
+    def seq_spec(n_heads):
+        on_heads = dist.TENSOR_AXIS if (t > 1 and n_heads % t == 0) else None
+        return P(dp_axes or None, on_heads, dist.SEQ_AXIS, None)
+
+    return heads, seq_spec(nh), seq_spec(nkv if nkv is not None else nh)
 
 
 def _constrain(x, spec):
-    from jax.sharding import NamedSharding
-    return jax.lax.with_sharding_constraint(x, NamedSharding(dist.get_mesh(), spec))
+    return dist.constrain(x, spec)
 
 
-def _sdpa_xla(q, k, v, mask_bias, dtype):
-    """Pure-XLA attention in bhtd: softmax in fp32, big-negative causal bias."""
+def _embed_layout(x):
+    """Route the embedding-gather output into the canonical activation layout
+    (batch over dp, T over seq, H replicated) in single-axis moves. The
+    gather inherits the table's tensor-tiled H; jumping straight to
+    (dp, seq, None) is a combined move the partitioner can only do by full
+    rematerialization, so step via (dp, seq, tensor) — a free slice — then
+    all-gather H over tensor alone."""
+    import math
+    if not dist.has_mesh():
+        return x
+    mesh = dist.get_mesh()
+    B, T, H = x.shape
+    dp = tuple(a for a in (dist.EXPERT_AXIS, dist.DATA_AXIS) if mesh.shape[a] > 1)
+    if dp and B % math.prod(mesh.shape[a] for a in dp) != 0:
+        dp = ()
+    seq = dist.SEQ_AXIS if (mesh.shape[dist.SEQ_AXIS] > 1
+                            and T % mesh.shape[dist.SEQ_AXIS] == 0) else None
+    t = dist.TENSOR_AXIS if (mesh.shape[dist.TENSOR_AXIS] > 1
+                             and H % mesh.shape[dist.TENSOR_AXIS] == 0) else None
+    if not dp and seq is None and t is None:
+        return x
+    x = _constrain(x, P(dp or None, seq, t))
+    return _constrain(x, P(dp or None, seq, None))
+
+
+def _sdpa_xla(q, k, v, mask_bias, dtype, interior_spec=None):
+    """Pure-XLA attention in bhtd: softmax in fp32, big-negative causal bias.
+
+    ``interior_spec``: optional PartitionSpec pinned onto scores/probs (and,
+    via the constraint's transpose rule, their cotangents). Under Ulysses the
+    interior must stay head-sharded end to end — without the pin the
+    partitioner mixes the seq-sharded cotangent layout into the softmax
+    backward and falls into involuntary full rematerialization."""
     hd = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) / jnp.sqrt(hd)
     scores = scores + mask_bias
+    if interior_spec is not None:
+        scores = _constrain(scores, interior_spec)
     probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    if interior_spec is not None:
+        probs = _constrain(probs, interior_spec)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype):
+def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype, alibi=None):
     """Grouped-query attention against a KV cache, no head expansion.
 
     q: (B, nh, T, hd); ck/cv: (B, nkv, S, hd); cache_mask: optional (B, S)
     bool marking valid cache slots (left-pad masking). Query position ``i`` of
-    this call sits at absolute cache position ``cache_index + i``.
+    this call sits at absolute cache position ``cache_index + i``. ``alibi``:
+    optional (nh,) slopes adding ``-slope * (qpos - kpos)`` to the scores.
     """
     B, nh, T, hd = q.shape
     nkv, S = ck.shape[1], ck.shape[2]
@@ -319,7 +388,14 @@ def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype):
     kpos = jnp.arange(S)[None, :]
     qpos = cache_index + jnp.arange(T)[:, None]
     bias = jnp.where(kpos <= qpos, 0.0, -1e30)  # (T, S)
-    if cache_mask is not None:
+    if alibi is not None:
+        rel = (qpos - kpos).astype(jnp.float32)  # (T, S)
+        bias = bias[None, None] - alibi.reshape(nkv, g)[:, :, None, None] * rel  # (nkv, g, T, S)
+        if cache_mask is not None:
+            bias = bias[None] + jnp.where(cache_mask, 0.0, -1e30)[:, None, None, None, :]
+        else:
+            bias = bias[None]
+    elif cache_mask is not None:
         bias = bias[None] + jnp.where(cache_mask, 0.0, -1e30)[:, None, :]  # (B, T, S)
         bias = bias[:, None, None]
     else:
@@ -382,7 +458,7 @@ class Attention(nn.Module):
         cfg = self.cfg
         B, T, H = x.shape
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_size
-        use_bias = cfg.norm == "layernorm"
+        use_bias = cfg.attn_bias if cfg.attn_bias is not None else cfg.norm == "layernorm"
         # bhtd layout end-to-end: projections emit head-major
         q = HeadProjection(nh, hd, use_bias, cfg.dtype, name="q_proj")(x)
         k = HeadProjection(nkv, hd, use_bias, cfg.dtype, name="k_proj")(x)
@@ -396,8 +472,15 @@ class Attention(nn.Module):
                 pos_cos = jax.lax.dynamic_slice_in_dim(cos, cache_index, T, axis=0)
             else:
                 pos_sin, pos_cos = sin[:T], cos[:T]
-            q = apply_rope(q, pos_sin, pos_cos)
-            k = apply_rope(k, pos_sin, pos_cos)
+            rot = cfg.rotary_dim or hd
+            if rot < hd:  # partial rotary (GPT-J/NeoX): pass-through tail dims
+                rope_part = lambda x: jnp.concatenate(
+                    [apply_rope(x[..., :rot], pos_sin, pos_cos), x[..., rot:]], axis=-1)
+            else:
+                rope_part = lambda x: apply_rope(x, pos_sin, pos_cos)
+            q = rope_part(q)
+            k = rope_part(k)
+        alibi = alibi_slopes(nh) if cfg.pos_embedding == "alibi" else None
 
         if kv_cache is not None:
             # cache layout (B, nkv, S, hd): contiguous (S, hd) slabs per head,
@@ -407,7 +490,7 @@ class Attention(nn.Module):
             ck, cv = kv_cache
             ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_index, axis=2)
             cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_index, axis=2)
-            if cfg.attention_impl == "flash" and T == 1:
+            if cfg.attention_impl == "flash" and T == 1 and alibi is None:
                 from ..ops.pallas.decode_attention import decode_attention
                 if attn_mask is not None:
                     starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
@@ -416,7 +499,7 @@ class Attention(nn.Module):
                 out = decode_attention(q[:, :, 0], ck, cv, starts, cache_index + 1,
                                        block_kv=cfg.decode_block_kv)[:, :, None]
             elif (cfg.attention_impl == "flash" and attn_mask is None and T >= 128
-                  and isinstance(cache_index, int) and cache_index == 0):
+                  and isinstance(cache_index, int) and cache_index == 0 and alibi is None):
                 # unpadded prefill: nothing earlier in the cache, so attention
                 # over the current tokens only — the flash kernel path
                 # (GQA-native: no head expansion)
@@ -425,12 +508,14 @@ class Attention(nn.Module):
                                               block_q=cfg.attention_block_q,
                                               block_kv=cfg.attention_block_kv)
             else:
-                out = _cached_attention_xla(q, ck, cv, cache_index, attn_mask, cfg.dtype)
+                out = _cached_attention_xla(q, ck, cv, cache_index, attn_mask, cfg.dtype,
+                                            alibi=alibi)
             out = out.astype(cfg.dtype)
             new_cache = (ck, cv)
         else:
             new_cache = None
-            use_flash = cfg.attention_impl == "flash" and T >= 128 and attn_mask is None
+            use_flash = (cfg.attention_impl == "flash" and T >= 128 and attn_mask is None
+                         and alibi is None)
             ring_possible = (cfg.sequence_parallel_impl == "ring" and dist.has_mesh()
                              and not dist.in_manual_region()
                              and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
@@ -450,9 +535,15 @@ class Attention(nn.Module):
                     k = jnp.repeat(k, nh // nkv, axis=1)
                     v = jnp.repeat(v, nh // nkv, axis=1)
                 S = k.shape[2]
-                ulysses = _ulysses_specs(B, nh)
+                ulysses = _ulysses_specs(B, nh, k.shape[1])
                 if ulysses is not None:
-                    heads_spec, seq_spec = ulysses
+                    heads_spec, seq_q, seq_kv = ulysses
+                    # pin BOTH sides of the all-to-all boundary: seq layout at
+                    # the projection side (so the weight-grad contraction sees
+                    # matching seq-sharded operands), head layout inside — the
+                    # constraint's transpose rule pins the cotangents likewise
+                    q = _constrain(q, seq_q)
+                    k, v = _constrain(k, seq_kv), _constrain(v, seq_kv)
                     q = _constrain(q, heads_spec)
                     if k.shape[1] == nh:
                         k, v = _constrain(k, heads_spec), _constrain(v, heads_spec)
@@ -463,11 +554,17 @@ class Attention(nn.Module):
                                                   block_kv=cfg.attention_block_kv)
                 else:
                     bias = jnp.where(jnp.tril(jnp.ones((T, S), dtype=bool)), 0.0, -1e30)[None, None]
+                    if alibi is not None:
+                        rel = (jnp.arange(T)[:, None] - jnp.arange(S)[None, :]).astype(jnp.float32)
+                        bias = bias - alibi[None, :, None, None] * rel[None, None]
                     if attn_mask is not None:
                         bias = bias + jnp.where(attn_mask, 0.0, -1e30)[:, None, None, :].astype(jnp.float32)
-                    out = _sdpa_xla(q, k, v, bias, cfg.dtype)
+                    interior = ulysses[0] if ulysses is not None else None
+                    out = _sdpa_xla(q, k, v, bias, cfg.dtype, interior_spec=interior)
+                    if ulysses is not None:
+                        out = _constrain(out, heads_spec)
                 if ulysses is not None:
-                    out = _constrain(out, seq_spec)
+                    out = _constrain(out, seq_q)
 
         out = OutProjection(H, use_bias, cfg.dtype, name="o_proj")(out)
         return out, new_cache
@@ -510,16 +607,24 @@ class Block(nn.Module):
                                                    cache_index, position_ids)
         if drop is not None:
             h = drop(h, deterministic=deterministic)
-        x = x + h
-        h = make_norm(cfg, name="mlp_norm")(x)
+        if cfg.parallel_residual:
+            # GPT-J/NeoX: attn and mlp both read the pre-attn stream and add
+            # into ONE residual (GPT-J ties attn_norm == mlp_norm weights —
+            # the conversion duplicates them)
+            ff_in = make_norm(cfg, name="mlp_norm")(x)
+        else:
+            x = x + h
+            ff_in = make_norm(cfg, name="mlp_norm")(x)
         if cfg.num_experts > 0:
             from ..moe.layer import MoE
-            ff, aux = MoE(cfg, name="moe")(h)
+            ff, aux = MoE(cfg, name="moe")(ff_in)
             self.sow("intermediates", "moe_aux_loss", aux)
         else:
-            ff = MLP(cfg, name="mlp")(h)
+            ff = MLP(cfg, name="mlp")(ff_in)
         if drop is not None:
             ff = drop(ff, deterministic=deterministic)
+        if cfg.parallel_residual:
+            return x + h + ff, new_cache
         return x + ff, new_cache
 
 
@@ -544,7 +649,9 @@ class CausalLM(nn.Module):
         B, T = input_ids.shape
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                        embedding_init=nn.initializers.normal(0.02), name="embed")
-        x = emb(input_ids)
+        x = _embed_layout(emb(input_ids))
+        if cfg.embed_norm:  # BLOOM's word_embeddings_layernorm
+            x = make_norm(cfg, name="embed_norm")(x)
         if cfg.pos_embedding == "learned":
             pos_emb = self.param("pos_embed", nn.initializers.normal(0.02),
                                  (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
@@ -554,7 +661,7 @@ class CausalLM(nn.Module):
                 x = x + jax.lax.dynamic_slice_in_dim(pos_emb, cache_index, T, axis=0).astype(cfg.dtype)
             else:
                 x = x + jax.lax.dynamic_slice_in_dim(pos_emb, 0, T, axis=0).astype(cfg.dtype)
-        sin, cos = (rope_table(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+        sin, cos = (rope_table(cfg.rotary_dim or cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
                     if cfg.pos_embedding == "rope" else (None, None))
 
         block = Block
@@ -613,7 +720,9 @@ class CausalLM(nn.Module):
         else:
             caches = []
             for i in range(cfg.num_layers):
-                layer_cache = None if kv_cache is None else jax.tree_util.tree_map(lambda c: c[i], kv_cache)
+                # per-layer tuple cache (init_cache, unrolled form); stacked
+                # arrays also index correctly for backward compatibility
+                layer_cache = None if kv_cache is None else (kv_cache[0][i], kv_cache[1][i])
                 blk = block(cfg, name=f"layer_{i}")
                 if ltd_active and i in ltd_layers:
                     y, c = ltd_apply(
@@ -626,7 +735,7 @@ class CausalLM(nn.Module):
                 x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
-                new_cache = jax.tree_util.tree_map(lambda *cs: jnp.stack(cs), *caches)
+                new_cache = (tuple(c[0] for c in caches), tuple(c[1] for c in caches))
 
         x = make_norm(cfg, name="final_norm")(x)
         if return_hidden:
@@ -635,7 +744,7 @@ class CausalLM(nn.Module):
         if cfg.tie_embeddings:
             logits = emb.attend(x)
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias, dtype=cfg.dtype,
                               param_dtype=jnp.float32, name="lm_head")(x)
         if kv_cache is not None:
             return logits, new_cache
@@ -677,13 +786,21 @@ class CausalLMModel:
 
     # ---- generation (KV cache) -------------------------------------------
     def init_cache(self, batch_size, max_len, dtype=None):
-        """Preallocated KV cache, (L, B, kv_heads, S, head_dim) per k and v —
-        the analogue of the reference's inference workspace KV arena
-        (``csrc/transformer/inference/includes/inference_context.h``)."""
+        """Preallocated KV cache — the analogue of the reference's inference
+        workspace KV arena (``csrc/transformer/inference/includes/
+        inference_context.h``). Scanned models carry one stacked
+        (L, B, kv_heads, S, head_dim) pair; unrolled models carry per-layer
+        tuples of (B, kv_heads, S, head_dim) — separate tensors alias
+        IN-PLACE through the decode while-loop carry, where a scan's stacked
+        ys output is rebuilt (full cache copy) every token."""
         cfg = self.cfg
-        shape = (cfg.num_layers, batch_size, cfg.kv_heads, max_len, cfg.head_size)
         dt = dtype or cfg.dtype
-        return (jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+        shape = (batch_size, cfg.kv_heads, max_len, cfg.head_size)
+        if cfg.scan_layers:
+            stacked = (cfg.num_layers, ) + shape
+            return (jnp.zeros(stacked, dt), jnp.zeros(stacked, dt))
+        return (tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_layers)),
+                tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_layers)))
 
     def apply_with_cache(self, params, input_ids, kv_cache, cache_index, cache_mask=None,
                          position_ids=None):
@@ -721,6 +838,8 @@ class CausalLMModel:
             return False
         if self.cfg.ce_chunk_size is None and self.cfg.vocab_size < 4096:
             return False
+        if self.cfg.lm_head_bias:
+            return False  # chunked CE rebuilds logits from the weight only
         return not (dist.has_mesh() and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
 
     def _ce_chunk(self):
@@ -789,9 +908,11 @@ class CausalLMModel:
 
         table = params["embed"]["embedding"].astype(cfg.dtype)
         x = table[ids]  # (M, b, T, H)
+        if cfg.embed_norm:
+            x = make_norm(cfg).apply({"params": params["embed_norm"]}, x)
         if cfg.pos_embedding == "learned":
             x = x + params["pos_embed"][:T].astype(cfg.dtype)
-        sin, cos = (rope_table(cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+        sin, cos = (rope_table(cfg.rotary_dim or cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
                     if cfg.pos_embedding == "rope" else (None, None))
 
         block_mod = Block(cfg)
@@ -846,6 +967,8 @@ class CausalLMModel:
         import optax
         eq = "mbth,vh->mbtv" if transpose else "mbth,hv->mbtv"
         logits = jnp.einsum(eq, stream[:, :, shift], w.astype(stream.dtype))
+        if cfg.lm_head_bias:
+            logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
         ce = optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), labels_c)
         return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
 

@@ -40,6 +40,11 @@ class InjectionPolicy:
     architectures = ()
     model_types = ()
 
+    @property
+    def model_class(self):
+        from ..models.transformer import CausalLMModel
+        return CausalLMModel
+
     @classmethod
     def matches(cls, hf_config):
         archs = tuple(getattr(hf_config, "architectures", None) or ())
@@ -279,6 +284,308 @@ class OPTPolicy(InjectionPolicy):
         return self._assemble(cfg, top, layer)
 
 
+class BloomPolicy(InjectionPolicy):
+    """BLOOM (reference ``containers/bloom.py``): ALiBi positions, embedding
+    layernorm, per-head-interleaved fused QKV ``(nh, 3, hd)``, tanh-gelu MLP,
+    tied embeddings."""
+
+    architectures = ("BloomForCausalLM", "BloomModel")
+    model_types = ("bloom", )
+
+    def build_config(self, hf, **overrides):
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            max_seq_len=int(getattr(hf, "seq_length", 0) or 2048),
+            pos_embedding="alibi",
+            norm="layernorm",
+            activation="gelu",  # BloomGelu is the tanh approximation
+            tie_embeddings=True,
+            embed_norm=True,
+            layernorm_epsilon=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+        )
+        kw.update(overrides)
+        return TransformerConfig(**kw)
+
+    def convert(self, get, cfg):
+        nh, hd, H = cfg.num_heads, cfg.head_size, cfg.hidden_size
+        p = "transformer."
+
+        def split_qkv(w, b):
+            # (3H, H) laid out (nh, 3, hd, H): q/k/v interleave PER HEAD
+            w = w.reshape(nh, 3, hd, H)
+            b = b.reshape(nh, 3, hd)
+            out = {}
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                # (nh, hd, H) -> (H, nh, hd)
+                out[name] = {"kernel": np.ascontiguousarray(w[:, j].transpose(2, 0, 1)),
+                             "bias": np.ascontiguousarray(b[:, j])}
+            return out
+
+        def layer(i):
+            q = f"{p}h.{i}."
+            attn = split_qkv(get(q + "self_attention.query_key_value.weight"),
+                             get(q + "self_attention.query_key_value.bias"))
+            attn["o_proj"] = {"kernel": _heads_out(_t(get(q + "self_attention.dense.weight")), nh, hd),
+                              "bias": get(q + "self_attention.dense.bias")}
+            return {
+                "attn_norm": {"scale": get(q + "input_layernorm.weight"),
+                              "bias": get(q + "input_layernorm.bias")},
+                "mlp_norm": {"scale": get(q + "post_attention_layernorm.weight"),
+                             "bias": get(q + "post_attention_layernorm.bias")},
+                "attn": attn,
+                "mlp": {
+                    "up_proj": {"kernel": _t(get(q + "mlp.dense_h_to_4h.weight")),
+                                "bias": get(q + "mlp.dense_h_to_4h.bias")},
+                    "down_proj": {"kernel": _t(get(q + "mlp.dense_4h_to_h.weight")),
+                                  "bias": get(q + "mlp.dense_4h_to_h.bias")},
+                },
+            }
+
+        top = {
+            "embed": {"embedding": get(p + "word_embeddings.weight")},
+            "embed_norm": {"scale": get(p + "word_embeddings_layernorm.weight"),
+                           "bias": get(p + "word_embeddings_layernorm.bias")},
+            "final_norm": {"scale": get(p + "ln_f.weight"), "bias": get(p + "ln_f.bias")},
+        }
+        return self._assemble(cfg, top, layer)
+
+
+def _interleaved_to_half_perm(rot):
+    """Dim permutation mapping interleaved rotary pairs (GPT-J convention:
+    (2i, 2i+1)) onto this model's half-split pairs ((i, i + rot/2)). Applied
+    identically to q AND k head dims, the attention dot product is unchanged
+    while ``apply_rope`` reproduces the interleaved rotation exactly."""
+    return np.concatenate([np.arange(0, rot, 2), np.arange(1, rot, 2)])
+
+
+class GPTJPolicy(InjectionPolicy):
+    """GPT-J (reference ``containers/gptj.py``): parallel residual with ONE
+    shared layernorm, partial interleaved rotary (``rotary_dim``), untied
+    lm_head with bias. The interleaved rotary becomes this model's half-split
+    convention by permuting the q/k kernel head dims (dot-product invariant)."""
+
+    architectures = ("GPTJForCausalLM", )
+    model_types = ("gptj", )
+
+    def build_config(self, hf, **overrides):
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.n_embd,
+            num_layers=hf.n_layer,
+            num_heads=hf.n_head,
+            max_seq_len=hf.n_positions,
+            pos_embedding="rope",
+            rotary_dim=int(getattr(hf, "rotary_dim", None) or (hf.n_embd // hf.n_head)),
+            rope_theta=10000.0,
+            norm="layernorm",
+            activation="gelu",  # gelu_new (tanh)
+            parallel_residual=True,
+            tie_embeddings=False,
+            lm_head_bias=True,
+            attn_bias=False,
+            layernorm_epsilon=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+        )
+        kw.update(overrides)
+        return TransformerConfig(**kw)
+
+    def convert(self, get, cfg):
+        nh, hd = cfg.num_heads, cfg.head_size
+        rot = cfg.rotary_dim or hd
+        perm = _interleaved_to_half_perm(rot)
+
+        def rotary_in(w):
+            k = _heads_in(_t(w), nh, hd)  # (H, nh, hd)
+            k[:, :, :rot] = k[:, :, perm]
+            return k
+
+        def layer(i):
+            q = f"transformer.h.{i}."
+            ln = {"scale": get(q + "ln_1.weight"), "bias": get(q + "ln_1.bias")}
+            return {
+                "attn_norm": ln,
+                "mlp_norm": dict(ln),  # GPT-J shares one norm; duplicated weights
+                "attn": {
+                    "q_proj": {"kernel": rotary_in(get(q + "attn.q_proj.weight"))},
+                    "k_proj": {"kernel": rotary_in(get(q + "attn.k_proj.weight"))},
+                    "v_proj": {"kernel": _heads_in(_t(get(q + "attn.v_proj.weight")), nh, hd)},
+                    "o_proj": {"kernel": _heads_out(_t(get(q + "attn.out_proj.weight")), nh, hd)},
+                },
+                "mlp": {
+                    "up_proj": {"kernel": _t(get(q + "mlp.fc_in.weight")),
+                                "bias": get(q + "mlp.fc_in.bias")},
+                    "down_proj": {"kernel": _t(get(q + "mlp.fc_out.weight")),
+                                  "bias": get(q + "mlp.fc_out.bias")},
+                },
+            }
+
+        top = {
+            "embed": {"embedding": get("transformer.wte.weight")},
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
+            "lm_head": {"kernel": _t(get("lm_head.weight")), "bias": get("lm_head.bias")},
+        }
+        return self._assemble(cfg, top, layer)
+
+
+class GPTNeoXPolicy(InjectionPolicy):
+    """GPT-NeoX / Pythia (reference ``containers/gptneox.py``): parallel
+    residual with separate norms, partial HALF-SPLIT rotary (``rotary_pct``,
+    no permutation needed), per-head-interleaved fused QKV, untied embed_out."""
+
+    architectures = ("GPTNeoXForCausalLM", )
+    model_types = ("gpt_neox", )
+
+    def build_config(self, hf, **overrides):
+        act = getattr(hf, "hidden_act", "gelu")
+        act_map = {"relu": "relu", "gelu": "gelu_exact", "gelu_new": "gelu",
+                   "gelu_fast": "gelu"}
+        if act not in act_map:
+            raise ValueError(f"GPT-NeoX hidden_act={act!r} unsupported")
+        hd = hf.hidden_size // hf.num_attention_heads
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            max_seq_len=hf.max_position_embeddings,
+            pos_embedding="rope",
+            rotary_dim=int(float(getattr(hf, "rotary_pct", 1.0)) * hd),
+            rope_theta=float(getattr(hf, "rotary_emb_base", 10000.0)),
+            norm="layernorm",
+            activation=act_map[act],
+            parallel_residual=bool(getattr(hf, "use_parallel_residual", True)),
+            tie_embeddings=bool(getattr(hf, "tie_word_embeddings", False)),
+            layernorm_epsilon=float(getattr(hf, "layer_norm_eps", 1e-5)),
+        )
+        kw.update(overrides)
+        return TransformerConfig(**kw)
+
+    def convert(self, get, cfg):
+        nh, hd, H = cfg.num_heads, cfg.head_size, cfg.hidden_size
+        p = "gpt_neox."
+
+        def split_qkv(w, b):
+            w = w.reshape(nh, 3, hd, H)
+            b = b.reshape(nh, 3, hd)
+            out = {}
+            for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+                out[name] = {"kernel": np.ascontiguousarray(w[:, j].transpose(2, 0, 1)),
+                             "bias": np.ascontiguousarray(b[:, j])}
+            return out
+
+        def layer(i):
+            q = f"{p}layers.{i}."
+            attn = split_qkv(get(q + "attention.query_key_value.weight"),
+                             get(q + "attention.query_key_value.bias"))
+            attn["o_proj"] = {"kernel": _heads_out(_t(get(q + "attention.dense.weight")), nh, hd),
+                              "bias": get(q + "attention.dense.bias")}
+            return {
+                "attn_norm": {"scale": get(q + "input_layernorm.weight"),
+                              "bias": get(q + "input_layernorm.bias")},
+                "mlp_norm": {"scale": get(q + "post_attention_layernorm.weight"),
+                             "bias": get(q + "post_attention_layernorm.bias")},
+                "attn": attn,
+                "mlp": {
+                    "up_proj": {"kernel": _t(get(q + "mlp.dense_h_to_4h.weight")),
+                                "bias": get(q + "mlp.dense_h_to_4h.bias")},
+                    "down_proj": {"kernel": _t(get(q + "mlp.dense_4h_to_h.weight")),
+                                  "bias": get(q + "mlp.dense_4h_to_h.bias")},
+                },
+            }
+
+        top = {
+            "embed": {"embedding": get(p + "embed_in.weight")},
+            "final_norm": {"scale": get(p + "final_layer_norm.weight"),
+                           "bias": get(p + "final_layer_norm.bias")},
+        }
+        if not cfg.tie_embeddings:
+            top["lm_head"] = {"kernel": _t(get("embed_out.weight"))}
+        return self._assemble(cfg, top, layer)
+
+
+class BertPolicy(InjectionPolicy):
+    """BERT encoder (reference ``containers/bert.py`` + ``distil_bert.py``
+    serving the fused ``BertLayer``): post-norm bidirectional blocks, learned
+    + token-type embeddings, pooler. Builds a ``BertEncoderModel`` — forward
+    returns (sequence_output, pooled_output), HF ``BertModel`` parity."""
+
+    architectures = ("BertModel", "BertForMaskedLM", "BertForSequenceClassification")
+    model_types = ("bert", )
+
+    @property
+    def model_class(self):
+        from ..models.bert import BertEncoderModel
+        return BertEncoderModel
+
+    def build_config(self, hf, **overrides):
+        from ..models.bert import BertConfig
+        act = getattr(hf, "hidden_act", "gelu")
+        act_map = {"gelu": "gelu_exact", "gelu_new": "gelu", "relu": "relu"}
+        if act not in act_map:
+            raise ValueError(f"BERT hidden_act={act!r} unsupported")
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=hf.intermediate_size,
+            num_layers=hf.num_hidden_layers,
+            num_heads=hf.num_attention_heads,
+            max_seq_len=hf.max_position_embeddings,
+            type_vocab_size=getattr(hf, "type_vocab_size", 2),
+            activation=act_map[act],
+            layernorm_epsilon=float(getattr(hf, "layer_norm_eps", 1e-12)),
+        )
+        kw.update(overrides)
+        return BertConfig(**kw)
+
+    def convert(self, get, cfg):
+        nh, hd = cfg.num_heads, cfg.head_size
+
+        def g(name):
+            # BertForMaskedLM et al. prefix the encoder with "bert."
+            for pre in ("", "bert."):
+                try:
+                    return get(pre + name)
+                except KeyError:
+                    continue
+            raise KeyError(name)
+
+        def lin_in(name, n):
+            return {"kernel": _heads_in(_t(g(name + ".weight")), n, hd),
+                    "bias": g(name + ".bias").reshape(n, hd)}
+
+        params = {
+            "embed": {"embedding": g("embeddings.word_embeddings.weight")},
+            "pos_embed": g("embeddings.position_embeddings.weight"),
+            "type_embed": {"embedding": g("embeddings.token_type_embeddings.weight")},
+            "embed_norm": {"scale": g("embeddings.LayerNorm.weight"),
+                           "bias": g("embeddings.LayerNorm.bias")},
+            "pooler": {"kernel": _t(g("pooler.dense.weight")),
+                       "bias": g("pooler.dense.bias")},
+        }
+        for i in range(cfg.num_layers):
+            q = f"encoder.layer.{i}."
+            params[f"layer_{i}"] = {
+                "q_proj": lin_in(q + "attention.self.query", nh),
+                "k_proj": lin_in(q + "attention.self.key", nh),
+                "v_proj": lin_in(q + "attention.self.value", nh),
+                "o_proj": {"kernel": _heads_out(_t(g(q + "attention.output.dense.weight")), nh, hd),
+                           "bias": g(q + "attention.output.dense.bias")},
+                "attn_norm": {"scale": g(q + "attention.output.LayerNorm.weight"),
+                              "bias": g(q + "attention.output.LayerNorm.bias")},
+                "up_proj": {"kernel": _t(g(q + "intermediate.dense.weight")),
+                            "bias": g(q + "intermediate.dense.bias")},
+                "down_proj": {"kernel": _t(g(q + "output.dense.weight")),
+                              "bias": g(q + "output.dense.bias")},
+                "mlp_norm": {"scale": g(q + "output.LayerNorm.weight"),
+                             "bias": g(q + "output.LayerNorm.bias")},
+            }
+        return params
+
+
 class MegatronPolicy(InjectionPolicy):
     """Megatron-LM GPT checkpoints (reference ``containers/megatron_gpt.py`` +
     ``MegatronSDLoader``'s key conventions): fused blocked [q;k;v] attention
@@ -364,13 +671,15 @@ class MegatronPolicy(InjectionPolicy):
         return self._assemble(cfg, top, layer)
 
 
-replace_policies = [LlamaPolicy, MixtralPolicy, GPT2Policy, OPTPolicy, MegatronPolicy]
+replace_policies = [LlamaPolicy, MixtralPolicy, GPT2Policy, OPTPolicy, BloomPolicy,
+                    GPTJPolicy, GPTNeoXPolicy, BertPolicy, MegatronPolicy]
 
 
 def get_policy(hf_config):
     # Mixtral before Llama: both match model_type prefixes via architectures;
     # MegatronPolicy last — it matches only to raise its routing explanation
-    for cls in (MixtralPolicy, LlamaPolicy, GPT2Policy, OPTPolicy, MegatronPolicy):
+    for cls in (MixtralPolicy, LlamaPolicy, GPT2Policy, OPTPolicy, BloomPolicy,
+                GPTJPolicy, GPTNeoXPolicy, BertPolicy, MegatronPolicy):
         if cls.matches(hf_config):
             return cls()
     raise ValueError(

@@ -79,13 +79,13 @@ def inject_hf_model(model_or_path, hf_config=None, dtype=None, **overrides):
     if dtype is not None:
         overrides = dict(overrides, dtype=dtype)
     cfg = policy.build_config(cfg_src, **overrides)
-    logger.info(f"module_inject: {type(policy).__name__} -> TransformerConfig("
+    logger.info(f"module_inject: {type(policy).__name__} -> {type(cfg).__name__}("
                 f"L={cfg.num_layers}, H={cfg.hidden_size}, heads={cfg.num_heads}/"
-                f"{cfg.kv_heads}, vocab={cfg.vocab_size})")
+                f"{getattr(cfg, 'kv_heads', cfg.num_heads)}, vocab={cfg.vocab_size})")
     params = policy.convert(loader.get, cfg)
     loader.close()
     params = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), params)
-    model = CausalLMModel(cfg)
+    model = policy.model_class(cfg)  # CausalLMModel, or e.g. BertEncoderModel
     _check_tree(model, params)
     return model, params
 

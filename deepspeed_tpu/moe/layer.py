@@ -18,13 +18,11 @@ from .sharded_moe import top_k_gating
 
 
 def _expert_constraint(x, spec):
-    """Pin an (E, ...) intermediate to the expert axis when a mesh is live.
-    Inside a manual (shard_map) region full-mesh constraints are illegal —
-    the auto partitioner still places the dispatch from the param shardings."""
-    if dist.in_manual_region():
-        return x
+    """Pin an (E, ...) intermediate to the expert axis when a mesh is live
+    (works inside partial-manual regions too — dist.constrain drops the
+    manually-partitioned axes and resolves over the auto remainder)."""
     if dist.has_mesh() and dist.get_mesh().shape[dist.EXPERT_AXIS] > 1:
-        return jax.lax.with_sharding_constraint(x, NamedSharding(dist.get_mesh(), spec))
+        return dist.constrain(x, spec)
     return x
 
 
@@ -60,20 +58,46 @@ class MoE(nn.Module):
     """Top-k routed MoE FFN; returns (output, aux_loss)."""
     cfg: any  # TransformerConfig
 
+    def _token_spec(self, B, T):
+        """Canonical (N, H) token layout: the flattened B·T dim carries the
+        batch axes (expert,data) major and seq minor — exactly what reshaping
+        a (B@dp, T@seq, H) activation preserves. Pinning it (and therefore
+        its cotangent) keeps the partitioner from dragging tensor-axis tiling
+        of H into the dispatch/combine einsums (involuntary full remat)."""
+        import math
+        mesh = dist.get_mesh()
+        axes = [a for a in (dist.EXPERT_AXIS, dist.DATA_AXIS) if mesh.shape[a] > 1]
+        if axes and B % math.prod(mesh.shape[a] for a in axes) != 0:
+            axes = []
+        if mesh.shape[dist.SEQ_AXIS] > 1 and T % mesh.shape[dist.SEQ_AXIS] == 0:
+            axes = axes + [dist.SEQ_AXIS]
+        return P(tuple(axes) if axes else None, None)
+
     @nn.compact
     def __call__(self, x):  # x: (B, T, H)
         cfg = self.cfg
         B, T, H = x.shape
         N, E = B * T, cfg.num_experts
         tokens = x.reshape(N, H)
+        if dist.has_mesh():
+            tokens = dist.constrain(tokens, self._token_spec(B, T))
 
         gate_w = self.param("gate", nn.initializers.normal(0.02), (H, E), jnp.float32)
         logits = tokens.astype(jnp.float32) @ gate_w
         dispatch, combine, aux_loss, _ = top_k_gating(logits, cfg.moe_top_k, cfg.moe_capacity_factor)
+        if dist.has_mesh():
+            # dispatch/combine stay token-sharded; the expert_in/out einsums
+            # contract over n (psum over the token axes) — tiling them by e
+            # mid-build is the involuntary-remat path
+            gspec = P(self._token_spec(B, T)[0], None, None)
+            dispatch = dist.constrain(dispatch, gspec)
+            combine = dist.constrain(combine, gspec)
 
         expert_in = jnp.einsum("nec,nh->ech", dispatch.astype(cfg.dtype), tokens)
         expert_in = _expert_constraint(expert_in, P(dist.EXPERT_AXIS, None, None))
         expert_out = Experts(E, H, cfg.ffn_size, cfg.activation, cfg.dtype, name="experts")(expert_in)
         expert_out = _expert_constraint(expert_out, P(dist.EXPERT_AXIS, None, None))
         out = jnp.einsum("nec,ech->nh", combine.astype(cfg.dtype), expert_out)
+        if dist.has_mesh():
+            out = dist.constrain(out, self._token_spec(B, T))
         return out.reshape(B, T, H), aux_loss

@@ -140,7 +140,8 @@ class FlopsProfiler:
             lines.append(f"achieved TFLOP/s:       {self._stats.get('flops', 0) / per_step / 1e12:.2f}")
         if detailed and hasattr(self.model, "module"):
             try:
-                lines.append(self._tabulate())
+                lines.append(module_profile_tree(self.model, depth=module_depth,
+                                                 top_modules=top_modules))
             except Exception as e:
                 lines.append(f"(per-module table unavailable: {e})")
         report = "\n".join(lines)
@@ -151,15 +152,61 @@ class FlopsProfiler:
             logger.info("\n" + report)
         return report
 
-    def _tabulate(self, batch_size=1, seq_len=None):
-        """Per-module analytic table via flax.linen.tabulate."""
-        import flax.linen as nn
-        import jax.numpy as jnp
-        cfg = self.model.cfg
-        T = seq_len or min(cfg.max_seq_len, 512)
-        ids = jnp.zeros((batch_size, T), jnp.int32)
-        return nn.tabulate(self.model.module, jax.random.key(0), compute_flops=True,
-                           compute_vjp_flops=False, depth=2)(ids)
+
+def module_profile_tree(model, batch_size=1, seq_len=None, depth=-1, top_modules=3):
+    """Reference-style per-module breakdown (``profiler.py:239``
+    ``print_model_profile`` depth/top-k tree): analytic forward FLOPs, MACs
+    and params per named module scope, aggregated per depth with the top-k
+    heaviest modules at each level and their share of the model total.
+
+    Where the reference counts through torch module hooks, here flax's module
+    tracer supplies per-scope flops and variables — same tree, no hooks."""
+    import jax.numpy as jnp
+    from flax.linen import summary
+
+    cfg = model.cfg
+    T = seq_len or min(cfg.max_seq_len, 512)
+    ids = jnp.zeros((batch_size, T), jnp.int32)
+    table = summary._get_module_table(model.module, depth=None, show_repeated=False,
+                                      compute_flops=True, compute_vjp_flops=False)(
+        {"params": jax.random.key(0)}, ids)
+
+    def row_params(row):
+        if not row.counted_variables:
+            return 0
+        import jax as _jax
+        return sum(int(np.prod(v.shape)) for col in row.counted_variables.values()
+                   for v in _jax.tree_util.tree_leaves(col))
+
+    raw = [(row.path, type(row.module_copy).__name__,
+            float(row.flops) if row.flops not in (None, ) else 0.0, row_params(row))
+           for row in table]
+    # aggregate params over descendants (flax counts each variable once, at
+    # its owning leaf scope)
+    rows = [(p, cls, f, sum(pr2 for p2, _, _, pr2 in raw if p2[:len(p)] == p))
+            for p, cls, f, _ in raw]
+    total_flops = next((f for p, _, f, _ in rows if p == ()), 0.0) or 1.0
+    total_params = next((pr for p, _, _, pr in rows if p == ()), 0)
+    max_depth = max((len(p) for p, _, _, _ in rows), default=0)
+    if depth is None or depth < 0:
+        depth = min(max_depth, 3)
+
+    lines = [f"per-module forward profile (bs={batch_size}, seq={T}; "
+             f"total {number_to_string(total_flops, 'FLOPs')}, "
+             f"{number_to_string(total_params, 'params')}):"]
+    for d in range(1, depth + 1):
+        level = [(p, cls, f, pr) for p, cls, f, pr in rows if len(p) == d]
+        if not level:
+            break
+        level.sort(key=lambda r: -r[2])
+        lines.append(f"depth {d} (top {min(top_modules, len(level))} of {len(level)} modules "
+                     f"by fwd FLOPs):")
+        for p, cls, f, pr in level[:top_modules]:
+            name = "/".join(p)
+            lines.append(f"  {name:<34s} {cls:<16s} "
+                         f"{number_to_string(pr, 'params'):>14s} "
+                         f"{number_to_string(f / 2, 'MACs'):>12s} {100 * f / total_flops:5.1f}%")
+    return "\n".join(lines)
 
 
 def get_model_profile(model, input_shape=None, args=None, print_profile=True, detailed=True,

@@ -66,6 +66,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         inf.planner = self.planner
         inf.params = None  # refreshed per generate()
         inf._compiled = {}
+        inf._cache_pool = {}
 
     # ------------------------------------------------------------------ modes
     def eval(self):

@@ -50,3 +50,19 @@ def test_env_report_runs():
     names = [row[0] for row in op_compatibility()]
     assert any("cpu_adam" in n for n in names)
     assert any("flash_attention" in n for n in names)
+
+
+def test_module_profile_tree():
+    """Reference-style depth/top-k per-module table (profiler.py:239)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.models import get_model
+    from deepspeed_tpu.profiling.flops_profiler.profiler import module_profile_tree
+    m = get_model("tiny", dtype=jnp.float32, scan_layers=False)
+    out = module_profile_tree(m, depth=2, top_modules=3)
+    assert "depth 1" in out and "depth 2" in out
+    assert "Block" in out and "Attention" in out
+    assert "params" in out and "MACs" in out and "%" in out
+    # params aggregate over descendants: a Block shows nonzero params
+    import re
+    block_line = next(l for l in out.splitlines() if "Block" in l)
+    assert not re.search(r"\b0 params", block_line)

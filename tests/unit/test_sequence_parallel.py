@@ -101,3 +101,59 @@ def test_sp2_tp2_ring_matches_dense():
     ring = run_losses({"sequence_parallel_size": 2, "tensor_parallel_size": 2}, T=128,
                       attention_impl="flash", sequence_parallel_impl="ring", steps=2)
     assert np.allclose(base, ring, rtol=2e-4), f"{base} vs {ring}"
+
+
+_REMAT_WORKER = r"""
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np
+import jax.numpy as jnp
+sys.path.insert(0, os.environ["DSTPU_REPO"])
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models import get_model
+
+comm._state["mesh"] = None
+model = get_model("tiny-moe", dtype=jnp.float32, num_experts=2)
+config = {
+    "train_batch_size": 4, "gradient_accumulation_steps": 2,
+    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+    "gradient_clipping": 1.0,
+    "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+    "steps_per_print": 1,
+    "mesh": {"data_parallel_size": 2, "sequence_parallel_size": 2,
+             "tensor_parallel_size": 2},
+}
+engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+rng = np.random.default_rng(0)
+batch = {"input_ids": rng.integers(0, 256, (4, 64)).astype(np.int32)}
+loss = engine.train_batch(batch=batch)
+assert np.isfinite(float(loss))
+print("STEP_OK", float(loss))
+"""
+
+
+def test_seq_tensor_layout_has_no_involuntary_remat(tmp_path):
+    """The (data=2, seq=2, tensor=2) train step must compile without the SPMD
+    partitioner's 'Involuntary full rematerialization' fallback (VERDICT r2
+    item 3): those replicate-then-repartition reshards are exactly what
+    craters seq x tensor MFU on a real pod. Subprocess because the warning is
+    emitted by XLA's C++ logging, not through Python."""
+    import os
+    import subprocess
+    import sys
+    worker = tmp_path / "worker.py"
+    worker.write_text(_REMAT_WORKER)
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["DSTPU_REPO"] = repo_root
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, str(worker)], capture_output=True, text=True,
+                          timeout=600, env=env)
+    assert proc.returncode == 0, f"worker failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    assert "STEP_OK" in proc.stdout
+    bad = [l for l in proc.stderr.splitlines() if "Involuntary full rematerialization" in l]
+    assert not bad, "involuntary remat reshards in seq x tensor layout:\n" + "\n".join(bad[:5])
