@@ -115,10 +115,15 @@ def build_host_commands(hosts, coordinator, port, script, script_args, env_passt
     return cmds
 
 
-def _ssh_wrap(host, argv, env, ssh_port=None):
+def _ssh_wrap(host, argv, env, ssh_port=None, tty=False):
+    """``tty=True`` (elastic mode): allocate a pty so terminating the LOCAL
+    ssh client HUPs the remote process group — without it, killing the ssh
+    client leaves remote workers alive holding the TPU across relaunches."""
     exports = " ".join(f"export {k}={shlex.quote(v)};" for k, v in env.items())
     remote = f"cd {shlex.quote(os.getcwd())}; {exports} {' '.join(shlex.quote(a) for a in argv)}"
     cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if tty:
+        cmd += ["-tt"]
     if ssh_port:
         cmd += ["-p", str(ssh_port)]
     return cmd + [host, remote]
@@ -138,23 +143,64 @@ def parse_args(argv=None):
     parser.add_argument("--ssh_port", type=int, default=None)
     parser.add_argument("--force_multi", action="store_true",
                         help="use ssh launch even for one host")
+    parser.add_argument("--elastic", action="store_true",
+                        help="supervise workers and relaunch on failure/preemption "
+                             "(workers auto-resume from the latest checkpoint)")
+    parser.add_argument("--max_elastic_restarts", type=int, default=3)
     parser.add_argument("user_script", help="training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
 
 
-def main(argv=None):
-    args = parse_args(argv)
-
+def _resolve_hosts(args):
     if os.path.isfile(args.hostfile):
         resources = fetch_hostfile(args.hostfile)
         resources = parse_inclusion_exclusion(resources, args.include, args.exclude)
         hosts = list(resources)
     else:
-        logger.info(f"no hostfile at {args.hostfile}; launching on localhost only")
         hosts = ["localhost"]
     if args.num_nodes > 0:
         hosts = hosts[:args.num_nodes]
+    return hosts
+
+
+_ENV_PASSTHROUGH = ("PYTHONPATH", "JAX_PLATFORMS", "DSTPU_LOG_LEVEL")
+
+
+def run_elastic(args):
+    """Supervised launch (reference ``DSElasticAgent``): re-resolve hosts and
+    bump the rendezvous port on every restart, so a preempted/replaced host
+    list rejoins cleanly; resume correctness rides the universal checkpoint."""
+    from ..elasticity.elastic_agent import DSElasticAgent
+
+    def build(attempt):
+        hosts = _resolve_hosts(args)  # hostfile re-read: dead hosts drop out
+        coordinator = args.master_addr or hosts[0]
+        port = args.master_port + attempt  # stale coordinators can't collide
+        cmds = build_host_commands(hosts, coordinator, port, args.user_script,
+                                   args.user_args, env_passthrough=_ENV_PASSTHROUGH)
+        out = []
+        for host, argv_h, env in cmds:
+            if len(hosts) == 1 and host in ("localhost", "127.0.0.1"):
+                out.append((argv_h, {**os.environ, **env}))
+            else:
+                out.append((_ssh_wrap(host, argv_h, env, args.ssh_port, tty=True),
+                            dict(os.environ)))
+        return out
+
+    agent = DSElasticAgent(build, max_restarts=args.max_elastic_restarts)
+    return agent.run()
+
+
+def main(argv=None):
+    args = parse_args(argv)
+
+    if args.elastic:
+        sys.exit(run_elastic(args))
+
+    if not os.path.isfile(args.hostfile):
+        logger.info(f"no hostfile at {args.hostfile}; launching on localhost only")
+    hosts = _resolve_hosts(args)
 
     coordinator = args.master_addr or hosts[0]
 
@@ -168,8 +214,7 @@ def main(argv=None):
         return  # unreachable
 
     cmds = build_host_commands(hosts, coordinator, args.master_port, args.user_script,
-                               args.user_args,
-                               env_passthrough=("PYTHONPATH", "JAX_PLATFORMS", "DSTPU_LOG_LEVEL"))
+                               args.user_args, env_passthrough=_ENV_PASSTHROUGH)
     procs = []
     for host, argv_h, env in cmds:
         full = _ssh_wrap(host, argv_h, env, args.ssh_port)

@@ -1,2 +1,4 @@
 from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
-from .data_sampler import DeepSpeedDataSampler  # noqa: F401
+from .data_sampler import DeepSpeedDataSampler, DifficultyDataSampler  # noqa: F401
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,  # noqa: F401
+                              close_mmap_dataset_builder, create_mmap_dataset_builder)

@@ -4,9 +4,11 @@ Counterpart of reference ``runtime/data_pipeline/data_analyzer.py``
 (``DataAnalyzer``: map workers compute per-sample metric values, reduce
 builds sorted index files the curriculum ``DeepSpeedDataSampler`` consumes).
 The torch-distributed map/reduce collapses to process-parallel chunks on one
-host (TPU hosts are fat; dataset metrics are CPU work), and the output is
-one ``.npy`` value file + one difficulty-sorted index file per metric —
-exactly what ``data_sampler.DeepSpeedDataSampler(difficulties=...)`` takes.
+host (TPU hosts are fat; dataset metrics are CPU work). Outputs per metric:
+``.npy`` value/sort sidecars (consumed by the light-weight
+``DifficultyDataSampler``) plus the ``<metric>_index_to_sample`` /
+``<metric>_index_to_metric`` mmap datasets the curriculum
+``DeepSpeedDataSampler`` clusters over.
 """
 
 import os
@@ -46,7 +48,13 @@ class DataAnalyzer:
         return out
 
     def run_reduce(self, map_results):
-        """Merge worker chunks, write sidecar files, return full value arrays."""
+        """Merge worker chunks, write sidecar + mmap index files, return full
+        value arrays. The mmap outputs are exactly what the curriculum
+        ``DeepSpeedDataSampler`` consumes (reference ``data_analyzer.py:357``):
+        ``<metric>_index_to_sample`` — one row of sample ids per unique metric
+        value, ascending — and ``<metric>_index_to_metric`` — the values."""
+        from .indexed_dataset import (close_mmap_dataset_builder,
+                                      create_mmap_dataset_builder, find_fit_int_dtype)
         merged = {}
         for name in self.metric_fns:
             idx = np.concatenate([r[name][0] for r in map_results])
@@ -57,11 +65,27 @@ class DataAnalyzer:
             if self.save_path:
                 os.makedirs(self.save_path, exist_ok=True)
                 np.save(os.path.join(self.save_path, f"{name}_values.npy"), values)
-                # difficulty-ascending sample order (reference index_to_sample)
                 np.save(os.path.join(self.save_path, f"{name}_index_to_sample.npy"),
                         np.argsort(values, kind="stable"))
-                logger.info(f"DataAnalyzer: wrote {name} index for {len(values)} samples "
-                            f"under {self.save_path}")
+                sample_dtype = find_fit_int_dtype(0, len(values))
+                s_path = os.path.join(self.save_path, f"{name}_index_to_sample")
+                m_path = os.path.join(self.save_path, f"{name}_index_to_metric")
+                sb = create_mmap_dataset_builder(s_path, sample_dtype)
+                mb = create_mmap_dataset_builder(m_path, np.int64 if
+                                                 np.issubdtype(values.dtype, np.integer)
+                                                 else np.float64)
+                # one argsort + boundary split: O(N log N) regardless of how
+                # many unique values a (possibly continuous) metric has
+                order = np.argsort(values, kind="stable")
+                sorted_vals = values[order]
+                uniq, starts = np.unique(sorted_vals, return_index=True)
+                for v, group in zip(uniq, np.split(order, starts[1:])):
+                    sb.add_item(group.astype(sample_dtype))
+                    mb.add_item(np.asarray([v]))
+                close_mmap_dataset_builder(sb, s_path)
+                close_mmap_dataset_builder(mb, m_path)
+                logger.info(f"DataAnalyzer: wrote {name} value + mmap index files for "
+                            f"{len(values)} samples under {self.save_path}")
         return merged
 
     def run_map_reduce(self, dataset):

@@ -1,21 +1,284 @@
-"""Curriculum-aware data sampler.
+"""Curriculum data samplers.
 
-Analogue of reference ``runtime/data_pipeline/data_sampler.py:36``
-(``DeepSpeedDataSampler``): draws sample indices whose difficulty is within
-the current curriculum threshold. The reference reads difficulties from an
-offline data-analyzer index; here they are supplied directly (a sequence
-aligned with the dataset) or computed by a callable per sample — the
-analyzer's mmap machinery collapses to a numpy argsort on TPU hosts.
+Counterpart of reference ``data_pipeline/data_sampling/data_sampler.py:36``
+(``DeepSpeedDataSampler``): difficulty-clustered sampling over an on-disk
+``MMapIndexedDataset`` index built by the data analyzer. Per global batch
+the per-metric curriculum schedules advance; when any difficulty moves, the
+newly-admitted samples form a new shuffled cluster (persisted as an
+mmap dataset under ``data_cluster_path``); batches draw from all live
+clusters weighted by size, reshuffling a cluster when its cursor wraps.
+Single-controller translation: the rank-0 + broadcast choreography of the
+reference collapses — one process computes the batch and every consumer
+slices its ``data_parallel_rank`` share.
 
-Usable as ``DeepSpeedDataLoader(..., data_sampler=...)`` — iterating yields
-an epoch's worth of indices filtered/clipped by difficulty; call
-``set_custom_map`` / ``state_dict`` / ``load_state_dict`` for parity.
+``DifficultyDataSampler`` is the light-weight variant (difficulty array in
+memory, one threshold) for quick curriculum setups without an on-disk index.
 """
+
+import os
 
 import numpy as np
 
+from ...utils.logging import logger
+from .curriculum_scheduler import CurriculumScheduler
+from .indexed_dataset import (MMapIndexedDataset, close_mmap_dataset_builder,
+                              create_mmap_dataset_builder, find_fit_int_dtype)
+
+# config keys (reference data_pipeline/constants.py)
+DATA_SAMPLING = "data_sampling"
+CURRICULUM_LEARNING = "curriculum_learning"
+CURRICULUM_METRICS = "curriculum_metrics"
+VALUE_BASED = "value"
+PERCENTILE_BASED = "percentile"
+SINGLE_CLUSTER = "single_cluster"
+CLUSTER_PREFIX = "cluster"
+
 
 class DeepSpeedDataSampler:
+    """Reference-parity curriculum sampler over analyzer-built indexes.
+
+    ``data_efficiency_config``: the ``data_efficiency`` config section, keys
+    as in the reference (``data_sampling.curriculum_learning.
+    curriculum_metrics.<metric>``: ``index_to_sample_path``,
+    ``index_to_metric_path``, ``difficulty_type`` value|percentile,
+    ``clustering_type``, schedule fields). Iterating yields this
+    data-parallel rank's micro-batch index lists.
+    """
+
+    def __init__(self, data_efficiency_config, one_epoch_total_samples, micro_batch_size,
+                 data_parallel_rank=0, data_parallel_size=1, data_parallel_group=None,
+                 gradient_accumulation_steps=1, global_rank=0, drop_last=True):
+        self.config = data_efficiency_config
+        self.one_epoch_total_samples = int(one_epoch_total_samples)
+        self.index_dtype = find_fit_int_dtype(0, one_epoch_total_samples)
+        sampling = dict(self.config.get(DATA_SAMPLING, {}))
+        self.total_samples = self.one_epoch_total_samples * int(sampling.get("num_epochs", 1000))
+        self.micro_batch_size = int(micro_batch_size)
+        self.data_parallel_rank = int(data_parallel_rank)
+        self.micro_batch_times_data_parallel_size = self.micro_batch_size * int(data_parallel_size)
+        self.global_batch_size = (self.micro_batch_times_data_parallel_size
+                                  * int(gradient_accumulation_steps))
+        self.drop_last = drop_last
+        self.np_rng = np.random.default_rng(int(self.config.get("seed", 1234)))
+        self.batch = []
+        self.consumed_samples = 0
+
+        cl = dict(sampling.get(CURRICULUM_LEARNING, {}))
+        self.curriculum_enabled = bool(cl.get("enabled", False))
+        self.curriculum_step = 0
+        self.current_difficulties = {}
+        self.curriculum_schedulers = {}
+        self.difficulty_type = {}
+        self.clustering_type = {}
+        self.index_to_sample = {}
+        self.index_to_metric = {}
+        self.data_clusters = []  # list[(name, MMapIndexedDataset)]
+        self.data_cluster_sizes = []
+        self.data_cluster_paths = []
+        self.data_cluster_current_position = []
+        self.data_1epoch_size = None
+        if self.curriculum_enabled:
+            self.cluster_path = cl["data_cluster_path"]
+            os.makedirs(self.cluster_path, exist_ok=True)
+            for metric, mcfg in dict(cl.get(CURRICULUM_METRICS, {})).items():
+                mcfg = dict(mcfg)
+                self.curriculum_schedulers[metric] = CurriculumScheduler(mcfg)
+                self.difficulty_type[metric] = mcfg.get("difficulty_type", VALUE_BASED)
+                self.clustering_type[metric] = mcfg.get("clustering_type", SINGLE_CLUSTER)
+                if self.clustering_type[metric] != SINGLE_CLUSTER:
+                    self.index_to_sample[metric] = MMapIndexedDataset(mcfg["index_to_sample_path"])
+                    if self.difficulty_type[metric] == VALUE_BASED:
+                        self.index_to_metric[metric] = MMapIndexedDataset(mcfg["index_to_metric_path"])
+
+        assert self.total_samples > 0 and self.micro_batch_size > 0
+        assert self.data_parallel_rank < int(data_parallel_size)
+
+    def __len__(self):
+        return self.total_samples
+
+    def set_custom_curriculum_learning_schedule(self, schedule_func_dict):
+        for metric, fn in schedule_func_dict.items():
+            if metric in self.curriculum_schedulers:
+                self.curriculum_schedulers[metric].set_custom_get_difficulty(fn)
+
+    # -- cluster construction ---------------------------------------------
+    def _samples_by_value(self, metric, value_start, value_end):
+        rows = []
+        for row in range(len(self.index_to_sample[metric])):
+            v = self.index_to_metric[metric][row]
+            if value_start < v <= value_end:
+                rows.append(np.array(self.index_to_sample[metric][row]))
+        return np.concatenate(rows) if rows else None
+
+    def _samples_by_percentile(self, metric, pct_start, pct_end):
+        idx = self.index_to_sample[metric]
+        if self.data_1epoch_size is None:
+            self.data_1epoch_size = sum(len(idx[r]) for r in range(len(idx)))
+        max_pct = self.curriculum_schedulers[metric].max_difficulty
+        per_pct = self.data_1epoch_size // max_pct
+        start_count = per_pct * pct_start
+        end_count = self.data_1epoch_size if pct_end == max_pct else per_pct * pct_end
+        rows, count = [], 0
+        for r in range(len(idx)):
+            row = idx[r]
+            if count + len(row) > start_count:
+                lo = max(0, start_count - count)
+                hi = len(row) if count + len(row) <= end_count else end_count - count
+                rows.append(np.array(row[lo:hi]))
+            count += len(row)
+            if count >= end_count:
+                break
+        return np.concatenate(rows) if rows else None
+
+    def _admitted(self, metric, prev, cur):
+        if self.difficulty_type[metric] == VALUE_BASED:
+            return self._samples_by_value(metric, prev, cur)
+        return self._samples_by_percentile(metric, prev, cur)
+
+    def get_new_cluster(self, previous_difficulties):
+        name = CLUSTER_PREFIX + "".join(f"_{m}{self.current_difficulties[m]}"
+                                        for m in self.curriculum_schedulers)
+        path = os.path.join(self.cluster_path, name)
+        multi = sum(1 for m in self.clustering_type
+                    if self.clustering_type[m] != SINGLE_CLUSTER) > 1
+        if multi:
+            # intersection of every metric's admitted set, minus what earlier
+            # clusters already cover (reference multi-metric branch). A metric
+            # admitting nothing means an EMPTY intersection — dropping its
+            # constraint would train on samples that violate it.
+            new = None
+            for m in self.curriculum_schedulers:
+                if self.clustering_type[m] == SINGLE_CLUSTER:
+                    sel = np.arange(self.one_epoch_total_samples, dtype=self.index_dtype)
+                else:
+                    lo = (float("-inf") if self.difficulty_type[m] == VALUE_BASED else 0)
+                    sel = self._admitted(m, lo, self.current_difficulties[m])
+                    if sel is None:
+                        sel = np.empty(0, self.index_dtype)
+                new = sel if new is None else np.intersect1d(new, sel, assume_unique=True)
+            for _, cluster in self.data_clusters:
+                new = np.setdiff1d(new, cluster[0], assume_unique=True)
+        else:
+            new = np.arange(self.one_epoch_total_samples, dtype=self.index_dtype) \
+                if not self.data_clusters else None
+            for m in self.curriculum_schedulers:
+                if self.clustering_type[m] != SINGLE_CLUSTER:
+                    new = self._admitted(m, previous_difficulties[m], self.current_difficulties[m])
+        if new is not None and len(new):
+            new = np.asarray(new, self.index_dtype)
+            self.np_rng.shuffle(new)
+            builder = create_mmap_dataset_builder(path, self.index_dtype)
+            builder.add_item(new)
+            close_mmap_dataset_builder(builder, path)
+            ds = MMapIndexedDataset(path)
+            self.data_clusters.append((name, ds))
+            self.data_cluster_sizes.append(len(ds[0]))
+            self.data_cluster_paths.append(name)
+            self.data_cluster_current_position.append(0)
+            logger.info(f"data sampler: new cluster {name} with {len(new)} samples")
+
+    def _reshuffle_cluster(self, cidx):
+        name = self.data_cluster_paths[cidx]
+        path = os.path.join(self.cluster_path, name)
+        data = np.copy(self.data_clusters[cidx][1][0])
+        self.np_rng.shuffle(data)
+        builder = create_mmap_dataset_builder(path, self.index_dtype)
+        builder.add_item(data)
+        close_mmap_dataset_builder(builder, path)
+        self.data_clusters[cidx] = (name, MMapIndexedDataset(path))
+
+    def _sample_from_clusters(self):
+        weights = np.asarray(self.data_cluster_sizes, np.float64)
+        weights = weights / weights.sum()
+        picks = self.np_rng.choice(len(self.data_clusters), self.global_batch_size,
+                                   replace=True, p=weights)
+        return np.bincount(picks, minlength=len(self.data_clusters))
+
+    def _take_from_cluster(self, cidx, n):
+        pos = self.data_cluster_current_position[cidx]
+        data = self.data_clusters[cidx][1][0]
+        out = list(np.copy(data[pos:pos + n]))
+        self.data_cluster_current_position[cidx] = pos + n
+        if len(out) < n:
+            remaining = n - len(out)
+            self._reshuffle_cluster(cidx)
+            out += list(np.copy(self.data_clusters[cidx][1][0][:remaining]))
+            self.data_cluster_current_position[cidx] = remaining
+        return out
+
+    # -- batch generation ---------------------------------------------------
+    def get_next_global_batch(self):
+        if self.curriculum_enabled:
+            self.curriculum_step += 1
+            new_cluster = False
+            previous = {}
+            for m, sched in self.curriculum_schedulers.items():
+                nxt = sched.update_difficulty(self.curriculum_step)
+                if m not in self.current_difficulties or nxt != self.current_difficulties[m]:
+                    new_cluster = True
+                previous[m] = self.current_difficulties.get(
+                    m, float("-inf") if self.difficulty_type[m] == VALUE_BASED else 0)
+                self.current_difficulties[m] = nxt
+            if new_cluster:
+                self.get_new_cluster(previous)
+            if not self.data_clusters:
+                raise ValueError(
+                    f"curriculum schedule admits no samples at difficulties "
+                    f"{self.current_difficulties} (step {self.curriculum_step}); lower "
+                    f"min_difficulty or check the metric index covers this range")
+            batch = []
+            for cidx, n in enumerate(self._sample_from_clusters()):
+                batch += self._take_from_cluster(cidx, int(n))
+            self.np_rng.shuffle(batch)
+        else:
+            batch = list(self.np_rng.integers(0, self.one_epoch_total_samples,
+                                              self.global_batch_size))
+        self.batch = [int(b) for b in batch]
+
+    def __iter__(self):
+        while self.consumed_samples <= self.total_samples:
+            if not self.batch:
+                self.get_next_global_batch()
+            current = self.batch[:self.micro_batch_times_data_parallel_size]
+            self.batch = self.batch[self.micro_batch_times_data_parallel_size:]
+            if len(current) == self.micro_batch_times_data_parallel_size or \
+                    (current and not self.drop_last):
+                start = self.data_parallel_rank * self.micro_batch_size
+                yield current[start:start + self.micro_batch_size]
+                self.consumed_samples += len(current)
+
+    # -- checkpoint ---------------------------------------------------------
+    def state_dict(self):
+        return {
+            "batch": list(self.batch),
+            "consumed_samples": self.consumed_samples,
+            "curriculum_step": self.curriculum_step,
+            "current_difficulties": dict(self.current_difficulties),
+            "data_cluster_paths": list(self.data_cluster_paths),
+            "data_cluster_current_position": list(self.data_cluster_current_position),
+            "np_rng_state": self.np_rng.bit_generator.state,
+        }
+
+    def load_state_dict(self, sd):
+        self.batch = list(sd["batch"])
+        self.consumed_samples = sd["consumed_samples"]
+        self.curriculum_step = sd["curriculum_step"]
+        self.current_difficulties = dict(sd["current_difficulties"])
+        self.data_cluster_paths = [os.path.basename(p) for p in sd["data_cluster_paths"]]
+        self.data_cluster_current_position = list(sd["data_cluster_current_position"])
+        self.np_rng.bit_generator.state = sd["np_rng_state"]
+        if self.curriculum_enabled:
+            self.data_clusters, self.data_cluster_sizes = [], []
+            for name in self.data_cluster_paths:
+                ds = MMapIndexedDataset(os.path.join(self.cluster_path, name))
+                self.data_clusters.append((name, ds))
+                self.data_cluster_sizes.append(len(ds[0]))
+
+
+class DifficultyDataSampler:
+    """Light-weight curriculum sampler: in-memory difficulty array + one
+    threshold schedule (no on-disk index). Kept from the round-2 surface for
+    quick setups; the reference-parity machinery is ``DeepSpeedDataSampler``."""
 
     def __init__(self, difficulties, curriculum_scheduler=None, total_samples=None, seed=0,
                  shuffle=True, drop_last=True):

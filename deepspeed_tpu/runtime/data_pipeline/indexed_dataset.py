@@ -1,0 +1,173 @@
+"""Memory-mapped indexed dataset (Megatron ``.bin``/``.idx`` format).
+
+Counterpart of reference ``data_pipeline/data_sampling/indexed_dataset.py``
+(``MMapIndexedDataset`` :369, ``MMapIndexedDatasetBuilder`` :575): random
+access into a flat binary corpus through an mmap'd index, the on-disk format
+Megatron-LM preprocessing emits — so existing preprocessed corpora serve
+this framework's curriculum/data-efficiency pipeline unchanged. Pure numpy
+(no torch): items are numpy array views straight off the mmap.
+
+On-disk layout (little endian):
+  <path>.bin   concatenated item payloads
+  <path>.idx   magic 'MMIDIDX\\x00\\x00' | u64 version=1 | u8 dtype code |
+               u64 n_items | u64 n_docs | i32 sizes[n_items] |
+               i64 pointers[n_items] | i64 doc_idx[n_docs]
+"""
+
+import os
+import struct
+
+import numpy as np
+
+_HDR_MAGIC = b"MMIDIDX\x00\x00"
+
+dtypes = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+    6: np.float64, 7: np.double, 8: np.uint16, 9: np.uint32, 10: np.uint64,
+}
+_CODES = {np.dtype(v): k for k, v in dtypes.items()}
+
+
+def code(dtype):
+    return _CODES[np.dtype(dtype)]
+
+
+def data_file_path(prefix):
+    return prefix + ".bin"
+
+
+def index_file_path(prefix):
+    return prefix + ".idx"
+
+
+def find_fit_int_dtype(low, high):
+    """Smallest integer dtype covering [low, high] (reference utils)."""
+    for dt in (np.uint8, np.int8, np.uint16, np.int16, np.uint32, np.int32,
+               np.uint64, np.int64):
+        info = np.iinfo(dt)
+        if info.min <= low and high <= info.max:
+            return dt
+    return np.int64
+
+
+class MMapIndexedDataset:
+    """Read side: ``ds[i]`` -> 1-D numpy view of item i; slices return lists.
+
+    ``skip_warmup`` accepted for reference parity (the page-cache warmup read
+    is pointless under numpy memmap on modern kernels — always skipped).
+    """
+
+    def __init__(self, path, skip_warmup=True):
+        self._path = path
+        with open(index_file_path(path), "rb") as f:
+            magic = f.read(len(_HDR_MAGIC))
+            if magic != _HDR_MAGIC:
+                raise ValueError(f"{index_file_path(path)}: not an MMIDIDX index "
+                                 f"(bad magic {magic!r})")
+            version, = struct.unpack("<Q", f.read(8))
+            if version != 1:
+                raise ValueError(f"unsupported index version {version}")
+            dtype_code, = struct.unpack("<B", f.read(1))
+            self._dtype = dtypes[dtype_code]
+            self._len, = struct.unpack("<Q", f.read(8))
+            self._doc_count, = struct.unpack("<Q", f.read(8))
+            offset = f.tell()
+        idx_buf = np.memmap(index_file_path(path), mode="r", order="C")
+        self._sizes = np.frombuffer(idx_buf, np.int32, count=self._len, offset=offset)
+        self._pointers = np.frombuffer(idx_buf, np.int64, count=self._len,
+                                       offset=offset + self._sizes.nbytes)
+        self._doc_idx = np.frombuffer(idx_buf, np.int64, count=self._doc_count,
+                                      offset=offset + self._sizes.nbytes + self._pointers.nbytes)
+        self._bin = np.memmap(data_file_path(path), mode="r", order="C")
+
+    def __len__(self):
+        return self._len
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(self._len))]
+        if idx < 0:
+            idx += self._len
+        ptr, size = int(self._pointers[idx]), int(self._sizes[idx])
+        return np.frombuffer(self._bin, self._dtype, count=size, offset=ptr)
+
+    def get(self, idx, offset=0, length=None):
+        """Partial item read (reference ``get``)."""
+        ptr, size = int(self._pointers[idx]), int(self._sizes[idx])
+        if length is None:
+            length = size - offset
+        ptr += offset * np.dtype(self._dtype).itemsize
+        return np.frombuffer(self._bin, self._dtype, count=length, offset=ptr)
+
+    @property
+    def sizes(self):
+        return self._sizes
+
+    @property
+    def doc_idx(self):
+        return self._doc_idx
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @staticmethod
+    def exists(path):
+        return os.path.exists(index_file_path(path)) and os.path.exists(data_file_path(path))
+
+
+class MMapIndexedDatasetBuilder:
+    """Write side (reference :575): stream items into ``.bin``, then
+    ``finalize`` writes the index."""
+
+    def __init__(self, out_file, dtype=np.int64):
+        self._file = open(out_file, "wb")
+        self._dtype = np.dtype(dtype)
+        self._sizes = []
+        self._doc_idx = [0]
+
+    def add_item(self, array):
+        arr = np.ascontiguousarray(np.asarray(array).reshape(-1), self._dtype)
+        self._file.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    add_item_numpy = add_item
+
+    def end_document(self):
+        self._doc_idx.append(len(self._sizes))
+
+    def merge_file_(self, another_file):
+        """Append another dataset with the same dtype (reference parity)."""
+        other = MMapIndexedDataset(another_file)
+        if np.dtype(other.dtype) != self._dtype:
+            raise ValueError(f"dtype mismatch: {other.dtype} vs {self._dtype}")
+        base = len(self._sizes)
+        for i in range(len(other)):
+            self.add_item(other[i])
+        for d in other.doc_idx[1:]:
+            self._doc_idx.append(base + int(d))
+
+    def finalize(self, index_file):
+        self._file.close()
+        sizes = np.asarray(self._sizes, np.int32)
+        pointers = np.zeros(len(sizes), np.int64)
+        if len(sizes):
+            np.cumsum(sizes[:-1].astype(np.int64) * self._dtype.itemsize, out=pointers[1:])
+        with open(index_file, "wb") as f:
+            f.write(_HDR_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", code(self._dtype)))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(sizes.tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, np.int64).tobytes(order="C"))
+
+
+def create_mmap_dataset_builder(path, dtype):
+    return MMapIndexedDatasetBuilder(data_file_path(path), dtype=dtype)
+
+
+def close_mmap_dataset_builder(builder, path):
+    builder.end_document()
+    builder.finalize(index_file_path(path))

@@ -60,3 +60,22 @@ def test_build_host_commands():
         assert env["JAX_NUM_PROCESSES"] == "3"
         assert env["COORDINATOR_ADDRESS"] == "h0:8476"
         assert argv[-3:] == ["train.py", "--foo", "1"]
+
+
+def test_elastic_args_and_builder(tmp_path):
+    """--elastic wires DSElasticAgent with per-attempt host re-resolution and
+    rendezvous port bumps."""
+    from deepspeed_tpu.launcher import runner
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("hostA slots=4\nhostB slots=4\n")
+    args = runner.parse_args(["-H", str(hostfile), "--elastic", "--max_elastic_restarts", "5",
+                              "train.py", "--foo"])
+    assert args.elastic and args.max_elastic_restarts == 5
+    hosts = runner._resolve_hosts(args)
+    assert hosts == ["hostA", "hostB"]
+    cmds = runner.build_host_commands(hosts, "hostA", runner.DEFAULT_COORD_PORT + 1,
+                                      args.user_script, args.user_args)
+    assert len(cmds) == 2
+    host, argv, env = cmds[1]
+    assert env["JAX_PROCESS_ID"] == "1" and env["JAX_NUM_PROCESSES"] == "2"
+    assert env["COORDINATOR_ADDRESS"].endswith(str(runner.DEFAULT_COORD_PORT + 1))

@@ -1,0 +1,195 @@
+"""Indexed dataset + curriculum data sampler (VERDICT r2 item 7).
+
+Mirrors the reference's data-efficiency coverage: MMapIndexedDataset
+round-trips in the Megatron .bin/.idx format, the analyzer builds the
+index_to_sample/index_to_metric files, and DeepSpeedDataSampler reproduces
+the reference's difficulty-clustered sampling semantics over them."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (DeepSpeedDataSampler, MMapIndexedDataset,
+                                                 MMapIndexedDatasetBuilder,
+                                                 close_mmap_dataset_builder,
+                                                 create_mmap_dataset_builder)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import DataAnalyzer
+
+
+def test_mmap_indexed_dataset_roundtrip(tmp_path):
+    path = str(tmp_path / "corpus")
+    builder = MMapIndexedDatasetBuilder(path + ".bin", dtype=np.int32)
+    items = [np.arange(5, dtype=np.int32), np.array([7, 8], np.int32),
+             np.arange(100, 117, dtype=np.int32)]
+    for it in items[:2]:
+        builder.add_item(it)
+    builder.end_document()
+    builder.add_item(items[2])
+    builder.end_document()
+    builder.finalize(path + ".idx")
+
+    ds = MMapIndexedDataset(path)
+    assert len(ds) == 3
+    for got, want in zip(ds[:], items):
+        np.testing.assert_array_equal(got, want)
+    assert ds.dtype == np.int32
+    np.testing.assert_array_equal(ds.sizes, [5, 2, 17])
+    np.testing.assert_array_equal(ds.doc_idx, [0, 2, 3])
+    # partial read
+    np.testing.assert_array_equal(ds.get(2, offset=3, length=4), items[2][3:7])
+
+
+def test_mmap_index_header_is_megatron_format(tmp_path):
+    """Byte-level format check: Megatron-preprocessed corpora must open."""
+    path = str(tmp_path / "c")
+    b = create_mmap_dataset_builder(path, np.uint16)
+    b.add_item(np.array([1, 2, 3], np.uint16))
+    close_mmap_dataset_builder(b, path)
+    raw = open(path + ".idx", "rb").read()
+    assert raw[:9] == b"MMIDIDX\x00\x00"
+    import struct
+    assert struct.unpack("<Q", raw[9:17])[0] == 1  # version
+    assert raw[17] == 8  # dtype code for uint16
+    assert struct.unpack("<Q", raw[18:26])[0] == 1  # one item
+
+
+def test_builder_merge(tmp_path):
+    a, bpath = str(tmp_path / "a"), str(tmp_path / "b")
+    for p, vals in ((a, [1, 2]), (bpath, [3, 4, 5])):
+        b = create_mmap_dataset_builder(p, np.int64)
+        b.add_item(np.asarray(vals, np.int64))
+        close_mmap_dataset_builder(b, p)
+    m = str(tmp_path / "m")
+    b = create_mmap_dataset_builder(m, np.int64)
+    b.merge_file_(a)
+    b.merge_file_(bpath)
+    close_mmap_dataset_builder(b, m)
+    ds = MMapIndexedDataset(m)
+    assert len(ds) == 2
+    np.testing.assert_array_equal(ds[1], [3, 4, 5])
+
+
+def _build_index(tmp_path, lengths):
+    """Analyzer over a toy dataset whose difficulty = sequence length."""
+    dataset = [list(range(n)) for n in lengths]
+    an = DataAnalyzer({"seqlen": lambda s: len(s)}, save_path=str(tmp_path), num_workers=2)
+    an.run_map_reduce(dataset)
+    return dataset
+
+
+def test_analyzer_emits_mmap_index(tmp_path):
+    lengths = [3, 1, 4, 1, 5, 9, 2, 6]
+    _build_index(tmp_path, lengths)
+    idx = MMapIndexedDataset(str(tmp_path / "seqlen_index_to_sample"))
+    metric = MMapIndexedDataset(str(tmp_path / "seqlen_index_to_metric"))
+    # rows ascend in metric value; union of rows covers every sample once
+    vals = [int(metric[r][0]) for r in range(len(metric))]
+    assert vals == sorted(set(lengths))
+    all_samples = np.concatenate([idx[r] for r in range(len(idx))])
+    assert sorted(all_samples.tolist()) == list(range(len(lengths)))
+    # samples in each row really have that difficulty
+    for r, v in enumerate(vals):
+        for s in idx[r]:
+            assert lengths[int(s)] == v
+
+
+def _sampler_config(tmp_path, max_difficulty, total_step=4):
+    return {
+        "seed": 1234,
+        "data_sampling": {
+            "enabled": True,
+            "num_epochs": 100,
+            "curriculum_learning": {
+                "enabled": True,
+                "data_cluster_path": str(tmp_path / "clusters"),
+                "curriculum_metrics": {
+                    "seqlen": {
+                        "index_to_sample_path": str(tmp_path / "seqlen_index_to_sample"),
+                        "index_to_metric_path": str(tmp_path / "seqlen_index_to_metric"),
+                        "difficulty_type": "value",
+                        "clustering_type": "schedule_based",
+                        "min_difficulty": 2,
+                        "max_difficulty": max_difficulty,
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": total_step,
+                                            "difficulty_step": 1},
+                    },
+                },
+            },
+        },
+    }
+
+
+def test_curriculum_sampler_admits_by_difficulty(tmp_path):
+    """Reference sampling semantics over an on-disk index: early batches only
+    contain easy samples; the pool grows with the schedule; every admitted
+    sample has difficulty <= the current threshold."""
+    lengths = [3, 1, 4, 1, 5, 9, 2, 6, 2, 3, 7, 8]
+    _build_index(tmp_path, lengths)
+    sampler = DeepSpeedDataSampler(_sampler_config(tmp_path, max_difficulty=9),
+                                   one_epoch_total_samples=len(lengths),
+                                   micro_batch_size=2, data_parallel_rank=0,
+                                   data_parallel_size=1, gradient_accumulation_steps=1)
+    it = iter(sampler)
+    seen_per_step = []
+    for step in range(24):
+        micro = next(it)
+        assert len(micro) == 2
+        threshold = sampler.current_difficulties["seqlen"]
+        for s in micro:
+            assert lengths[s] <= threshold, (step, s, lengths[s], threshold)
+        seen_per_step.append(set(lengths[s] for s in micro))
+    # the schedule reached max difficulty: hard samples eventually appear
+    assert sampler.current_difficulties["seqlen"] == 9
+    assert any(9 in seen for seen in seen_per_step[8:])
+    # clusters were persisted as mmap datasets
+    import os
+    assert any(f.endswith(".idx") for f in os.listdir(tmp_path / "clusters"))
+
+
+def test_curriculum_sampler_dp_slicing(tmp_path):
+    """DP ranks slice disjoint shares of the same global batch."""
+    lengths = [3, 1, 4, 1, 5, 9, 2, 6]
+    _build_index(tmp_path, lengths)
+    micros = {}
+    for rank in range(2):
+        s = DeepSpeedDataSampler(_sampler_config(tmp_path, max_difficulty=9),
+                                 one_epoch_total_samples=len(lengths),
+                                 micro_batch_size=2, data_parallel_rank=rank,
+                                 data_parallel_size=2, gradient_accumulation_steps=1)
+        micros[rank] = [next(iter(s)) for _ in range(1)][0]
+    assert len(micros[0]) == 2 and len(micros[1]) == 2
+    # same rng seed -> same global batch; ranks take disjoint slices
+    assert micros[0] != micros[1]
+
+
+def test_curriculum_sampler_state_roundtrip(tmp_path):
+    """Resume determinism: run A straight through; run B to the snapshot
+    point in its own cluster dir, then resume C from B's snapshot — C must
+    reproduce A's continuation exactly (the rng state, cluster files and
+    cursors all round-trip)."""
+    lengths = [3, 1, 4, 1, 5, 9, 2, 6]
+    _build_index(tmp_path, lengths)
+
+    def make(cluster_dir):
+        cfg = _sampler_config(tmp_path, max_difficulty=9)
+        cfg["data_sampling"]["curriculum_learning"]["data_cluster_path"] = str(cluster_dir)
+        return DeepSpeedDataSampler(cfg, one_epoch_total_samples=len(lengths),
+                                    micro_batch_size=2, data_parallel_rank=0,
+                                    data_parallel_size=1, gradient_accumulation_steps=1)
+
+    a = make(tmp_path / "clusters_a")
+    it_a = iter(a)
+    full = [next(it_a) for _ in range(9)]
+
+    b = make(tmp_path / "clusters_b")
+    it_b = iter(b)
+    for _ in range(5):
+        next(it_b)
+    sd = b.state_dict()
+    del b, it_b  # simulated shutdown at the checkpoint
+
+    c = make(tmp_path / "clusters_b")
+    c.load_state_dict(sd)
+    it_c = iter(c)
+    cont = [next(it_c) for _ in range(4)]
+    assert cont == full[5:9]

@@ -107,6 +107,6 @@ def test_data_analyzer_map_reduce(tmp_path):
     np.testing.assert_array_equal(idx, np.arange(10))  # already difficulty-sorted
 
     # analyzer output feeds the curriculum sampler directly
-    from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
-    sampler = DeepSpeedDataSampler(loaded)
+    from deepspeed_tpu.runtime.data_pipeline.data_sampler import DifficultyDataSampler
+    sampler = DifficultyDataSampler(loaded)
     assert len(list(iter(sampler))) == 10

@@ -94,13 +94,13 @@ def test_curriculum_seqlen_in_engine():
 
 
 def test_curriculum_data_sampler():
-    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler, DeepSpeedDataSampler
+    from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler, DifficultyDataSampler
     sched = CurriculumScheduler({"min_difficulty": 10, "max_difficulty": 100,
                                  "schedule_type": "fixed_linear",
                                  "schedule_config": {"total_curriculum_step": 10,
                                                      "difficulty_step": 10}})
     difficulties = np.arange(100)  # sample i has difficulty i
-    sampler = DeepSpeedDataSampler(difficulties, curriculum_scheduler=sched)
+    sampler = DifficultyDataSampler(difficulties, curriculum_scheduler=sched)
     sampler.advance(0)
     early = list(iter(sampler))
     assert max(difficulties[early]) <= 10
