@@ -1,2 +1,3 @@
-from .compress import init_compression, redundancy_clean  # noqa: F401
+from .compress import (init_compression, init_layer_reduction, kd_loss,  # noqa: F401
+                       redundancy_clean)
 from .helper import fake_quantize, magnitude_mask  # noqa: F401

@@ -10,12 +10,18 @@ the loss (QAT fake-quant with straight-through gradients, magnitude masks
 for pruning) and ``redundancy_clean`` bakes the same transform into the
 stored parameters permanently.
 
-Supported groups (same JSON keys): ``weight_quantization``
-(target_bits/quantize_groups/quantization_type per different_group),
-``sparse_pruning``, ``row_pruning`` (structured along the output dim),
-``head_pruning`` (structured along the heads dim of attention projections).
+Supported groups (same JSON keys): ``weight_quantization`` (static
+target_bits, or the MoQ anneal ``start_bits``→``target_bits`` dropping one
+bit per ``quantize_period`` steps with the period doubling each drop —
+scaled by the engine's Hessian-eigenvalue factor when the ``eigenvalue``
+section is enabled, reference ``runtime/quantize.py`` ``compute_quantization``),
+``activation_quantization`` (applied inside the model's blocks via the
+``set_activation_quantization`` hook), ``sparse_pruning``, ``row_pruning``
+(structured along the output dim), ``channel_pruning`` (input dim),
+``head_pruning`` (heads dim of attention projections). ``layer_reduction``
+is the functional ``init_layer_reduction``/``kd_loss`` pair (distillation).
 ``schedule_offset`` activates each transform only after that global step —
-the wrapped model re-jits once when a transform flips on.
+the wrapped model re-jits when its compression signature changes.
 """
 
 import re
@@ -58,21 +64,41 @@ class _Transform:
         self.patterns = patterns
         self.params = params
         self.schedule_offset = schedule_offset
+        # MoQ anneal state (weight_quantization only; reference
+        # runtime/quantize.py compute_quantization: -1 bit per period, the
+        # period doubling each drop, scaled by the eigenvalue factor)
+        self.target_bits = int(params.get("target_bits", 8))
+        self.current_bits = int(params.get("start_bits", self.target_bits))
+        self.quantize_period = int(params.get("quantize_period", 0))
+        self._next_boundary = schedule_offset + self.quantize_period
+
+    def advance(self, step, eigenvalue_factor=1):
+        """Advance the MoQ bit schedule to ``step``."""
+        if self.kind != "weight_quantization" or self.quantize_period <= 0:
+            return
+        while self.current_bits > self.target_bits and step >= self._next_boundary:
+            self.current_bits -= 1
+            self.quantize_period = self.quantize_period * 2 * max(1, int(eigenvalue_factor))
+            self._next_boundary += self.quantize_period
+
+    def signature(self):
+        return (self.kind, self.current_bits)
 
     def applies(self, path):
         return _path_matches(path, self.patterns)
 
     def apply(self, path, w):
         if self.kind == "weight_quantization":
-            bits = int(self.params.get("target_bits", 8))
             groups = int(self.params.get("quantize_groups", 1))
             sym = self.params.get("quantization_type", "symmetric") == "symmetric"
-            return fake_quantize(w, bits=bits, groups=groups, symmetric=sym)
+            return fake_quantize(w, bits=self.current_bits, groups=groups, symmetric=sym)
         ratio = float(self.params.get("dense_ratio", 0.5))
         if self.kind == "sparse_pruning":
             mask = magnitude_mask(w, ratio)
         elif self.kind == "row_pruning":
             mask = magnitude_mask(w, ratio, dim=w.ndim - 1)  # output dim
+        elif self.kind == "channel_pruning":
+            mask = magnitude_mask(w, ratio, dim=0)  # input-channel dim
         elif self.kind == "head_pruning":
             # bhtd attention projections: kernel (H, heads, hd) — prune the
             # heads dim; fall back to dim 0 for 2-D params
@@ -84,7 +110,8 @@ class _Transform:
 
 def _build_transforms(sec):
     transforms = []
-    for kind in ("weight_quantization", "sparse_pruning", "row_pruning", "head_pruning"):
+    for kind in ("weight_quantization", "activation_quantization", "sparse_pruning",
+                 "row_pruning", "channel_pruning", "head_pruning"):
         group = dict(sec.get(kind, {}))
         shared = dict(group.get("shared_parameters", {}))
         if not shared.get("enabled", False):
@@ -99,21 +126,58 @@ def _build_transforms(sec):
 
 class CompressedModel:
     """Wraps a deepspeed_tpu model; applies active transforms to matching
-    params inside loss/apply. Exposes the same engine-facing contract."""
+    params inside loss/apply. Exposes the same engine-facing contract.
+    ``eigenvalue_factor`` is set by the engine's Hessian power iteration when
+    the ``eigenvalue`` config section is enabled (MoQ period scaling)."""
 
     def __init__(self, inner, transforms):
         self.inner = inner
         self.transforms = transforms
-        self.global_step = 0  # advanced by the engine-side scheduler
+        self._step = 0  # advanced by the engine-side scheduler
+        self.eigenvalue_factor = 1
+        self._act_quant_on = False
 
     def __getattr__(self, name):  # delegate cfg, tp_rules, init_params, ...
         return getattr(self.inner, name)
 
+    @property
+    def global_step(self):
+        return self._step
+
+    @global_step.setter
+    def global_step(self, step):
+        self._step = step
+        for t in self.transforms:
+            if step >= t.schedule_offset:
+                t.advance(step, self.eigenvalue_factor)
+        self._sync_activation_quantization()
+
+    def _sync_activation_quantization(self):
+        if self.inner is None:  # redundancy_clean shim: params-only
+            return
+        want = next((t for t in self._active() if t.kind == "activation_quantization"), None)
+        if want is not None and not self._act_quant_on:
+            if hasattr(self.inner, "set_activation_quantization"):
+                bits = int(want.params.get("bits", want.params.get("target_bits", 8)))
+                sym = want.params.get("quantization_type", "symmetric") == "symmetric"
+                self.inner.set_activation_quantization(bits, symmetric=sym)
+                self._act_quant_on = True
+            else:
+                logger.warning("activation_quantization enabled but the model exposes no "
+                               "set_activation_quantization hook — section has NO effect")
+                self._act_quant_on = True  # warn once
+
     def _active(self):
-        return [t for t in self.transforms if self.global_step >= t.schedule_offset]
+        return [t for t in self.transforms if self._step >= t.schedule_offset]
+
+    def compression_signature(self):
+        """Changes whenever the compiled compression graph must change
+        (activation set, MoQ bit drops) — the engine retraces on mismatch."""
+        return tuple(t.signature() for t in self._active()) + (self._act_quant_on, )
 
     def compress_params(self, params):
-        active = self._active()
+        # act-quant lives inside the model's blocks, not on the params
+        active = [t for t in self._active() if t.kind != "activation_quantization"]
         if not active:
             return params
         flat, treedef = jax.tree_util.tree_flatten_with_path(params)
@@ -135,10 +199,14 @@ class CompressedModel:
 
 def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
     """Wrap ``model`` with the compression transforms from the
-    ``compression_training`` section (reference :95). ``teacher_model``
-    (layer-reduction distillation) is not supported and must be None."""
+    ``compression_training`` section (reference :95). For layer reduction /
+    distillation, build the student first with ``init_layer_reduction``
+    (functional replacement for the reference's ``student_initialization``)
+    and pass the student here."""
     if teacher_model is not None:
-        raise NotImplementedError("layer_reduction/distillation is not supported yet")
+        raise ValueError("pass the student built by init_layer_reduction(teacher_model, "
+                         "teacher_params, config) instead of a live teacher_model; use "
+                         "kd_loss for the distillation term")
     if hasattr(deepspeed_config, "raw_config"):
         deepspeed_config = deepspeed_config.raw_config
     transforms = _build_transforms(_section(dict(deepspeed_config)))
@@ -147,6 +215,56 @@ def init_compression(model, deepspeed_config, teacher_model=None, mpu=None):
                        "returning the model unchanged")
         return model
     return CompressedModel(model, transforms)
+
+
+def init_layer_reduction(teacher_model, teacher_params, deepspeed_config):
+    """Build a depth-reduced student from a teacher (reference
+    ``compression_training.layer_reduction`` + ``student_initialization``,
+    ``compress.py:123-160``): the student keeps ``keep_number_layer`` layers,
+    initialized from the teacher layers listed in ``teacher_layer`` (plus all
+    non-layer parameters — embeddings, norms, head). Returns
+    ``(student_model, student_params)``; train the student with ``kd_loss``
+    against the teacher's logits for the distillation term."""
+    import dataclasses
+    if hasattr(deepspeed_config, "raw_config"):
+        deepspeed_config = deepspeed_config.raw_config
+    sec = dict(_section(dict(deepspeed_config)).get("layer_reduction", {}))
+    if not sec.get("enabled", False):
+        raise ValueError("layer_reduction section missing or not enabled")
+    keep = int(sec["keep_number_layer"])
+    teacher_layers = [int(i) for i in sec["teacher_layer"]]
+    if len(teacher_layers) != keep:
+        raise ValueError(f"teacher_layer lists {len(teacher_layers)} layers but "
+                         f"keep_number_layer={keep}")
+    cfg = teacher_model.cfg
+    if any(i >= cfg.num_layers for i in teacher_layers):
+        raise ValueError(f"teacher_layer {teacher_layers} out of range for "
+                         f"{cfg.num_layers}-layer teacher")
+    student_model = type(teacher_model)(dataclasses.replace(cfg, num_layers=keep))
+    params = dict(teacher_params)
+    if cfg.scan_layers:
+        stacked = params.pop("layers")
+        idx = np.asarray(teacher_layers)
+        params["layers"] = jax.tree_util.tree_map(lambda x: np.asarray(x)[idx], stacked)
+    else:
+        layers = [params.pop(f"layer_{i}") for i in range(cfg.num_layers)]
+        for s, t in enumerate(teacher_layers):
+            params[f"layer_{s}"] = layers[t]
+    log_dist(f"layer_reduction: {cfg.num_layers}-layer teacher -> {keep}-layer student "
+             f"from teacher layers {teacher_layers}", [0])
+    return student_model, params
+
+
+def kd_loss(student_logits, teacher_logits, temperature=1.0):
+    """Knowledge-distillation term: KL(teacher_T || student_T) * T^2, mean
+    over positions (the standard Hinton objective the reference's
+    distillation examples optimize alongside the task loss)."""
+    import jax.numpy as jnp
+    t = float(temperature)
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    p = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    per_pos = jnp.sum(p * (jnp.log(jnp.maximum(p, 1e-20)) - s), axis=-1)
+    return jnp.mean(per_pos) * t * t
 
 
 def redundancy_clean(model_or_params, deepspeed_config=None):

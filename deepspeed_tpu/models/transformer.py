@@ -50,6 +50,10 @@ class TransformerConfig:
     embed_norm: bool = False  # layernorm right after the embedding (BLOOM)
     lm_head_bias: bool = False  # untied lm_head with bias (GPT-J)
     attn_bias: Optional[bool] = None  # None = follow norm (layernorm -> biased); GPT-J: False
+    # QAT activation quantization (compression.activation_quantization):
+    # fake-quantize each block's input with a straight-through gradient
+    act_quant_bits: Optional[int] = None
+    act_quant_symmetric: bool = True
     layernorm_epsilon: float = 1e-5
     dropout: float = 0.0
     # MoE (0 experts = dense)
@@ -602,6 +606,10 @@ class Block(nn.Module):
                  cache_index=None, position_ids=None):
         cfg = self.cfg
         drop = nn.Dropout(rate=cfg.dropout) if cfg.dropout > 0 else None
+        if cfg.act_quant_bits:  # QAT activation fake-quant (compression)
+            from ..compression.helper import fake_quantize
+            x = fake_quantize(x, bits=cfg.act_quant_bits, groups=1,
+                              symmetric=cfg.act_quant_symmetric)
         h = make_norm(cfg, name="attn_norm")(x)
         h, new_cache = Attention(cfg, name="attn")(h, sin, cos, attn_mask, kv_cache,
                                                    cache_index, position_ids)
@@ -774,6 +782,13 @@ class CausalLMModel:
         """Engine hook for the ``activation_checkpointing`` config section:
         rebuild the module with the given ``jax.checkpoint`` policy name."""
         self.cfg = dataclasses.replace(self.cfg, remat_policy=policy)
+        self.module = CausalLM(self.cfg)
+
+    def set_activation_quantization(self, bits, symmetric=True):
+        """Compression hook (``compression.activation_quantization``):
+        rebuild the module with per-block input fake-quantization."""
+        self.cfg = dataclasses.replace(self.cfg, act_quant_bits=bits,
+                                       act_quant_symmetric=symmetric)
         self.module = CausalLM(self.cfg)
 
     def init_params(self, rng):

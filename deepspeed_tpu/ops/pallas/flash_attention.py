@@ -46,7 +46,8 @@ def _interpret():
     return jax.default_backend() == "cpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal, q_len,
+                kv_len):
     """Grid: (B, H, num_q_blocks). Blocks: q/o (1, 1, bq, D);
     k/v (1, 1, Tkv, D) — the full (padded) KV head in VMEM; lse (1, 1, bq)."""
     block_q = q_ref.shape[2]
@@ -79,7 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # (bq, bkv)
 
-        mask = ik < seq_len - kv_start
+        mask = ik < kv_len - kv_start
         if causal:
             mask = mask & (ikq <= q_start - kv_start)
         s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
@@ -89,7 +90,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
         # sequence is padded (causal rows always see the diagonal): only then
         # pay for the explicit zero that yields l=0 -> zero output, -inf lse
         # (otherwise exp(MASK - m_new) underflows to 0 on its own)
-        if seq_len % block_kv or seq_len % block_q:
+        if kv_len % block_kv or q_len % block_q:
             p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         else:
             p = jnp.exp(s - m_new)
@@ -107,7 +108,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_kv, causal,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, scale, block_kv, causal,
-                   seq_len):
+                   kv_len):
     block_q = q_ref.shape[2]
     d = q_ref.shape[-1]
     qi = pl.program_id(2)
@@ -132,7 +133,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
         v = v_ref[0, 0, pl.ds(kv_start, block_kv), :]
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = ik < seq_len - kv_start
+        mask = ik < kv_len - kv_start
         if causal:
             mask = mask & (ikq <= q_start - kv_start)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
@@ -147,7 +148,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, s
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, scale, block_q,
-                    causal, seq_len):
+                    causal, q_len, kv_len):
     """Grid: (B, H, num_kv_blocks). k/v blocks (1, 1, bkv, D) come from the
     (possibly grouped) KV head for query head h; dk/dv are written per
     *query* head (into (B, H, Tkv, D)) and group-summed by the caller."""
@@ -177,7 +178,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_
 
         s = jax.lax.dot_general(q, k, (((1, ), (1, )), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        mask = (ik < seq_len - kv_start) & (iq < seq_len - q_start)
+        mask = (ik < kv_len - kv_start) & (iq < q_len - q_start)
         if causal:
             mask = mask & (ikq <= q_start - kv_start)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
@@ -217,12 +218,12 @@ def flash_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None)
 
 def _flash_call(q, k, v, causal, block_q, block_kv, scale):
     B, H, T, D = q.shape
-    Hkv = k.shape[1]
+    Hkv, T_kv = k.shape[1], k.shape[2]
     assert H % Hkv == 0, f"query heads {H} not a multiple of kv heads {Hkv}"
     g = H // Hkv
     scale = scale if scale is not None else 1.0 / (D**0.5)
     block_q = min(block_q, T)
-    block_kv = min(block_kv, T)
+    block_kv = min(block_kv, T_kv)
 
     qp = _pad_seq(q, block_q)
     kp = _pad_seq(k, block_kv)
@@ -230,7 +231,8 @@ def _flash_call(q, k, v, causal, block_q, block_kv, scale):
     Tq, Tkv = qp.shape[2], kp.shape[2]
     grid = (B, H, Tq // block_q)
 
-    kernel = functools.partial(_fwd_kernel, scale=scale, block_kv=block_kv, causal=causal, seq_len=T)
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_kv=block_kv, causal=causal,
+                               q_len=T, kv_len=T_kv)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -263,7 +265,9 @@ def _flash_fwd(q, k, v, causal, block_q, block_kv, scale):
     # (models.transformer exposes it as policy "dots_and_attn_saveable").
     out_p = checkpoint_name(out_p, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return out_p[:, :, :T], (qp, kp, vp, out_p, lse)
+    # residuals keep the UNPADDED operands: backward re-pads (cheap) and the
+    # logical q/kv lengths stay statically derivable from the shapes
+    return out_p[:, :, :T], (q, k, v, out_p, lse)
 
 
 def _flash_bwd(causal, block_q, block_kv, scale, res, g_out):
@@ -271,15 +275,18 @@ def _flash_bwd(causal, block_q, block_kv, scale, res, g_out):
 
 
 def _flash_bwd_impl(causal, block_q, block_kv, scale, res, g_out, delta_shift=None):
-    qp, kp, vp, out_p, lse = res
-    B, H, Tq, D = qp.shape
-    Hkv = kp.shape[1]
+    q, k, v, out_p, lse = res
+    B, H, T, D = q.shape
+    Hkv = k.shape[1]
     grp = H // Hkv
-    Tkv = kp.shape[2]
-    T = g_out.shape[2]
+    T_kv_logical = k.shape[2]
     scale_v = scale if scale is not None else 1.0 / (D**0.5)
     bq = min(block_q, T)
-    bkv = min(block_kv, T)
+    bkv = min(block_kv, T_kv_logical)
+    qp = _pad_seq(q, bq)
+    kp = _pad_seq(k, bkv)
+    vp = _pad_seq(v, bkv)
+    Tq, Tkv = qp.shape[2], kp.shape[2]
 
     dop = jnp.pad(g_out, ((0, 0), (0, 0), (0, Tq - T), (0, 0))) if Tq != T else g_out
 
@@ -289,7 +296,8 @@ def _flash_bwd_impl(causal, block_q, block_kv, scale, res, g_out, delta_shift=No
         delta = delta - delta_shift.astype(jnp.float32)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale_v, block_kv=bkv, causal=causal, seq_len=T),
+        functools.partial(_bwd_dq_kernel, scale=scale_v, block_kv=bkv, causal=causal,
+                          kv_len=T_kv_logical),
         grid=(B, H, Tq // bq),
         in_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, i: (b, h, i, 0)),
@@ -305,7 +313,8 @@ def _flash_bwd_impl(causal, block_q, block_kv, scale, res, g_out, delta_shift=No
     )(qp, kp, vp, dop, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale_v, block_q=bq, causal=causal, seq_len=T),
+        functools.partial(_bwd_dkv_kernel, scale=scale_v, block_q=bq, causal=causal,
+                          q_len=T, kv_len=T_kv_logical),
         grid=(B, H, Tkv // bkv),
         in_specs=[
             pl.BlockSpec((1, 1, Tq, D), lambda b, h, j: (b, h, 0, 0)),
@@ -329,7 +338,7 @@ def _flash_bwd_impl(causal, block_q, block_kv, scale, res, g_out, delta_shift=No
     if grp > 1:  # group-sum per-query-head dk/dv back onto the shared KV head
         dk = dk.reshape(B, Hkv, grp, Tkv, D).sum(axis=2)
         dv = dv.reshape(B, Hkv, grp, Tkv, D).sum(axis=2)
-    return dq[:, :, :T], dk[:, :, :T], dv[:, :, :T]
+    return dq[:, :, :T], dk[:, :, :T_kv_logical], dv[:, :, :T_kv_logical]
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -349,7 +358,7 @@ def flash_attention_with_lse(q, k, v, causal=True, block_q=512, block_kv=512, sc
 def _flash_lse_fwd(q, k, v, causal, block_q, block_kv, scale):
     T = q.shape[2]
     out_p, lse, (qp, kp, vp, Tq, Tkv) = _flash_call(q, k, v, causal, block_q, block_kv, scale)
-    return (out_p[:, :, :T], lse[:, :, :T, 0]), (qp, kp, vp, out_p, lse)
+    return (out_p[:, :, :T], lse[:, :, :T, 0]), (q, k, v, out_p, lse)
 
 
 def _flash_lse_bwd(causal, block_q, block_kv, scale, res, g):
@@ -358,9 +367,9 @@ def _flash_lse_bwd(causal, block_q, block_kv, scale, res, g):
     is ds = p∘(dp − (delta − g_lse)) — so shifting delta by −g_lse reuses
     both kernels unchanged."""
     g_out, g_lse = g
-    qp, kp, vp, out_p, lse = res
+    out_p = res[3]
     T = g_out.shape[2]
-    Tq = qp.shape[2]
+    Tq = out_p.shape[2]
     g_lse_p = jnp.pad(g_lse, ((0, 0), (0, 0), (0, Tq - T))) if Tq != T else g_lse
     return _flash_bwd_impl(causal, block_q, block_kv, scale, res, g_out,
                            delta_shift=g_lse_p[..., None])

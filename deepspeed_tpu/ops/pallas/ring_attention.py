@@ -10,12 +10,21 @@ rotate around the ``seq`` ring via ``ppermute`` (ICI neighbor traffic), and
 each step's local flash-attention result merges into a running (out, lse)
 pair — the online-softmax identity across chips instead of across blocks.
 
-Causal scheduling: at ring step ``s`` chip ``i`` holds KV chunk ``i−s`` mod
-``n``. Step 0 is the causal diagonal; step ``s≥1`` is a full (non-causal)
-block that only chips ``i >= s`` keep (wrapped chunks are future context —
-their result is discarded by an lse=−inf merge). This is the simple
-unbalanced schedule: ~half the non-diagonal block computations are masked
-away; the zig-zag balanced variant can land behind the same API.
+Causal scheduling, two variants behind one API (``schedule=``):
+
+- ``unbalanced``: at ring step ``s`` chip ``i`` holds KV chunk ``i−s`` mod
+  ``n``. Step 0 is the causal diagonal; step ``s≥1`` is a full (non-causal)
+  block that only chips ``i >= s`` keep — wrapped chunks are future context,
+  discarded by an lse=−inf merge, so ~half the non-diagonal block compute is
+  wasted.
+- ``zigzag`` (default): the global sequence splits into 2n chunks and chip
+  ``i`` holds the PAIR (chunk i, chunk 2n−1−i) — one early, one late. At
+  every non-diagonal step each chip does exactly one half-block of useful
+  work (received-from-behind: full-Q x early-KV-half; received-from-ahead:
+  late-Q-half x full-KV), recovering the ~2x causal efficiency. The
+  contiguous→zig-zag chunk relayout (and its inverse on the output) runs as
+  four ppermutes of half-chunks — O(T/n) neighbor traffic, amortized over
+  the n ring steps.
 
 Differentiable end-to-end: the per-step kernel is
 ``flash_attention_with_lse`` (custom VJP with the lse cotangent folded into
@@ -92,18 +101,135 @@ def ring_attention_local(q, k, v, axis_name="seq", causal=True, block_q=512, blo
     return out.astype(q.dtype)
 
 
-def ring_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
+# --------------------------------------------------------------------- zigzag
+def _zigzag_mapping(n, inverse=False):
+    """Half-chunk routing tables: ``mapping[dst_slot]`` is a list of
+    ``(src_chip, src_slot, dst_chip)``. Forward: contiguous layout (chip s
+    holds chunks 2s, 2s+1) -> zig-zag (chip i holds chunks i, 2n-1-i)."""
+    mapping = {0: [], 1: []}
+    for i in range(n):
+        for dst_slot, chunk in ((0, i), (1, 2 * n - 1 - i)):
+            src_chip, src_slot = chunk // 2, chunk % 2
+            if inverse:
+                # transpose: contiguous chip src_chip slot src_slot receives
+                # chunk back from zig-zag chip i slot dst_slot
+                mapping[src_slot].append((i, dst_slot, src_chip))
+            else:
+                mapping[dst_slot].append((src_chip, src_slot, i))
+    return mapping
+
+
+def _permute_halves(halves, mapping, axis_name):
+    """Route local half-chunks by the mapping (<=2 ppermutes per dst slot;
+    a chip that is no pair's destination receives zeros, so summing the
+    slot-wise ppermutes reassembles every destination exactly once)."""
+    out = []
+    for dst_slot in (0, 1):
+        acc = None
+        for src_slot in (0, 1):
+            pairs = [(sc, dc) for sc, ss, dc in mapping[dst_slot] if ss == src_slot]
+            if not pairs:
+                continue
+            moved = jax.lax.ppermute(halves[src_slot], axis_name, pairs)
+            acc = moved if acc is None else acc + moved
+        out.append(acc)
+    return tuple(out)
+
+
+def _zigzag_relayout(x, axis_name, n, inverse=False):
+    """(B, H, 2c, D) local chunk-pair -> re-routed chunk-pair."""
+    c = x.shape[2] // 2
+    halves = (x[:, :, :c], x[:, :, c:])
+    h0, h1 = _permute_halves(halves, _zigzag_mapping(n, inverse), axis_name)
+    return jnp.concatenate([h0, h1], axis=2)
+
+
+def zigzag_ring_attention_local(q, k, v, axis_name="seq", block_q=512, block_kv=512,
+                                scale=None):
+    """Per-chip body over the ZIG-ZAG layout: local tensors hold (chunk i,
+    chunk 2n-1-i) of the 2n-chunk causal sequence. Every element of the early
+    chunk precedes every element of the late chunk, so the local diagonal is
+    a plain causal flash call on the concatenation; non-diagonal steps are
+    exactly one balanced half-block each (see module docstring)."""
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    B, H, T2, D = q.shape
+    c = T2 // 2
+
+    def attend(qq, kk, vv, causal_flag):
+        return flash_attention_with_lse(qq, kk, vv, causal_flag, block_q, block_kv, scale)
+
+    out0, lse = jax.checkpoint(functools.partial(attend, causal_flag=True))(q, k, v)
+    out = out0.astype(jnp.float32)
+    if n == 1:
+        return out.astype(q.dtype)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def from_behind(kv):
+        # kv came from chip j < i: its early chunk precedes BOTH local q
+        # chunks; its late chunk follows both. Full Q x early-KV-half.
+        kk, vv = kv
+        o, l = jax.checkpoint(functools.partial(attend, causal_flag=False))(
+            q, kk[:, :, :c], vv[:, :, :c])
+        return o.astype(jnp.float32), l
+
+    def from_ahead(kv):
+        # kv came from chip j > i: both its chunks sit between local q's
+        # early and late chunks. Late-Q-half x full KV; early half attends
+        # nothing (lse=-inf so the merge ignores it).
+        kk, vv = kv
+        o, l = jax.checkpoint(functools.partial(attend, causal_flag=False))(
+            q[:, :, c:], kk, vv)
+        pad_o = jnp.zeros((B, H, c, D), jnp.float32)
+        pad_l = jnp.full((B, H, c), _NEG_INF, l.dtype)
+        return (jnp.concatenate([pad_o, o.astype(jnp.float32)], axis=2),
+                jnp.concatenate([pad_l, l], axis=2))
+
+    def body(s, carry):
+        out, lse, kv = carry
+        kv = jax.tree_util.tree_map(lambda x: jax.lax.ppermute(x, axis_name, perm), kv)
+        o_s, lse_s = jax.lax.cond(idx >= s, from_behind, from_ahead, kv)
+        out, lse = _merge(out, lse, o_s, lse_s)
+        return out, lse, kv
+
+    out, lse, _ = jax.lax.fori_loop(1, n, body, (out, lse, (k, v)))
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None,
+                   schedule="zigzag"):
     """Mesh-level entry: q (B, H, T, D), k/v (B, Hkv, T, D) sequence-sharded
     over the ``seq`` axis, batch over data axes, heads over ``tensor`` (when
     divisible). Runs the ring inside ``shard_map``; falls back to a plain
-    flash call on a trivial mesh."""
+    flash call on a trivial mesh. ``schedule``: 'zigzag' (balanced causal,
+    default) or 'unbalanced'; non-causal attention always uses the plain
+    rotation (every block is useful there)."""
     from ...comm import comm as dist
+
+    def local_fn(n_ring, local_t):
+        use_zigzag = (schedule == "zigzag" and causal and n_ring > 1
+                      and local_t % 2 == 0)
+
+        def fn(q, k, v):
+            if use_zigzag:
+                q_z = _zigzag_relayout(q, dist.SEQ_AXIS, n_ring)
+                k_z = _zigzag_relayout(k, dist.SEQ_AXIS, n_ring)
+                v_z = _zigzag_relayout(v, dist.SEQ_AXIS, n_ring)
+                out = zigzag_ring_attention_local(q_z, k_z, v_z, dist.SEQ_AXIS,
+                                                  block_q, block_kv, scale)
+                return _zigzag_relayout(out, dist.SEQ_AXIS, n_ring, inverse=True)
+            return ring_attention_local(q, k, v, dist.SEQ_AXIS, causal, block_q, block_kv,
+                                        scale)
+
+        return fn
 
     if dist.in_manual_region():
         # already inside someone's shard_map: run the ring only if the seq
         # axis is actually bound there
         if dist.SEQ_AXIS in dist.get_manual_axes():
-            return ring_attention_local(q, k, v, dist.SEQ_AXIS, causal, block_q, block_kv, scale)
+            n_ring = dist.get_mesh().shape[dist.SEQ_AXIS] if dist.has_mesh() else 1
+            return local_fn(n_ring, q.shape[2])(q, k, v)
         return _dense_fallback(q, k, v, causal, block_q, block_kv, scale)
     if not dist.has_mesh() or dist.get_mesh().shape[dist.SEQ_AXIS] == 1:
         return _dense_fallback(q, k, v, causal, block_q, block_kv, scale)
@@ -122,10 +248,9 @@ def ring_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None):
     spec = P(dp_axes or None, head_axis, dist.SEQ_AXIS, None)
     axes = set(dp_axes) | {dist.SEQ_AXIS} | ({head_axis} if head_axis else set())
 
-    def fn(q, k, v):
-        return ring_attention_local(q, k, v, dist.SEQ_AXIS, causal, block_q, block_kv, scale)
-
+    n_ring = mesh.shape[dist.SEQ_AXIS]
     with dist.manual_axes(axes):
+        fn = local_fn(n_ring, T // n_ring)
         return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                              axis_names=axes, check_vma=False)(q, k, v)
 

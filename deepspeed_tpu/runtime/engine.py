@@ -253,6 +253,7 @@ class DeepSpeedEngine:
         self._compiled = {}
         self._pending_batches = []
         self._last_metrics = None
+        self._eigenvalue = None  # built lazily from the 'eigenvalue' section
 
         log_dist(
             f"DeepSpeedEngine ready: world={dist.get_world_size()} mesh={dict(self.mesh.shape)} "
@@ -1023,11 +1024,15 @@ class DeepSpeedEngine:
 
         self.tput_timer.start()
         # compression scheduler (reference engine.py:1268): advance the step
-        # and re-trace the compiled step once when a transform activates
+        # and re-trace the compiled step when the compression graph changes
+        # (a transform activates, MoQ drops a bit, act-quant switches on)
         if hasattr(self.module, "transforms") and hasattr(self.module, "_active"):
-            n_before = len(self.module._active())
+            self._maybe_update_eigenvalue(stacked)
+            sig = getattr(self.module, "compression_signature", None)
+            before = sig() if sig else len(self.module._active())
             self.module.global_step = self.global_steps
-            if len(self.module._active()) != n_before:
+            after = sig() if sig else len(self.module._active())
+            if after != before:
                 self._compiled.clear()
         if self.offload_optimizer:
             metrics = self._offload_train_batch(stacked)
@@ -1136,6 +1141,39 @@ class DeepSpeedEngine:
             self.state = zero_fn(self.state)
 
     # ------------------------------------------------------------------ reporting
+    def _maybe_update_eigenvalue(self, stacked):
+        """MoQ curvature schedule (reference engine.py:1268 eigenvalue hook):
+        at ``gas_boundary_resolution`` intervals, power-iterate the loss
+        Hessian and scale the compressed model's quantize periods by
+        ``1 + floor(ev_norm * 4)`` — high-curvature phases quantize slower.
+        Simplification vs the per-layer reference factors, documented: one
+        global factor from the max-normalized mean of the subtree values."""
+        ev_cfg = dict(self._config.raw_config.get("eigenvalue", {}))
+        if not ev_cfg.get("enabled") or not hasattr(self.module, "eigenvalue_factor"):
+            return
+        if self._eigenvalue is None:
+            from .eigenvalue import Eigenvalue
+            keys = ("verbose", "max_iter", "tol", "stability", "gas_boundary_resolution",
+                    "layer_name", "layer_num")
+            self._eigenvalue = Eigenvalue(**{k: ev_cfg[k] for k in keys if k in ev_cfg})
+        res = max(1, int(self._eigenvalue.gas_boundary_resolution))
+        if self.global_steps == 0 or self.global_steps % res != 0:
+            return
+        import math
+        mb = jax.tree_util.tree_map(lambda x: x[0], stacked)
+        try:
+            evs = self._eigenvalue.compute_eigenvalue(self.module.loss, self.state.params, mb)
+        except Exception as e:
+            logger.warning(f"eigenvalue: computation failed ({e}); keeping factor "
+                           f"{self.module.eigenvalue_factor}")
+            return
+        vals = np.asarray([abs(v) for v in evs.values()], np.float64)
+        if vals.size and vals.max() > 0:
+            ev_norm = float(np.mean(vals / vals.max()))
+            self.module.eigenvalue_factor = 1 + math.floor(ev_norm * 4)
+            log_dist(f"eigenvalue: factor={self.module.eigenvalue_factor} "
+                     f"(normalized mean {ev_norm:.3f})", [0])
+
     def _maybe_profile_flops(self, stacked):
         """flops_profiler section: at profile_step, read XLA's cost analysis
         of the compiled train step and log achieved vs peak (reference

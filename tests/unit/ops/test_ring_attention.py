@@ -102,3 +102,90 @@ def test_lse_cotangent_through_merge():
     g1 = jax.grad(f_kernel)(q)
     g2 = jax.grad(f_ref)(q)
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=5e-5, rtol=5e-5)
+
+
+def run_zigzag(mesh, q, k, v):
+    from deepspeed_tpu.ops.pallas.ring_attention import (_zigzag_relayout,
+                                                         zigzag_ring_attention_local)
+    n = mesh.shape["seq"]
+
+    def fn(q, k, v):
+        qz = _zigzag_relayout(q, "seq", n)
+        kz = _zigzag_relayout(k, "seq", n)
+        vz = _zigzag_relayout(v, "seq", n)
+        out = zigzag_ring_attention_local(qz, kz, vz, "seq", block_q=64, block_kv=64)
+        return _zigzag_relayout(out, "seq", n, inverse=True)
+
+    return jax.shard_map(fn, mesh=mesh, in_specs=(P(None, None, "seq", None), ) * 3,
+                         out_specs=P(None, None, "seq", None), check_vma=False)(q, k, v)
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_zigzag_matches_dense_and_unbalanced(n):
+    """VERDICT r2 item 10: the balanced zig-zag schedule is numerically the
+    same attention — vs the dense kernel AND the unbalanced ring."""
+    q, k, v = qkv(3)
+    ref = flash_attention(q, k, v, True, 64, 64, None)
+    unb = run_ring(seq_mesh(n), q, k, v, True)
+    zig = run_zigzag(seq_mesh(n), q, k, v)
+    np.testing.assert_allclose(np.asarray(zig), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(zig), np.asarray(unb), atol=2e-5)
+
+
+def test_zigzag_relayout_roundtrip():
+    from deepspeed_tpu.ops.pallas.ring_attention import _zigzag_relayout
+    n = 4
+    mesh = seq_mesh(n)
+    x = jnp.arange(B * H * T * D, dtype=jnp.float32).reshape(B, H, T, D)
+
+    def fn(x):
+        z = _zigzag_relayout(x, "seq", n)
+        return _zigzag_relayout(z, "seq", n, inverse=True)
+
+    out = jax.shard_map(fn, mesh=mesh, in_specs=P(None, None, "seq", None),
+                        out_specs=P(None, None, "seq", None), check_vma=False)(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+    # forward relayout places the right chunks: chip i holds (chunk i, 2n-1-i)
+    def fwd(x):
+        return _zigzag_relayout(x, "seq", n)
+
+    z = jax.shard_map(fwd, mesh=mesh, in_specs=P(None, None, "seq", None),
+                      out_specs=P(None, None, "seq", None), check_vma=False)(x)
+    c = T // (2 * n)
+    zv = np.asarray(z).reshape(B, H, n, 2 * c, D)  # per-chip local pairs
+    xv = np.asarray(x).reshape(B, H, 2 * n, c, D)  # global 2n chunks
+    for i in range(n):
+        np.testing.assert_array_equal(zv[:, :, i, :c], xv[:, :, i])
+        np.testing.assert_array_equal(zv[:, :, i, c:], xv[:, :, 2 * n - 1 - i])
+
+
+def test_zigzag_gradients_match_dense():
+    q, k, v = qkv(4)
+
+    def dense_loss(q, k, v):
+        return jnp.sum(jnp.square(flash_attention(q, k, v, True, 64, 64, None)))
+
+    def zig_loss(q, k, v):
+        return jnp.sum(jnp.square(run_zigzag(seq_mesh(4), q, k, v)))
+
+    g_ref = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    g_zig = jax.grad(zig_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_zig):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=3e-4)
+
+
+def test_mesh_level_ring_default_zigzag_matches_unbalanced():
+    """Public ring_attention: schedule='zigzag' (default) == 'unbalanced'."""
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.ops.pallas.ring_attention import ring_attention
+    comm._state["mesh"] = None
+    comm.initialize_mesh(seq=4)
+    q, k, v = qkv(5)
+    try:
+        zig = ring_attention(q, k, v, causal=True, block_q=64, block_kv=64)
+        unb = ring_attention(q, k, v, causal=True, block_q=64, block_kv=64,
+                             schedule="unbalanced")
+        np.testing.assert_allclose(np.asarray(zig), np.asarray(unb), atol=2e-5)
+    finally:
+        comm._state["mesh"] = None
