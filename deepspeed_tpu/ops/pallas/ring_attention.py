@@ -246,7 +246,13 @@ def ring_attention(q, k, v, causal=True, block_q=512, block_kv=512, scale=None,
         k = jnp.repeat(k, H // Hkv, axis=1)
         v = jnp.repeat(v, H // Hkv, axis=1)
     spec = P(dp_axes or None, head_axis, dist.SEQ_AXIS, None)
-    axes = set(dp_axes) | {dist.SEQ_AXIS} | ({head_axis} if head_axis else set())
+    # Full-manual over every mesh axis: axes the spec does not name just see
+    # replicated blocks. A partial-manual region (axis_names ⊂ mesh axes)
+    # cannot use check_vma=False — None spec entries are then read as
+    # replicated-over-ALL-mesh-axes and shard_map rejects the out_specs for
+    # every auto axis — and check_vma=True needs vma-annotated out_shapes
+    # all the way into the pallas_call, so full-manual is the simple shape.
+    axes = set(mesh.axis_names)
 
     n_ring = mesh.shape[dist.SEQ_AXIS]
     with dist.manual_axes(axes):
