@@ -93,16 +93,32 @@ class _Transform:
             sym = self.params.get("quantization_type", "symmetric") == "symmetric"
             return fake_quantize(w, bits=self.current_bits, groups=groups, symmetric=sym)
         ratio = float(self.params.get("dense_ratio", 0.5))
+        # Scanned models stack every block param under "layers/..." with a
+        # leading layer dim; structured pruning must neither prune that dim
+        # (zeroing whole layers) nor share one slice selection across layers
+        # — ``lead`` gives each layer its own top-k (the reference prunes
+        # each Linear independently).
+        lead = 1 if path.split("/", 1)[0] == "layers" else 0
         if self.kind == "sparse_pruning":
             mask = magnitude_mask(w, ratio)
         elif self.kind == "row_pruning":
-            mask = magnitude_mask(w, ratio, dim=w.ndim - 1)  # output dim
+            mask = magnitude_mask(w, ratio, dim=w.ndim - 1, lead=lead)  # output dim
         elif self.kind == "channel_pruning":
-            mask = magnitude_mask(w, ratio, dim=0)  # input-channel dim
+            # input channels = the first non-layer dim in every zoo kernel
+            # layout: (in, out) MLP, (in, heads, hd) qkv, (heads, hd, H)
+            # o_proj (whole input heads count as the channel group there)
+            mask = magnitude_mask(w, ratio, dim=lead, lead=lead)
         elif self.kind == "head_pruning":
-            # bhtd attention projections: kernel (H, heads, hd) — prune the
-            # heads dim; fall back to dim 0 for 2-D params
-            mask = magnitude_mask(w, ratio, dim=1 if w.ndim >= 3 else 0)
+            # heads dim by projection layout: o_proj (heads, hd, H) leads
+            # with it; q/k/v (in, heads, hd) put it second; 2-D params have
+            # no head structure — prune dim 0 slices
+            if w.ndim - lead < 3:
+                dim = lead
+            elif "o_proj" in path:
+                dim = lead
+            else:
+                dim = lead + 1
+            mask = magnitude_mask(w, ratio, dim=dim, lead=lead)
         else:
             raise ValueError(f"unknown compression kind {self.kind}")
         return w * mask.astype(w.dtype)

@@ -36,7 +36,7 @@ def fake_quantize(w, bits=8, groups=1, symmetric=True):
     return w + jax.lax.stop_gradient(deq - w)
 
 
-def magnitude_mask(w, dense_ratio, dim=None):
+def magnitude_mask(w, dense_ratio, dim=None, lead=0):
     """Keep-mask retaining the largest-|w| fraction ``dense_ratio``
     (traceable: recomputed from the live weights inside the compiled step, so
     the sparsity pattern tracks training like the reference's periodically
@@ -44,16 +44,20 @@ def magnitude_mask(w, dense_ratio, dim=None):
 
     ``dim=None``: unstructured (per-element, reference sparse_pruning l1
     method). ``dim=k``: structured — whole slices along dim ``k`` are kept or
-    dropped by their L1 norm (row/head pruning)."""
+    dropped by their L1 norm (row/head pruning). ``lead``: number of leading
+    stack dims (a scanned model's layer dim) to select INDEPENDENTLY over —
+    each stack index gets its own top-k, matching the reference's per-Linear
+    pruning; with lead=0 the selection is global over the one tensor."""
     aw = jnp.abs(w.astype(jnp.float32))
     if dim is None:
         k = max(1, int(round(w.size * dense_ratio)))
         threshold = jax.lax.top_k(aw.reshape(-1), k)[0][-1]
         return aw >= threshold
-    scores = aw.sum(axis=tuple(i for i in range(w.ndim) if i != dim))
-    k = max(1, int(round(scores.size * dense_ratio)))
-    threshold = jax.lax.top_k(scores, k)[0][-1]
+    assert dim >= lead, (dim, lead)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != dim and i >= lead)
+    scores = aw.sum(axis=reduce_axes)  # (lead dims..., w.shape[dim])
+    k = max(1, int(round(w.shape[dim] * dense_ratio)))
+    threshold = jax.lax.top_k(scores, k)[0][..., -1:]
     keep = scores >= threshold
-    shape = [1] * w.ndim
-    shape[dim] = w.shape[dim]
+    shape = [w.shape[i] if (i < lead or i == dim) else 1 for i in range(w.ndim)]
     return jnp.broadcast_to(keep.reshape(shape), w.shape)

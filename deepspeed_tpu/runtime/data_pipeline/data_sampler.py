@@ -200,10 +200,17 @@ class DeepSpeedDataSampler:
         out = list(np.copy(data[pos:pos + n]))
         self.data_cluster_current_position[cidx] = pos + n
         if len(out) < n:
-            remaining = n - len(out)
+            # wrap-around fill: a cluster smaller than its sampled share must
+            # still return n items (clusters are drawn with replacement, so
+            # repeats are fine) — a single top-up would come up short and the
+            # resulting short global batch would spin under drop_last. One
+            # reshuffle + modular cycling, not a disk rewrite per wrap.
             self._reshuffle_cluster(cidx)
-            out += list(np.copy(self.data_clusters[cidx][1][0][:remaining]))
-            self.data_cluster_current_position[cidx] = remaining
+            data = self.data_clusters[cidx][1][0]
+            remaining = n - len(out)
+            reps = np.resize(np.copy(data), remaining)  # cycles when short
+            out += list(reps)
+            self.data_cluster_current_position[cidx] = remaining % max(len(data), 1)
         return out
 
     # -- batch generation ---------------------------------------------------
@@ -243,9 +250,16 @@ class DeepSpeedDataSampler:
             self.batch = self.batch[self.micro_batch_times_data_parallel_size:]
             if len(current) == self.micro_batch_times_data_parallel_size or \
                     (current and not self.drop_last):
+                consumed = len(current)
+                if consumed < self.micro_batch_times_data_parallel_size:
+                    # drop_last=False tail: pad by cycling the partial batch
+                    # so every DP rank still sees a full micro_batch_size —
+                    # rank-divergent batch shapes would desync SPMD consumers
+                    reps = -(-self.micro_batch_times_data_parallel_size // consumed)
+                    current = (current * reps)[:self.micro_batch_times_data_parallel_size]
                 start = self.data_parallel_rank * self.micro_batch_size
                 yield current[start:start + self.micro_batch_size]
-                self.consumed_samples += len(current)
+                self.consumed_samples += consumed
 
     # -- checkpoint ---------------------------------------------------------
     def state_dict(self):

@@ -115,7 +115,9 @@ def test_activation_quantization_trains_and_takes_effect():
 
 
 def test_channel_pruning_clean():
-    """channel_pruning prunes whole INPUT channels (dim 0)."""
+    """channel_pruning prunes whole INPUT channels — on the zoo default
+    scanned layout (L, F, H) that is dim 1, NOT the layer-stack dim 0
+    (regression: dim=0 silently zeroed entire transformer layers)."""
     cfg = {"compression_training": {"channel_pruning": {
         "shared_parameters": {"enabled": True, "schedule_offset": 0},
         "different_groups": {"cp1": {"params": {"dense_ratio": 0.5},
@@ -126,10 +128,16 @@ def test_channel_pruning_clean():
     flat = {jax.tree_util.keystr(p): w for p, w in
             jax.tree_util.tree_flatten_with_path(cleaned)[0]}
     w = next(np.asarray(v) for k, v in flat.items() if "down_proj" in k and "kernel" in k)
-    # scanned layers: (L, F, H) — input dim is 0 of the per-layer (F, H) view?
-    # kernel dims: whole slices along dim 0 zeroed for ~half the channels
-    per_channel = np.abs(w).reshape(w.shape[0], -1).sum(axis=1)
-    assert float(np.mean(per_channel == 0)) >= 0.3
+    assert w.ndim == 3  # scanned (L, F, H)
+    # no layer slice may be entirely zero (the dim=0 bug zeroed whole layers)
+    per_layer = np.abs(w).reshape(w.shape[0], -1).sum(axis=1)
+    assert (per_layer > 0).all()
+    # whole input-channel slices (dim 1) zeroed for ~half the channels in
+    # every layer — each layer selects independently, so the zeroed sets
+    # need not align across layers
+    for layer in range(w.shape[0]):
+        zero_cols = np.abs(w[layer]).sum(axis=1) == 0
+        assert float(np.mean(zero_cols)) >= 0.3
 
 
 def test_moq_bit_annealing_schedule():
@@ -207,3 +215,53 @@ def test_layer_reduction_and_kd_loss():
     np.testing.assert_array_equal(
         np.asarray(sparams_s["layers"]["attn"]["q_proj"]["kernel"][0]),
         np.asarray(tparams_s["layers"]["attn"]["q_proj"]["kernel"][1]))
+
+
+def test_structured_pruning_layout_aware_dims():
+    """head/channel pruning pick the right dim per kernel layout, per layer:
+    qkv (L, H, heads, hd) -> heads dim 2; o_proj (L, heads, hd, H) -> dim 1;
+    each layer gets its OWN top-k selection (reference prunes each Linear
+    independently)."""
+    import numpy as np
+    from deepspeed_tpu.compression.helper import magnitude_mask
+
+    r = np.random.default_rng(0)
+    L, H, heads, hd = 3, 8, 4, 2
+    qkv = jnp.asarray(r.standard_normal((L, H, heads, hd)), jnp.float32)
+    mask = np.asarray(magnitude_mask(qkv, 0.5, dim=2, lead=1))
+    # per (layer, head) slices all-kept or all-dropped; half per layer
+    for l in range(L):
+        per_head = mask[l].all(axis=(0, 2)) | ~mask[l].any(axis=(0, 2))
+        assert per_head.all()
+        assert mask[l].all(axis=(0, 2)).sum() == heads // 2
+    # per-layer independence: craft weights so layer 0 and 1 keep different heads
+    w = np.ones((2, H, heads, hd), np.float32) * 0.01
+    w[0, :, :2] = 1.0  # layer 0: heads 0,1 strong
+    w[1, :, 2:] = 1.0  # layer 1: heads 2,3 strong
+    m = np.asarray(magnitude_mask(jnp.asarray(w), 0.5, dim=2, lead=1))
+    assert m[0].all(axis=(0, 2)).tolist() == [True, True, False, False]
+    assert m[1].all(axis=(0, 2)).tolist() == [False, False, True, True]
+
+
+def test_head_pruning_o_proj_vs_qkv_dims():
+    """End-to-end head_pruning on a scanned model: qkv kernels lose whole
+    heads (dim 2) and o_proj kernels lose whole heads (dim 1) — not hd
+    coordinates and not whole layers."""
+    cfg = {"compression_training": {"head_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0},
+        "different_groups": {"hp1": {"params": {"dense_ratio": 0.5},
+                                     "modules": ["attn/(q|k|v|o)_proj"]}}}}}
+    model = get_model("tiny", dtype=jnp.float32)
+    params = model.init_params(jax.random.key(0))
+    cleaned = redundancy_clean(params, cfg)
+    flat = {jax.tree_util.keystr(p): np.asarray(w) for p, w in
+            jax.tree_util.tree_flatten_with_path(cleaned)[0]}
+    wq = next(v for k, v in flat.items() if "q_proj" in k and "kernel" in k)
+    wo = next(v for k, v in flat.items() if "o_proj" in k and "kernel" in k)
+    assert wq.ndim == 4 and wo.ndim == 4  # scanned
+    for l in range(wq.shape[0]):
+        assert np.abs(wq[l]).sum() > 0 and np.abs(wo[l]).sum() > 0  # no layer zeroed
+        q_heads_gone = np.abs(wq[l]).sum(axis=(0, 2)) == 0  # (H, heads, hd) -> heads
+        o_heads_gone = np.abs(wo[l]).sum(axis=(1, 2)) == 0  # (heads, hd, H) -> heads
+        assert q_heads_gone.sum() >= 1
+        assert o_heads_gone.sum() >= 1
