@@ -991,6 +991,106 @@ class CausalLMModel:
         """Regex of params whose leading (layer) dim shards over ``pipe``."""
         return r"^layers/" if self.cfg.scan_layers else None
 
+    # ---- ZeRO-Infinity parameter streaming --------------------------------
+    # Layer-granular entry points for the param-offload runner
+    # (``runtime/zero/param_offload.py``): host-resident parameter blocks are
+    # streamed through these, so HBM never holds more than one block (plus
+    # activations). Counterpart of the reference's partitioned-param fetch
+    # (``runtime/zero/partitioned_param_swapper.py:36`` + ``stage3.py:463``),
+    # with the module-hook machinery replaced by explicit block functions.
+    def stream_plan(self, abstract_params):
+        """Block partition of the param tree: which top-level keys ride the
+        embed block, which the tail block, and the stacked layer key. Tied
+        embeddings place "embed" in BOTH blocks (one host copy; the runner
+        sums its two grad contributions)."""
+        if not self.cfg.scan_layers:
+            raise ValueError("parameter streaming requires scan_layers=True "
+                             "(stacked layer params)")
+        keys = set(abstract_params.keys())
+        embed = [k for k in ("embed", "embed_norm", "pos_embed") if k in keys]
+        tail = [k for k in ("final_norm", "lm_head") if k in keys]
+        if self.cfg.tie_embeddings:
+            tail.append("embed")
+        extra = keys - set(embed) - set(tail) - {"layers"}
+        if extra:
+            raise ValueError(f"stream_plan: unrecognized top-level params {sorted(extra)}")
+        return {"layer_key": "layers", "embed": embed, "tail": tail}
+
+    def stream_embed(self, embed_tree, input_ids, cache_index=None):
+        """Token embedding (+ optional embed norm / learned positions):
+        (B, T) ids -> (B, T, H) activations."""
+        cfg = self.cfg
+        table = embed_tree["embed"]["embedding"].astype(cfg.dtype)
+        x = table[input_ids]
+        if cfg.embed_norm:
+            x = make_norm(cfg).apply({"params": embed_tree["embed_norm"]}, x)
+        if cfg.pos_embedding == "learned":
+            T = input_ids.shape[1]
+            start = 0 if cache_index is None else cache_index
+            x = x + jax.lax.dynamic_slice_in_dim(embed_tree["pos_embed"], start, T,
+                                                 axis=0).astype(cfg.dtype)
+        return x
+
+    def _rope(self):
+        cfg = self.cfg
+        return (rope_table(cfg.rotary_dim or cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
+                if cfg.pos_embedding == "rope" else (None, None))
+
+    def stream_layer(self, layer_tree, h, attn_mask=None):
+        """One transformer block (deterministic): ``layer_tree`` is a single
+        layer's params (the stacked leaves sliced at one index)."""
+        sin, cos = self._rope()
+        y, _ = Block(self.cfg).apply({"params": layer_tree}, h, sin, cos, attn_mask)
+        return y
+
+    def stream_layer_cached(self, layer_tree, h, kv_cache, cache_index, cache_mask=None):
+        """One block in decode mode: attends over (and appends to) this
+        layer's KV cache pair (B, kv_heads, S, head_dim)."""
+        sin, cos = self._rope()
+        y, new_cache = Block(self.cfg).apply({"params": layer_tree}, h, sin, cos,
+                                             cache_mask, True, kv_cache, cache_index)
+        return y, new_cache
+
+    def stream_tail_loss(self, tail_tree, h, labels, valid, shift=True):
+        """final norm + vocab projection + masked CE (mean over valid).
+        ``shift``: drop the last hidden position (next-token objective on
+        unshifted inputs); grads w.r.t. the FULL ``h`` come out of the vjp
+        with zeros there."""
+        cfg = self.cfg
+        h = make_norm(cfg).apply({"params": tail_tree["final_norm"]}, h)
+        if shift:
+            h = h[:, :-1]
+        labels_c = jnp.maximum(labels, 0)
+        if cfg.tie_embeddings:
+            w, transpose = tail_tree["embed"]["embedding"], True
+        else:
+            w, transpose = tail_tree["lm_head"]["kernel"], False
+        if self._use_chunked_ce():
+            total = chunked_cross_entropy(h, w, labels_c, valid, chunk=self._ce_chunk(),
+                                          transpose=transpose)
+        else:
+            import optax
+            eq = "bth,vh->btv" if transpose else "bth,hv->btv"
+            logits = jnp.einsum(eq, h, w.astype(h.dtype))
+            if cfg.lm_head_bias:
+                logits = logits + tail_tree["lm_head"]["bias"].astype(logits.dtype)
+            ce = optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), labels_c)
+            total = jnp.sum(ce * valid)
+        return total / jnp.maximum(jnp.sum(valid), 1)
+
+    def stream_logits(self, tail_tree, h):
+        """final norm + vocab projection for decode: (B, T, H) -> (B, T, V)."""
+        cfg = self.cfg
+        h = make_norm(cfg).apply({"params": tail_tree["final_norm"]}, h)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bth,vh->btv", h, tail_tree["embed"]["embedding"].astype(h.dtype))
+        else:
+            logits = jnp.einsum("bth,hv->btv", h, tail_tree["lm_head"]["kernel"].astype(h.dtype))
+            if cfg.lm_head_bias:
+                logits = logits + tail_tree["lm_head"]["bias"].astype(logits.dtype)
+        return logits
+
     # ---- sharding rules ---------------------------------------------------
     def tp_rules(self):
         """Megatron row/col sharding over the ``tensor`` axis (the training

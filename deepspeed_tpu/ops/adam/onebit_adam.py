@@ -25,14 +25,15 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ...runtime.comm.compressed import onebit_all_reduce
+from ...runtime.comm.compressed import chunk_len, onebit_all_reduce
 
 
 class OneBitAdamState(NamedTuple):
     count: jnp.ndarray
     m: optax.Updates
     v: optax.Updates
-    error: optax.Updates  # 1-bit compression error feedback, per worker
+    error: optax.Updates  # worker compression error feedback
+    server_error: optax.Updates  # server error on this worker's owned chunk
 
 
 class ZeroOneAdamState(NamedTuple):
@@ -41,37 +42,65 @@ class ZeroOneAdamState(NamedTuple):
     m: optax.Updates
     v: optax.Updates
     error: optax.Updates
+    server_error: optax.Updates
 
 
-def _init_onebit_state(params):
+def _group_size(axis_name):
+    """DP group size at trace/init time (the mesh is already installed when
+    the engine builds the optimizer; single-process tests default to 1)."""
+    from ...comm import comm as dist
+    if dist.has_mesh():
+        return int(dist.get_mesh().shape[axis_name])
+    return 1
+
+
+def _init_onebit_state(params, n):
     zeros = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
+    server = jax.tree_util.tree_map(
+        lambda p: jnp.zeros((chunk_len(_size(p), n), ), jnp.float32), params)
     return OneBitAdamState(count=jnp.zeros((), jnp.int32), m=zeros,
                            v=jax.tree_util.tree_map(jnp.copy, zeros),
-                           error=jax.tree_util.tree_map(jnp.copy, zeros))
+                           error=jax.tree_util.tree_map(jnp.copy, zeros),
+                           server_error=server)
+
+
+def _size(p):
+    out = 1
+    for d in p.shape:
+        out *= int(d)
+    return out
 
 
 def onebit_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
-                weight_decay=0.0, freeze_step=100):
+                weight_decay=0.0, freeze_step=100, group_size=None):
     """Build the transformation. ``learning_rate``: float or schedule(count).
     Apply with per-shard gradients inside ``shard_map``; updates come out
-    replicated across ``axis_name`` (all workers apply the same step)."""
+    replicated across ``axis_name`` (all workers apply the same step).
+    ``group_size``: DP group size (resolved from the mesh when omitted) —
+    sizes the server-error state of the two-phase compressed exchange."""
 
-    init = _init_onebit_state
+    def init(params):
+        return _init_onebit_state(params, group_size or _group_size(axis_name))
 
-    def _leaf_update(count, g, m, v, err):
+    def _leaf_update(count, g, m, v, err, serr):
         g = g.astype(jnp.float32)
 
         def warm(_):
             g_avg = jax.lax.pmean(g, axis_name)
             m2 = b1 * m + (1 - b1) * g_avg
             v2 = b2 * v + (1 - b2) * jnp.square(g_avg)
-            return m2, v2, err
+            return m2, v2, err, serr
 
         def compressed(_):
             m_local = b1 * m + (1 - b1) * g
-            m2, err2 = onebit_all_reduce(m_local, err, axis_name)
-            return m2, v, err2  # v frozen
+            m2, err2, serr2 = onebit_all_reduce(m_local, err, serr, axis_name)
+            return m2, v, err2, serr2  # v frozen
 
+        if freeze_step <= 0:
+            # static specialization: lax.cond compiles BOTH branches, so a
+            # never-taken warm branch would still put a dense fp32 pmean in
+            # the program (and in any wire-bytes audit of its HLO)
+            return compressed(None)
         # compression begins at step >= freeze_step (paper schedule)
         return jax.lax.cond(count < freeze_step, warm, compressed, None)
 
@@ -83,11 +112,12 @@ def onebit_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
         flat_m = jax.tree_util.tree_leaves(state.m)
         flat_v = jax.tree_util.tree_leaves(state.v)
         flat_e = jax.tree_util.tree_leaves(state.error)
-        new_m, new_v, new_e, upd = [], [], [], []
+        flat_s = jax.tree_util.tree_leaves(state.server_error)
+        new_m, new_v, new_e, new_s, upd = [], [], [], [], []
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
         flat_p = jax.tree_util.tree_leaves(params) if params is not None else [None] * len(flat_g)
-        for g, m, v, e, p in zip(flat_g, flat_m, flat_v, flat_e, flat_p):
-            m2, v2, e2 = _leaf_update(count, g, m, v, e)
+        for g, m, v, e, s, p in zip(flat_g, flat_m, flat_v, flat_e, flat_s, flat_p):
+            m2, v2, e2, s2 = _leaf_update(count, g, m, v, e, s)
             mhat = m2 / (1 - b1**count.astype(jnp.float32))
             vhat = v2 / (1 - b2**count.astype(jnp.float32))
             step = mhat / (jnp.sqrt(vhat) + eps)
@@ -96,17 +126,18 @@ def onebit_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
             new_m.append(m2)
             new_v.append(v2)
             new_e.append(e2)
+            new_s.append(s2)
             upd.append((-lr * step).astype(g.dtype))
         unf = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
         return unf(upd), OneBitAdamState(count=count, m=unf(new_m), v=unf(new_v),
-                                         error=unf(new_e))
+                                         error=unf(new_e), server_error=unf(new_s))
 
     return optax.GradientTransformation(init, update)
 
 
 def zero_one_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
                   weight_decay=0.0, var_freeze_step=100, var_update_scaler=16,
-                  local_step_scaler=1000, local_step_clipper=16):
+                  local_step_scaler=1000, local_step_clipper=16, group_size=None):
     """0/1 Adam (reference ``runtime/fp16/onebit/zoadam.py``; paper "0/1
     Adam: accelerating distributed training with adaptive compression"): the
     variance updates only at exponentially-spaced steps (doubling intervals
@@ -124,9 +155,10 @@ def zero_one_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
     del local_step_scaler, local_step_clipper  # parity knobs; see docstring
 
     def init(params):
-        base = _init_onebit_state(params)
+        base = _init_onebit_state(params, group_size or _group_size(axis_name))
         return ZeroOneAdamState(count=base.count, v_count=jnp.zeros((), jnp.int32),
-                                m=base.m, v=base.v, error=base.error)
+                                m=base.m, v=base.v, error=base.error,
+                                server_error=base.server_error)
 
     def _v_update_due(count):
         # doubling intervals: update at k, k + 2k, + 4k, ... until freeze
@@ -147,13 +179,14 @@ def zero_one_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
         flat_m = jax.tree_util.tree_leaves(state.m)
         flat_v = jax.tree_util.tree_leaves(state.v)
         flat_e = jax.tree_util.tree_leaves(state.error)
+        flat_s = jax.tree_util.tree_leaves(state.server_error)
         flat_p = jax.tree_util.tree_leaves(params) if params is not None else [None] * len(flat_g)
         lr = learning_rate(count) if callable(learning_rate) else learning_rate
-        new_m, new_v, new_e, upd = [], [], [], []
-        for g, m, v, e, p in zip(flat_g, flat_m, flat_v, flat_e, flat_p):
+        new_m, new_v, new_e, new_s, upd = [], [], [], [], []
+        for g, m, v, e, s, p in zip(flat_g, flat_m, flat_v, flat_e, flat_s, flat_p):
             g = g.astype(jnp.float32)
             m_local = b1 * m + (1 - b1) * g
-            m2, e2 = onebit_all_reduce(m_local, e, axis_name)
+            m2, e2, s2 = onebit_all_reduce(m_local, e, s, axis_name)
             # the dense gradient pmean only runs at the (exponentially rare)
             # due steps — cond, not where, so the wire stays compressed
             v2 = jax.lax.cond(
@@ -170,10 +203,12 @@ def zero_one_adam(learning_rate, axis_name, b1=0.9, b2=0.999, eps=1e-8,
             new_m.append(m2)
             new_v.append(v2)
             new_e.append(e2)
+            new_s.append(s2)
             upd.append((-lr * step).astype(g.dtype))
         unf = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
         return unf(upd), ZeroOneAdamState(count=count, v_count=v_count, m=unf(new_m),
-                                          v=unf(new_v), error=unf(new_e))
+                                          v=unf(new_v), error=unf(new_e),
+                                          server_error=unf(new_s))
 
     return optax.GradientTransformation(init, update)
 

@@ -1,14 +1,26 @@
-"""Compressed collectives: error-compensated 1-bit and int8 all-reduce.
+"""Compressed collectives: error-compensated 1-bit and int8 all-reduce with
+the narrow dtype ON THE WIRE.
 
 TPU-native analogue of the reference's compressed-communication backends
 (``runtime/comm/nccl.py:54`` / ``mpi.py:132`` ``compressed_allreduce``: 1-bit
 sign compression with error feedback over cupy+NCCL gather/allgather, used by
-the 1-bit Adam/LAMB optimizers). Design translation (SURVEY §2.2/§5): the
-wire format is what the collective exchanges, so compression = quantize →
-XLA collective on the narrow dtype → dequantize, inside ``shard_map`` over
-the data axis. On ICI the bandwidth win rarely pays for the quantization
-math (the engine's dense default); over DCN multislice it does — these
-primitives are the building blocks the 1-bit optimizers plug into.
+the 1-bit Adam/LAMB optimizers). The algorithm is the reference's two-phase
+gather scheme — a plain ``psum`` of ``scale * signs`` would put dense fp32
+back on the wire, which is exactly what these exist to avoid:
+
+  phase 1  each worker compresses its compensated tensor to (int8 sign
+           plane, fp32 scalar scale), chunks it n ways, and ``all_to_all``s
+           the chunks — worker i collects everyone's chunk i (int8 wire).
+  local    worker i averages its chunk: sum_j scale_j * sign_j / n.
+  phase 2  the averaged chunk is compressed AGAIN (server error feedback),
+           and the (int8 chunk, scalar) pairs are ``all_gather``ed so every
+           worker reconstructs the full result (int8 wire).
+
+Wire bytes per worker ~ 2 * size * (n-1)/n * 1 B vs ~ 2 * size * (n-1)/n *
+4 B for the dense fp32 ring all-reduce: a 4x reduction (8x vs the reference's
+fp32 grads; 2x vs a bf16 wire), matching the reference's
+compressed-chunk gather design. Both error feedbacks (worker + server) are
+carried by the caller, as in ``OnebitAdam`` (``fp16/onebit/adam.py``).
 
 Both functions are *collective* ops: call inside ``shard_map`` (or any
 manual-axes region) with ``axis_name`` bound.
@@ -18,42 +30,87 @@ import jax
 import jax.numpy as jnp
 
 
-def onebit_all_reduce(x, error, axis_name):
+def chunk_len(size, n):
+    """Per-worker chunk length for a flat tensor of ``size`` over ``n``
+    workers (the server-error leaf shape the optimizers carry)."""
+    return -(-size // n)
+
+
+def _to_chunks(flat, n, k):
+    pad = n * k - flat.shape[0]
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad, ), flat.dtype)])
+    return flat.reshape(n, k)
+
+
+def onebit_all_reduce(x, error, server_error, axis_name):
     """Error-compensated 1-bit averaged all-reduce (reference
     ``compressed_allreduce``).
 
-    Each worker sends only sign bits plus one fp32 scale: the compensated
-    tensor ``c = x + error`` is compressed to ``scale * sign(c)`` with
-    ``scale = mean(|c|)``; the average of the compressed tensors is the
-    result, and ``c - compressed`` carries to the next call as error
-    feedback. Returns ``(avg, new_error)``.
+    ``error``: worker error feedback, shape of ``x``. ``server_error``: server
+    error feedback for this worker's owned chunk, shape ``(chunk_len(x.size,
+    n),)``. Returns ``(avg, new_error, new_server_error)``. Only int8 planes
+    and scalar fp32 scales cross the wire.
     """
+    n = jax.lax.axis_size(axis_name)
     c = x.astype(jnp.float32) + error
     scale = jnp.mean(jnp.abs(c))
-    # int8 sign plane: 1/4 the bytes of f32 on the wire; the scale is a scalar
     signs = jnp.where(c >= 0, jnp.int8(1), jnp.int8(-1))
-    local_compressed = scale * signs.astype(jnp.float32)
-    new_error = c - local_compressed
-    # average of per-worker (scale_i * sign_i): psum the sign plane weighted
-    # by its scalar scale — communicated as (int8 plane, f32 scalar) pair
-    summed = jax.lax.psum(local_compressed, axis_name)
-    n = jax.lax.psum(jnp.ones((), jnp.float32), axis_name)
-    return summed / n, new_error
+    new_error = c - scale * signs.astype(jnp.float32)
+    if n == 1:
+        sc = c.reshape(-1) + server_error
+        s_scale = jnp.mean(jnp.abs(sc))
+        s_signs = jnp.where(sc >= 0, jnp.int8(1), jnp.int8(-1))
+        out = s_scale * s_signs.astype(jnp.float32)
+        return out.reshape(x.shape), new_error, sc - out
+
+    k = chunk_len(c.size, n)
+    # phase 1: int8 chunk exchange — worker i collects everyone's chunk i
+    recv = jax.lax.all_to_all(_to_chunks(signs.reshape(-1), n, k), axis_name,
+                              split_axis=0, concat_axis=0, tiled=True)  # (n, k) int8
+    scales = jax.lax.all_gather(scale, axis_name)  # (n,) fp32 scalars
+    avg_chunk = jnp.einsum("n,nk->k", scales, recv.astype(jnp.float32)) / n
+
+    # phase 2: compress the averaged chunk (server error feedback) + gather
+    sc = avg_chunk + server_error
+    s_scale = jnp.mean(jnp.abs(sc))
+    s_signs = jnp.where(sc >= 0, jnp.int8(1), jnp.int8(-1))
+    new_server_error = sc - s_scale * s_signs.astype(jnp.float32)
+    g_signs = jax.lax.all_gather(s_signs, axis_name)  # (n, k) int8 wire
+    g_scales = jax.lax.all_gather(s_scale, axis_name)  # (n,) fp32
+    full = (g_scales[:, None] * g_signs.astype(jnp.float32)).reshape(-1)
+    return full[:c.size].reshape(x.shape), new_error, new_server_error
 
 
 def quantized_all_reduce(x, axis_name, bits=8):
-    """Symmetric int-quantized averaged all-reduce.
+    """Symmetric int8-on-the-wire quantized averaged all-reduce.
 
-    A shared scale (global abs-max over the group) quantizes every worker's
-    tensor to ``bits``-bit integers; the integer psum is exact, so unlike the
-    1-bit path this needs no error feedback — precision loss is bounded by
-    one quantization step. Returns the dequantized average.
+    Two-phase like ``onebit_all_reduce`` but stateless: a group-shared scale
+    (abs-max) quantizes each worker's tensor to ``bits`` levels packed in
+    int8; chunk sums are exact in int32 locally; the averaged chunk is
+    requantized per-owner for the int8 gather. Precision loss is bounded by
+    two quantization steps (vs one for a dense wire) — the price of the 4x
+    wire saving. Returns the dequantized average.
     """
+    n = jax.lax.axis_size(axis_name)
     xf = x.astype(jnp.float32)
     qmax = 2.0**(bits - 1) - 1
     scale = jax.lax.pmax(jnp.max(jnp.abs(xf)), axis_name) / qmax
     scale = jnp.where(scale == 0, 1.0, scale)
-    q = jnp.clip(jnp.round(xf / scale), -qmax - 1, qmax).astype(jnp.int32)
-    total = jax.lax.psum(q, axis_name)
-    n = jax.lax.psum(jnp.ones((), jnp.float32), axis_name)
-    return (total.astype(jnp.float32) * scale / n).astype(x.dtype)
+    q = jnp.clip(jnp.round(xf / scale), -qmax - 1, qmax).astype(jnp.int8)
+    if n == 1:
+        return (q.astype(jnp.float32) * scale).astype(x.dtype)
+
+    k = chunk_len(xf.size, n)
+    recv = jax.lax.all_to_all(_to_chunks(q.reshape(-1), n, k), axis_name,
+                              split_axis=0, concat_axis=0, tiled=True)  # (n, k) int8
+    # exact int32 sum of n int8 chunks (|sum| <= n * 128 << 2^31)
+    avg_chunk = recv.astype(jnp.int32).sum(0).astype(jnp.float32) * scale / n
+
+    s_scale = jnp.max(jnp.abs(avg_chunk)) / qmax
+    s_scale = jnp.where(s_scale == 0, 1.0, s_scale)
+    q2 = jnp.clip(jnp.round(avg_chunk / s_scale), -qmax - 1, qmax).astype(jnp.int8)
+    g_q = jax.lax.all_gather(q2, axis_name)  # (n, k) int8 wire
+    g_scales = jax.lax.all_gather(s_scale, axis_name)  # (n,) fp32
+    full = (g_scales[:, None] * g_q.astype(jnp.float32)).reshape(-1)
+    return full[:xf.size].reshape(x.shape).astype(x.dtype)

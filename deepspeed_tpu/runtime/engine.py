@@ -170,24 +170,62 @@ class DeepSpeedEngine:
                                       "pipeline_parallel_size > 1")
         self.host_opt = None
 
+        # ---- ZeRO-Infinity parameter offload (streamed step) -------------
+        offp = self._config.zero_optimization.offload_param
+        self.offload_param = offp.device in ("cpu", "nvme")
+        self.param_stream = None
+        if self.offload_param:
+            if self._config.zero_optimization.stage != 3:
+                raise ValueError("offload_param requires zero stage 3 (reference "
+                                 "zero/stage3.py:463 configures param swapping under "
+                                 "stage 3 only)")
+            if self.mesh.shape[dist.PIPE_AXIS] > 1:
+                raise NotImplementedError("offload_param does not compose with "
+                                          "pipeline_parallel_size > 1")
+            if not hasattr(model, "stream_plan"):
+                raise ValueError("offload_param requires a model exposing the parameter "
+                                 "streaming protocol (stream_plan/stream_embed/stream_layer/"
+                                 "stream_tail_loss — deepspeed_tpu.models transformers do)")
+            if self.offload_optimizer:
+                log_dist("offload_param subsumes offload_optimizer: the streamed step keeps "
+                         "fp32 master + moments host-resident by construction", [0])
+                self.offload_optimizer = False
+
         # ---- params ------------------------------------------------------
         if model_parameters is None and hasattr(model, "init_params"):
             model_parameters = None  # initialized sharded below
         self._seed = self._config.seed if rng_seed is None else rng_seed
         self._base_rng = jax.random.key(self._seed)
-        params = self._init_params(model, model_parameters)
 
-        # ---- optimizer ---------------------------------------------------
-        self.lr_schedule_fn, self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
-        self._onebit = None  # set when a 1-bit/0-1 optimizer is configured
-        self.tx = self._configure_optimizer(optimizer)
+        if self.offload_param:
+            # params never materialize on device: the runner owns host blocks
+            # and the streamed step (no fused pjit state)
+            from .zero.param_offload import ParamStreamRunner
+            self.lr_schedule_fn, self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+            self._onebit = None
+            self.tx = None
+            self.param_stream = ParamStreamRunner(
+                model, self._config, self.mesh, self.planner, self.compute_dtype,
+                self.lr_schedule_fn, rng_seed=self._seed)
+            self.state_shardings = None
+            self.state = TrainState(step=jnp.zeros((), jnp.int32), params={}, opt_state={},
+                                    grad_acc={}, micro_step=jnp.zeros((), jnp.int32),
+                                    loss_scale=self.loss_scaler.init_state(),
+                                    skipped_steps=jnp.zeros((), jnp.int32))
+        else:
+            params = self._init_params(model, model_parameters)
 
-        # ---- state + shardings -------------------------------------------
-        self.state_shardings = None
-        if self.offload_optimizer:
-            params = self._init_host_optimizer(params)
-        self.state = self._init_state(params)
-        del params
+            # ---- optimizer -----------------------------------------------
+            self.lr_schedule_fn, self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+            self._onebit = None  # set when a 1-bit/0-1 optimizer is configured
+            self.tx = self._configure_optimizer(optimizer)
+
+            # ---- state + shardings ---------------------------------------
+            self.state_shardings = None
+            if self.offload_optimizer:
+                params = self._init_host_optimizer(params)
+            self.state = self._init_state(params)
+            del params
 
         # ---- curriculum learning + progressive layer drop ----------------
         # (legacy `curriculum_learning` section, reference engine.py:1663
@@ -966,6 +1004,25 @@ class DeepSpeedEngine:
         is the whole batch).
         """
         gas = self.gradient_accumulation_steps()
+        if self.param_stream is not None:
+            if batch is None:
+                it = data_iter if data_iter is not None else iter(self.training_dataloader)
+                micro = self._next_microbatches(it, gas)
+                batch = jax.tree_util.tree_map(lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
+                                               *micro)
+            self.tput_timer.start()
+            metrics = self.param_stream.train_batch(batch)
+            # overflow steps don't advance the runner's (or Adam's) counter;
+            # mirror it so checkpoints and the lr schedule stay in sync
+            self.global_steps = self.param_stream.global_steps
+            self.global_samples += self.train_batch_size()
+            self.micro_steps += gas
+            self._last_metrics = metrics
+            self.tput_timer.stop(global_step=True)
+            self._report(metrics)
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.last_batch_iteration = self.global_steps
+            return metrics["loss"]
         if batch is not None:
             # each feeding process supplies its share of the global batch
             # (single-controller: one process feeds everything)
@@ -1062,9 +1119,9 @@ class DeepSpeedEngine:
                 "the forward/backward/step facade is not supported under pipeline parallelism; "
                 "use train_batch() (the reference PipelineEngine likewise only supports "
                 "train_batch, pipe/engine.py:285)")
-        if self.offload_optimizer:
+        if self.offload_optimizer or self.param_stream is not None:
             raise RuntimeError("the forward/backward/step facade is not supported with "
-                               "offload_optimizer; use train_batch()")
+                               "offload_optimizer/offload_param; use train_batch()")
         if self._onebit:
             raise RuntimeError("the forward/backward/step facade is not supported with 1-bit "
                                "optimizers (the compressed exchange lives inside the fused "
@@ -1119,6 +1176,8 @@ class DeepSpeedEngine:
         return metrics
 
     def eval_batch(self, batch):
+        if self.param_stream is not None:
+            return jnp.asarray(self.param_stream.eval_batch(batch)["loss"])
         batch = self._shard_batch(batch)
         fn = self._get("eval", self._build_eval_fn)
         with self.mesh:
@@ -1262,6 +1321,20 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
             "ds_config": self._config.raw_config,
         })
+        if self.param_stream is not None:
+            # param offload: every block (master + moments) is host-resident;
+            # the runner writes them per block, plus the latest tag
+            tag_dir = os.path.join(save_dir, str(tag))
+            self.param_stream.save_checkpoint(tag_dir)
+            if save_latest and jax.process_index() == 0:
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(str(tag))
+            with open(os.path.join(tag_dir, "client_state.json"), "w") as f:
+                import json as _json
+                _json.dump({k: v for k, v in client_sd.items()
+                            if isinstance(v, (int, float, str, bool, dict, list, type(None)))}, f)
+            log_dist(f"saved param-offload checkpoint {save_dir}/{tag}", [0])
+            return True
         # grad_acc is in-flight facade scratch, not training state — always
         # checkpoint the canonical (empty) structure so resume works from
         # either API path (the reference likewise never checkpoints IPG
@@ -1285,6 +1358,28 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None):
         from .checkpoint_engine.engine import load_checkpoint as _load
+        if self.param_stream is not None:
+            from .checkpoint_engine.engine import get_latest_tag
+            tag_used = tag or get_latest_tag(load_dir)
+            if tag_used is None:
+                return None, None
+            tag_dir = os.path.join(os.path.abspath(load_dir), str(tag_used))
+            if not self.param_stream.load_checkpoint(tag_dir):
+                return None, None
+            client_sd = {}
+            cs = os.path.join(tag_dir, "client_state.json")
+            if os.path.isfile(cs):
+                import json as _json
+                with open(cs) as f:
+                    client_sd = _json.load(f)
+            self.global_steps = client_sd.get("global_steps", self.param_stream.global_steps)
+            self.param_stream.global_steps = self.global_steps
+            self.global_samples = client_sd.get("global_samples", 0)
+            self.micro_steps = client_sd.get("micro_steps", 0)
+            if load_lr_scheduler_states and self.lr_scheduler is not None and client_sd.get("lr_scheduler"):
+                self.lr_scheduler.load_state_dict(client_sd["lr_scheduler"])
+            self.loaded_checkpoint_tag = tag_used
+            return load_dir, client_sd
         state, client_sd = _load(load_dir, tag, self.state_shardings._replace(grad_acc={}), self.mesh,
                                  template=self.state._replace(grad_acc={}),
                                  load_optimizer_states=load_optimizer_states,

@@ -1,0 +1,172 @@
+"""ZeRO-Infinity parameter offload: the streamed step trains correctly,
+matches the fused on-device step numerically, checkpoints, and generates
+from streamed weights (ZeRO-Inference).
+
+Reference surface: ``runtime/swap_tensor/partitioned_param_swapper.py:36``,
+``runtime/zero/stage3.py:463``, ``docs/_posts/2022-09-10-zero-inference.md``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models import get_model
+
+
+def _cfg(extra_zero=None, **over):
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3, "offload_param": {"device": "cpu"},
+                                 **(extra_zero or {})},
+           "steps_per_print": 1000}
+    cfg.update(over)
+    return cfg
+
+
+def _batch(bs=8, T=32, seed=0):
+    return {"input_ids": np.random.default_rng(seed).integers(0, 256, (bs, T)).astype(np.int32)}
+
+
+def _engine(cfg, model=None):
+    comm._state["mesh"] = None
+    model = model or get_model("tiny")
+    e, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    return e, model
+
+
+def test_streamed_step_trains():
+    engine, _ = _engine(_cfg())
+    losses = [float(engine.train_batch(batch=_batch())) for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_streamed_matches_fused_step():
+    """Same params + batch: streamed loss/updated params == one fused-pjit
+    AdamW step (the reference's parity bar: swap must be numerics-neutral)."""
+    base_cfg = {"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000}
+    fused, _ = _engine(base_cfg)
+    host_params = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x), np.float32),
+                                         fused.state.params)
+
+    streamed, _ = _engine(_cfg())
+    streamed.param_stream.set_params_from_tree(host_params)
+
+    b = _batch()
+    l_fused = float(fused.train_batch(batch=b))
+    l_streamed = float(streamed.train_batch(batch=b))
+    assert abs(l_fused - l_streamed) < 2e-3, (l_fused, l_streamed)
+
+    # params after the step agree (streamed bf16-grad rounding tolerance);
+    # the TIED embedding must receive BOTH its vjp contributions (embed
+    # lookup + CE projection) — a dropped tail contribution shows up here
+    p_f = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x), np.float32),
+                                 fused.state.params)
+    p_s = streamed.param_stream.get_params_tree()
+    flat_f = {jax.tree_util.keystr(p): v for p, v in
+              jax.tree_util.tree_flatten_with_path(p_f)[0]}
+    flat_s = {jax.tree_util.keystr(p): v for p, v in
+              jax.tree_util.tree_flatten_with_path(p_s)[0]}
+    assert flat_f.keys() == flat_s.keys()
+    for k in flat_f:
+        np.testing.assert_allclose(flat_s[k], flat_f[k], atol=2e-3, err_msg=k)
+
+
+def test_gradient_accumulation():
+    engine, _ = _engine(_cfg(train_batch_size=16, gradient_accumulation_steps=2))
+    losses = [float(engine.train_batch(batch=_batch(bs=16))) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    engine, _ = _engine(_cfg())
+    b = _batch()
+    for _ in range(2):
+        engine.train_batch(batch=b)
+    ref_next = float(engine.eval_batch(b))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+
+    fresh, _ = _engine(_cfg())
+    load_dir, client = fresh.load_checkpoint(str(tmp_path))
+    assert load_dir is not None
+    assert fresh.global_steps == 2
+    got = fresh.param_stream.eval_batch(b)["loss"]
+    np.testing.assert_allclose(got, ref_next, atol=1e-4)
+    # moments restored: the next step matches the original's next step
+    l1 = float(engine.train_batch(batch=b))
+    l2 = float(fresh.train_batch(batch=b))
+    np.testing.assert_allclose(l2, l1, atol=1e-3)
+
+
+def test_zero_inference_generate_matches_dense():
+    """Streamed greedy decode == full-model greedy decode (same params)."""
+    engine, model = _engine(_cfg())
+    params = jax.tree_util.tree_map(jnp.asarray, engine.param_stream.get_params_tree())
+    ids = _batch(bs=2, T=8)["input_ids"]
+    out = engine.param_stream.generate(ids, max_new_tokens=5)
+    assert out.shape == (2, 13)
+
+    # dense greedy reference via the plain forward path
+    cur = np.asarray(ids)
+    for _ in range(5):
+        logits = np.asarray(model.apply(params, jnp.asarray(cur)))
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, cur)
+
+
+def test_nvme_tier_parity(tmp_path):
+    """nvme param store steps identically to the cpu store."""
+    cpu_e, _ = _engine(_cfg())
+    host_params = cpu_e.param_stream.get_params_tree()
+
+    nvme_e, _ = _engine(_cfg(extra_zero={
+        "offload_param": {"device": "nvme", "nvme_path": str(tmp_path)}}))
+    nvme_e.param_stream.set_params_from_tree(host_params)
+
+    b = _batch()
+    l_cpu = float(cpu_e.train_batch(batch=b))
+    l_nvme = float(nvme_e.train_batch(batch=b))
+    np.testing.assert_allclose(l_nvme, l_cpu, atol=1e-4)
+    p_c = cpu_e.param_stream.get_params_tree()
+    p_n = nvme_e.param_stream.get_params_tree()
+    for a, b_ in zip(jax.tree_util.tree_leaves(p_c), jax.tree_util.tree_leaves(p_n)):
+        np.testing.assert_allclose(b_, a, atol=1e-5)
+
+
+def test_streamed_multichip_layout():
+    """tensor=2 x data=4 mesh: streamed blocks shard over TP, batch over DP;
+    the step runs and trains (the dryrun shape for param offload)."""
+    comm._state["mesh"] = None
+    comm.initialize_mesh(tensor=2)
+    model = get_model("tiny")
+    cfg = _cfg()
+    e, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    losses = [float(e.train_batch(batch=_batch())) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    comm._state["mesh"] = None
+
+
+def test_facade_rejected():
+    engine, _ = _engine(_cfg())
+    with pytest.raises(RuntimeError, match="offload_param"):
+        engine.forward(_batch())
+
+
+def test_requires_stage3():
+    comm._state["mesh"] = None
+    with pytest.raises(ValueError, match="stage 3"):
+        deepspeed_tpu.initialize(
+            model=get_model("tiny"),
+            config={"train_batch_size": 8,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2, "offload_param": {"device": "cpu"}}},
+            rng_seed=0)
