@@ -12,8 +12,16 @@ dead worker, so the resource manager/log scraping machinery
 
 Config surface (``autotuning`` section, reference key names):
 ``enabled``, ``metric`` ("throughput"), ``tuner_type`` ("gridsearch" |
-"random"), ``max_trials``, plus the TPU search dims ``micro_batch_sizes``,
-``zero_stages``, ``remat_policies``.
+"random" | "model_based"), ``max_trials``, plus the TPU search dims
+``micro_batch_sizes``, ``zero_stages``, ``remat_policies``.
+
+``model_based`` is the reference's SMBO tuner
+(``autotuning/tuner/model_based_tuner.py`` + ``cost_model.py``): seed with a
+few random trials, fit a cost model over config features, then repeatedly
+run the untried candidate the model predicts fastest and refit. The
+reference's XGBoost cost model becomes a ridge regression on log-throughput
+(``CostModel``) — the same exploit-the-surrogate loop without the
+dependency.
 """
 
 import itertools
@@ -21,7 +29,36 @@ import json
 import random
 import time
 
+import numpy as np
+
 from ..utils.logging import log_dist, logger
+
+
+class CostModel:
+    """Ridge regression over candidate features -> log throughput
+    (reference ``autotuning/tuner/cost_model.py`` XGBoostCostModel)."""
+
+    def __init__(self, ridge=1e-3):
+        self.ridge = ridge
+        self.w = None
+        self._cats = None
+
+    def _featurize(self, cand, cats):
+        micro_bs, stage, remat = cand
+        f = [1.0, float(np.log2(max(micro_bs, 1))), float(stage), float(stage == 3)]
+        f += [1.0 if remat == c else 0.0 for c in cats]
+        return f
+
+    def fit(self, candidates, throughputs):
+        self._cats = sorted({c[2] for c in candidates}, key=str)
+        X = np.asarray([self._featurize(c, self._cats) for c in candidates], np.float64)
+        y = np.log(np.asarray(throughputs, np.float64))
+        A = X.T @ X + self.ridge * np.eye(X.shape[1])
+        self.w = np.linalg.solve(A, X.T @ y)
+
+    def predict(self, candidates):
+        X = np.asarray([self._featurize(c, self._cats) for c in candidates], np.float64)
+        return np.exp(X @ self.w)
 
 
 class Autotuner:
@@ -86,27 +123,71 @@ class Autotuner:
         dt = time.perf_counter() - t0
         return engine.train_batch_size() * self.steps_per_trial / dt
 
+    def _measure(self, cand, best):
+        micro_bs, stage, remat = cand
+        cfg = self._trial_config(micro_bs, stage, remat)
+        label = f"micro_bs={micro_bs} zero={stage} remat={remat}"
+        try:
+            samples_per_sec = self._run_trial(cfg)
+        except Exception as e:  # RESOURCE_EXHAUSTED, bad combos, ...
+            logger.warning(f"autotuner: trial {label} failed: {type(e).__name__}: {e}")
+            self.results.append({"config": label, "samples_per_sec": None})
+            return best, None
+        self.results.append({"config": label, "samples_per_sec": round(samples_per_sec, 2)})
+        log_dist(f"autotuner: {label} -> {samples_per_sec:.1f} samples/s", [0])
+        if best is None or samples_per_sec > best[1]:
+            best = (cfg, samples_per_sec)
+        return best, samples_per_sec
+
     def tune(self):
-        """Run all trials; returns (best_config, best_metric). OOM/compile
+        """Run trials; returns (best_config, best_metric). OOM/compile
         failures score None and are skipped (reference marks them
-        'untunable')."""
+        'untunable'). ``model_based`` explores with a surrogate: after a few
+        seed trials it always measures the candidate the cost model predicts
+        fastest, usually covering the best point in far fewer trials than
+        the grid."""
+        if self.tuner_type == "model_based":
+            return self._tune_model_based()
         best = None
-        for micro_bs, stage, remat in self.candidates():
-            cfg = self._trial_config(micro_bs, stage, remat)
-            label = f"micro_bs={micro_bs} zero={stage} remat={remat}"
-            try:
-                samples_per_sec = self._run_trial(cfg)
-            except Exception as e:  # RESOURCE_EXHAUSTED, bad combos, ...
-                logger.warning(f"autotuner: trial {label} failed: {type(e).__name__}: {e}")
-                self.results.append({"config": label, "samples_per_sec": None})
-                continue
-            self.results.append({"config": label, "samples_per_sec": round(samples_per_sec, 2)})
-            log_dist(f"autotuner: {label} -> {samples_per_sec:.1f} samples/s", [0])
-            if best is None or samples_per_sec > best[1]:
-                best = (cfg, samples_per_sec)
+        for cand in self.candidates():
+            best, _ = self._measure(cand, best)
         if best is None:
             raise RuntimeError("autotuner: every trial failed")
         log_dist(f"autotuner: best = {json.dumps(self.results, default=str)}", [0])
+        return best
+
+    def _tune_model_based(self):
+        space = list(itertools.product(self.micro_batch_sizes, self.zero_stages,
+                                       self.remat_policies))
+        budget = self.max_trials or max(3, len(space) // 2)
+        rnd = random.Random(0)
+        rnd.shuffle(space)
+        n_seed = min(3, budget, len(space))
+        measured, tried = [], []
+        best = None
+        for cand in space[:n_seed]:
+            best, thr = self._measure(cand, best)
+            tried.append(cand)
+            if thr is not None:
+                measured.append((cand, thr))
+        remaining = [c for c in space if c not in tried]
+        model = CostModel()
+        while remaining and len(tried) < budget:
+            if len(measured) >= 2:
+                model.fit(*zip(*measured))
+                pred = model.predict(remaining)
+                cand = remaining[int(np.argmax(pred))]
+            else:
+                cand = remaining[0]
+            remaining.remove(cand)
+            tried.append(cand)
+            best, thr = self._measure(cand, best)
+            if thr is not None:
+                measured.append((cand, thr))
+        if best is None:
+            raise RuntimeError("autotuner: every trial failed")
+        log_dist(f"autotuner(model_based): {len(tried)}/{len(space) + 0} trials, "
+                 f"best = {json.dumps(self.results, default=str)}", [0])
         return best
 
     def write_results(self, path):

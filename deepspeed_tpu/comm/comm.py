@@ -161,6 +161,18 @@ def init_distributed(dist_backend="xla",
     coord = os.environ.get("COORDINATOR_ADDRESS") or os.environ.get("JAX_COORDINATOR_ADDRESS")
     n_proc = os.environ.get("JAX_NUM_PROCESSES") or os.environ.get("WORLD_SIZE")
     proc_id = os.environ.get("JAX_PROCESS_ID") or os.environ.get("RANK")
+    if proc_id is None and auto_mpi_discovery:
+        # MPI/Slurm launcher rank discovery (reference comm.py:591
+        # mpi_discovery): OpenMPI, hydra/MPICH/MVAPICH, srun
+        for k in ("OMPI_COMM_WORLD_RANK", "PMI_RANK", "SLURM_PROCID"):
+            if k in os.environ:
+                proc_id = os.environ[k]
+                break
+    if n_proc is None and auto_mpi_discovery:
+        for k in ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "SLURM_NTASKS"):
+            if k in os.environ:
+                n_proc = os.environ[k]
+                break
     if coord is None and os.environ.get("MASTER_ADDR"):
         # torch/DeepSpeed-launcher style rendezvous env
         coord = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', distributed_port)}"

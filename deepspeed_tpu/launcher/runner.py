@@ -143,6 +143,13 @@ def parse_args(argv=None):
     parser.add_argument("--ssh_port", type=int, default=None)
     parser.add_argument("--force_multi", action="store_true",
                         help="use ssh launch even for one host")
+    parser.add_argument("--launcher", default="ssh",
+                        choices=["ssh", "pdsh", "openmpi", "mpich", "mvapich", "slurm"],
+                        help="multinode backend (reference multinode_runner.py variants); "
+                             "'ssh' is the built-in loop")
+    parser.add_argument("--launcher_args", default="",
+                        help="extra args appended to the backend command (parity knob)")
+    parser.add_argument("--slurm_comment", default="", help="srun --comment value")
     parser.add_argument("--elastic", action="store_true",
                         help="supervise workers and relaunch on failure/preemption "
                              "(workers auto-resume from the latest checkpoint)")
@@ -212,6 +219,18 @@ def main(argv=None):
         logger.info(f"single-host launch: {' '.join(argv)}")
         os.execvpe(argv[0], argv, env)  # replaces this process
         return  # unreachable
+
+    if args.launcher != "ssh":
+        # backend runners build ONE command that fans out (reference
+        # multinode_runner.get_cmd); rank discovery happens in
+        # comm.init_distributed from the backend's env
+        from .multinode_runner import get_runner
+        runner = get_runner(args.launcher, args, {h: 1 for h in hosts})
+        cmd, env = runner.get_cmd(dict(os.environ), hosts)
+        if args.launcher_args:
+            cmd = cmd[:1] + shlex.split(args.launcher_args) + cmd[1:]
+        logger.info(f"{args.launcher} launch: {' '.join(cmd)}")
+        sys.exit(subprocess.call(cmd, env=env))
 
     cmds = build_host_commands(hosts, coordinator, args.master_port, args.user_script,
                                args.user_args, env_passthrough=_ENV_PASSTHROUGH)

@@ -31,7 +31,8 @@ class BertConfig:
     num_layers: int = 12
     num_heads: int = 12
     max_seq_len: int = 512
-    type_vocab_size: int = 2
+    type_vocab_size: int = 2  # 0: no token-type embeddings (DistilBERT)
+    pooler: bool = True  # False: no [CLS] pooler head (DistilBERT)
     layernorm_epsilon: float = 1e-12
     activation: str = "gelu_exact"  # HF "gelu" = erf
     dtype: Any = jnp.float32
@@ -77,10 +78,11 @@ class BertEncoder(nn.Module):
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (cfg.max_seq_len, cfg.hidden_size), jnp.float32)
         emb = emb + pos[:T].astype(cfg.dtype)
-        types = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
-        emb = emb + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
-                             embedding_init=nn.initializers.normal(0.02),
-                             name="type_embed")(types)
+        if cfg.type_vocab_size > 0:
+            types = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
+            emb = emb + nn.Embed(cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+                                 embedding_init=nn.initializers.normal(0.02),
+                                 name="type_embed")(types)
         x = nn.LayerNorm(epsilon=cfg.layernorm_epsilon, dtype=cfg.dtype,
                          param_dtype=jnp.float32, name="embed_norm")(emb)
         if attention_mask is not None:
@@ -89,6 +91,8 @@ class BertEncoder(nn.Module):
             mask_bias = jnp.zeros((1, 1, 1, T), jnp.float32)
         for i in range(cfg.num_layers):
             x = BertLayer(cfg, name=f"layer_{i}")(x, mask_bias)
+        if not cfg.pooler:
+            return x, x[:, 0]  # DistilBERT: no pooler head; [CLS] hidden state
         pooled = nn.tanh(nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
                                   name="pooler")(x[:, 0]))
         return x, pooled

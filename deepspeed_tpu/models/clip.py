@@ -1,0 +1,78 @@
+"""CLIP text encoder.
+
+Counterpart of the reference's CLIP serving surface
+(``module_inject/containers/clip.py`` + ``model_implementations/...
+DSClipEncoder``): the text tower of CLIP — a CAUSAL pre-norm transformer
+(HF ``CLIPTextModel``) with learned positions, QuickGELU MLPs, a final
+LayerNorm, EOS-token pooling and the ``text_projection`` head that produces
+the embedding CLIP scores against images.
+
+TPU-first: the tower reuses the causal zoo's ``CausalLM`` machinery
+(``return_hidden``), so flash attention / TP sharding / compression hooks
+all apply unchanged; only the pooling + projection are CLIP-specific.
+"""
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import CausalLM, TransformerConfig
+
+
+def clip_text_config(hidden=512, layers=12, heads=8, ffn=2048, vocab=49408, seq=77,
+                     **overrides):
+    kw = dict(vocab_size=vocab, hidden_size=hidden, num_layers=layers, num_heads=heads,
+              intermediate_size=ffn, max_seq_len=seq, pos_embedding="learned",
+              norm="layernorm", activation="quick_gelu", tie_embeddings=False,
+              lm_head_bias=False)
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+class ClipTextModel:
+    """Engine-facing wrapper: forward returns (last_hidden_state,
+    pooled_text_embeds) — HF ``CLIPTextModelWithProjection`` parity."""
+
+    def __init__(self, cfg: TransformerConfig, projection_dim=None):
+        self.cfg = cfg
+        self.projection_dim = projection_dim or cfg.hidden_size
+        # drop the LM head: the tower ends at final_norm (return_hidden)
+        self.module = CausalLM(dataclasses.replace(cfg, tie_embeddings=True))
+
+    def init_params(self, rng):
+        ids = jnp.zeros((2, min(self.cfg.max_seq_len, 16)), jnp.int32)
+        params = dict(self.module.init({"params": rng}, ids)["params"])
+        params["text_projection"] = {
+            "kernel": jax.random.normal(jax.random.fold_in(rng, 1),
+                                        (self.cfg.hidden_size, self.projection_dim),
+                                        jnp.float32) * 0.02}
+        return params
+
+    def apply(self, params, input_ids, attention_mask=None):
+        enc = {k: v for k, v in params.items() if k != "text_projection"}
+        hidden = self.module.apply({"params": enc}, input_ids, attention_mask,
+                                   True, return_hidden=True)
+        # CLIP pools the EOS position = the highest token id (eot_token is
+        # the largest id in CLIP's vocab; HF does argmax the same way)
+        eos = jnp.argmax(input_ids, axis=-1)
+        pooled = hidden[jnp.arange(hidden.shape[0]), eos]
+        proj = pooled.astype(jnp.float32) @ params["text_projection"]["kernel"]
+        return hidden, proj.astype(hidden.dtype)
+
+    def apply_with_cache(self, *a, **kw):
+        raise NotImplementedError("CLIP text tower is an embedder: no generate path")
+
+    def init_cache(self, *a, **kw):
+        raise NotImplementedError("CLIP text tower is an embedder: no KV cache")
+
+    def tp_rules(self):
+        from ..comm import comm as dist
+        from .transformer import CausalLMModel
+        t = dist.TENSOR_AXIS
+        rules = CausalLMModel(self.cfg).tp_rules()
+        return rules + [(r"text_projection/kernel", (None, t))]
+
+    def expert_pattern(self):
+        return None

@@ -593,6 +593,8 @@ class MLP(nn.Module):
                 h = nn.gelu(h)  # tanh approximation (HF "gelu_new")
             elif cfg.activation == "gelu_exact":
                 h = nn.gelu(h, approximate=False)  # erf (HF "gelu")
+            elif cfg.activation == "quick_gelu":
+                h = h * nn.sigmoid(1.702 * h)  # CLIP's QuickGELU
             else:
                 h = nn.relu(h)
         return dense(cfg.hidden_size, name="down_proj")(h)
@@ -933,29 +935,49 @@ class CausalLMModel:
         block_mod = Block(cfg)
         dropout_on = rng is not None and cfg.dropout > 0
 
+        moe = cfg.num_experts > 0
+
         def stage_fn(local_layers, h_in, t):
             # h_in: activation, or (activation, mask) when the batch is padded
             h, mask = h_in if isinstance(h_in, tuple) else (h_in, None)
             n_layers = jax.tree_util.tree_leaves(local_layers)[0].shape[0]
 
-            def body(h, layer):
+            def body(carry, layer):
+                h, aux_acc = carry
                 lp, li = layer
                 kw = {"deterministic": True}
                 if dropout_on:
                     # decorrelate dropout per (pipeline step, global layer)
                     kw = {"deterministic": False,
                           "rngs": {"dropout": jax.random.fold_in(jax.random.fold_in(rng, t), li)}}
-                y, _ = block_mod.apply({"params": lp}, h, sin, cos, mask, **kw)
-                return y, None
+                if moe:
+                    # capture the MoE load-balancing aux loss sown by the
+                    # block — the pipeline's aux channel carries it out
+                    (y, _), mut = block_mod.apply({"params": lp}, h, sin, cos, mask,
+                                                  mutable=["intermediates"], **kw)
+                    aux_leaves = jax.tree_util.tree_leaves(mut.get("intermediates", {}))
+                    aux_acc = aux_acc + sum(jnp.sum(a) for a in aux_leaves)
+                else:
+                    y, _ = block_mod.apply({"params": lp}, h, sin, cos, mask, **kw)
+                return (y, aux_acc), None
 
             stage = jax.lax.axis_index(dist.PIPE_AXIS) if dist.in_manual_region() else 0
             global_idx = stage * n_layers + jnp.arange(n_layers)
-            h, _ = jax.lax.scan(body, h, (local_layers, global_idx))
-            return (h, mask) if mask is not None else h
+            aux0 = jnp.zeros((), jnp.float32)
+            if dist.in_manual_region():
+                # the aux carry becomes stage-varying inside the scan; mark
+                # its initial value so the carry types agree (shard_map vma)
+                aux0 = jax.lax.pvary(aux0, tuple(dist.get_manual_axes()))
+            (h, aux), _ = jax.lax.scan(body, (h, aux0), (local_layers, global_idx))
+            out = (h, mask) if mask is not None else h
+            return (out, aux) if moe else out
 
         x_stream = (x, attn_mask) if attn_mask is not None else x
         stream = spmd_pipeline(stage_fn, params["layers"], x_stream, mesh=mesh,
-                               remat=bool(cfg.remat_policy))
+                               remat=bool(cfg.remat_policy), with_aux=moe)
+        aux_total = jnp.zeros((), jnp.float32)
+        if moe:
+            stream, aux_total = stream
         if attn_mask is not None:
             stream = stream[0]
 
@@ -978,14 +1000,19 @@ class CausalLMModel:
                                           w, labels_c.reshape(M * b, -1),
                                           valid.reshape(M * b, -1),
                                           chunk=self._ce_chunk(), transpose=transpose)
-            return total / jnp.maximum(jnp.sum(valid), 1)
-        import optax
-        eq = "mbth,vh->mbtv" if transpose else "mbth,hv->mbtv"
-        logits = jnp.einsum(eq, stream[:, :, shift], w.astype(stream.dtype))
-        if cfg.lm_head_bias:
-            logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
-        ce = optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32), labels_c)
-        return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
+            ce_mean = total / jnp.maximum(jnp.sum(valid), 1)
+        else:
+            import optax
+            eq = "mbth,vh->mbtv" if transpose else "mbth,hv->mbtv"
+            logits = jnp.einsum(eq, stream[:, :, shift], w.astype(stream.dtype))
+            if cfg.lm_head_bias:
+                logits = logits + params["lm_head"]["bias"].astype(logits.dtype)
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits.astype(jnp.float32),
+                                                                 labels_c)
+            ce_mean = jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
+        # aux_total sums per-microbatch aux over the stream; /M matches the
+        # non-pipelined per-microbatch mean the engine averages over gas
+        return ce_mean + cfg.moe_aux_loss_coef * aux_total / M
 
     def pipeline_pattern(self):
         """Regex of params whose leading (layer) dim shards over ``pipe``."""

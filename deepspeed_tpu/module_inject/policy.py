@@ -55,6 +55,11 @@ class InjectionPolicy:
     def build_config(self, hf, **overrides):
         raise NotImplementedError
 
+    def build_model(self, cfg):
+        """Instantiate the serving model (override when the model takes more
+        than the config, e.g. CLIP's projection_dim)."""
+        return self.model_class(cfg)
+
     def convert(self, get, cfg):
         """``get(name) -> np.float32 ndarray``; returns the params pytree
         (layers stacked along axis 0 when ``cfg.scan_layers``)."""
@@ -586,6 +591,173 @@ class BertPolicy(InjectionPolicy):
         return params
 
 
+class DistilBertPolicy(InjectionPolicy):
+    """DistilBERT (reference ``containers/distil_bert.py``): BERT-family
+    post-norm encoder without token-type embeddings or pooler; HF names the
+    projections q_lin/k_lin/v_lin/out_lin and the MLPs lin1/lin2."""
+
+    architectures = ("DistilBertModel", "DistilBertForMaskedLM",
+                     "DistilBertForSequenceClassification")
+    model_types = ("distilbert", )
+
+    @property
+    def model_class(self):
+        from ..models.bert import BertEncoderModel
+        return BertEncoderModel
+
+    def build_config(self, hf, **overrides):
+        from ..models.bert import BertConfig
+        act = getattr(hf, "activation", "gelu")
+        act_map = {"gelu": "gelu_exact", "relu": "relu"}
+        if act not in act_map:
+            raise ValueError(f"DistilBERT activation={act!r} unsupported")
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.dim,
+            intermediate_size=hf.hidden_dim,
+            num_layers=hf.n_layers,
+            num_heads=hf.n_heads,
+            max_seq_len=hf.max_position_embeddings,
+            type_vocab_size=0,
+            pooler=False,
+            activation=act_map[act],
+            layernorm_epsilon=1e-12,
+        )
+        kw.update(overrides)
+        return BertConfig(**kw)
+
+    def convert(self, get, cfg):
+        nh, hd = cfg.num_heads, cfg.head_size
+
+        def g(name):
+            for pre in ("", "distilbert."):
+                try:
+                    return get(pre + name)
+                except KeyError:
+                    continue
+            raise KeyError(name)
+
+        def lin_in(name, n):
+            return {"kernel": _heads_in(_t(g(name + ".weight")), n, hd),
+                    "bias": g(name + ".bias").reshape(n, hd)}
+
+        params = {
+            "embed": {"embedding": g("embeddings.word_embeddings.weight")},
+            "pos_embed": g("embeddings.position_embeddings.weight"),
+            "embed_norm": {"scale": g("embeddings.LayerNorm.weight"),
+                           "bias": g("embeddings.LayerNorm.bias")},
+        }
+        for i in range(cfg.num_layers):
+            q = f"transformer.layer.{i}."
+            params[f"layer_{i}"] = {
+                "q_proj": lin_in(q + "attention.q_lin", nh),
+                "k_proj": lin_in(q + "attention.k_lin", nh),
+                "v_proj": lin_in(q + "attention.v_lin", nh),
+                "o_proj": {"kernel": _heads_out(_t(g(q + "attention.out_lin.weight")), nh, hd),
+                           "bias": g(q + "attention.out_lin.bias")},
+                "attn_norm": {"scale": g(q + "sa_layer_norm.weight"),
+                              "bias": g(q + "sa_layer_norm.bias")},
+                "up_proj": {"kernel": _t(g(q + "ffn.lin1.weight")),
+                            "bias": g(q + "ffn.lin1.bias")},
+                "down_proj": {"kernel": _t(g(q + "ffn.lin2.weight")),
+                              "bias": g(q + "ffn.lin2.bias")},
+                "mlp_norm": {"scale": g(q + "output_layer_norm.weight"),
+                             "bias": g(q + "output_layer_norm.bias")},
+            }
+        return params
+
+
+class CLIPTextPolicy(InjectionPolicy):
+    """CLIP text tower (reference ``containers/clip.py`` + ``DSClipEncoder``,
+    ``model_implementations/features/cuda_graph.py``): causal pre-norm
+    encoder with QuickGELU, final LN, EOS pooling + text projection. The
+    vision tower is out of scope (the reference's container also only fuses
+    the text transformer's attention)."""
+
+    architectures = ("CLIPModel", "CLIPTextModel", "CLIPTextModelWithProjection")
+    model_types = ("clip", "clip_text_model")
+
+    @property
+    def model_class(self):
+        from ..models.clip import ClipTextModel
+        return ClipTextModel
+
+    def build_config(self, hf, **overrides):
+        from ..models.clip import clip_text_config
+        txt = getattr(hf, "text_config", hf)  # CLIPModel nests the text config
+        act = getattr(txt, "hidden_act", "quick_gelu")
+        act_map = {"quick_gelu": "quick_gelu", "gelu": "gelu_exact"}
+        kw = dict(
+            vocab=txt.vocab_size,
+            hidden=txt.hidden_size,
+            ffn=txt.intermediate_size,
+            layers=txt.num_hidden_layers,
+            heads=txt.num_attention_heads,
+            seq=txt.max_position_embeddings,
+            activation=act_map.get(act, "quick_gelu"),
+            layernorm_epsilon=float(getattr(txt, "layer_norm_eps", 1e-5)),
+        )
+        kw.update(overrides)
+        self._projection_dim = getattr(hf, "projection_dim", txt.hidden_size)
+        return clip_text_config(**kw)
+
+    def build_model(self, cfg):
+        from ..models.clip import ClipTextModel
+        return ClipTextModel(cfg, projection_dim=self._projection_dim)
+
+    def convert(self, get, cfg):
+        nh, hd = cfg.num_heads, cfg.head_size
+
+        def g(name):
+            for pre in ("", "text_model.", "clip.text_model."):
+                try:
+                    return get(pre + name)
+                except KeyError:
+                    continue
+            raise KeyError(name)
+
+        def lin_in(name, n):
+            return {"kernel": _heads_in(_t(g(name + ".weight")), n, hd),
+                    "bias": g(name + ".bias").reshape(n, hd)}
+
+        def layer(i):
+            q = f"encoder.layers.{i}."
+            return {
+                "attn": {
+                    "q_proj": lin_in(q + "self_attn.q_proj", nh),
+                    "k_proj": lin_in(q + "self_attn.k_proj", nh),
+                    "v_proj": lin_in(q + "self_attn.v_proj", nh),
+                    "o_proj": {"kernel": _heads_out(_t(g(q + "self_attn.out_proj.weight")),
+                                                    nh, hd),
+                               "bias": g(q + "self_attn.out_proj.bias")},
+                },
+                "attn_norm": {"scale": g(q + "layer_norm1.weight"),
+                              "bias": g(q + "layer_norm1.bias")},
+                "mlp": {"up_proj": {"kernel": _t(g(q + "mlp.fc1.weight")),
+                                    "bias": g(q + "mlp.fc1.bias")},
+                        "down_proj": {"kernel": _t(g(q + "mlp.fc2.weight")),
+                                      "bias": g(q + "mlp.fc2.bias")}},
+                "mlp_norm": {"scale": g(q + "layer_norm2.weight"),
+                             "bias": g(q + "layer_norm2.bias")},
+            }
+
+        top = {
+            "embed": {"embedding": g("embeddings.token_embedding.weight")},
+            "pos_embed": g("embeddings.position_embedding.weight"),
+            "final_norm": {"scale": g("final_layer_norm.weight"),
+                           "bias": g("final_layer_norm.bias")},
+        }
+        try:
+            top["text_projection"] = {"kernel": _t(get("text_projection.weight"))}
+        except KeyError:
+            # projection-less CLIPTextModel: identity head — build_model
+            # (called after convert) must size the head accordingly, whatever
+            # projection_dim the config advertises
+            self._projection_dim = cfg.hidden_size
+            top["text_projection"] = {"kernel": np.eye(cfg.hidden_size, dtype=np.float32)}
+        return self._assemble(cfg, top, layer)
+
+
 class MegatronPolicy(InjectionPolicy):
     """Megatron-LM GPT checkpoints (reference ``containers/megatron_gpt.py`` +
     ``MegatronSDLoader``'s key conventions): fused blocked [q;k;v] attention
@@ -672,14 +844,16 @@ class MegatronPolicy(InjectionPolicy):
 
 
 replace_policies = [LlamaPolicy, MixtralPolicy, GPT2Policy, OPTPolicy, BloomPolicy,
-                    GPTJPolicy, GPTNeoXPolicy, BertPolicy, MegatronPolicy]
+                    GPTJPolicy, GPTNeoXPolicy, BertPolicy, DistilBertPolicy,
+                    CLIPTextPolicy, MegatronPolicy]
 
 
 def get_policy(hf_config):
     # Mixtral before Llama: both match model_type prefixes via architectures;
     # MegatronPolicy last — it matches only to raise its routing explanation
     for cls in (MixtralPolicy, LlamaPolicy, GPT2Policy, OPTPolicy, BloomPolicy,
-                GPTJPolicy, GPTNeoXPolicy, BertPolicy, MegatronPolicy):
+                GPTJPolicy, GPTNeoXPolicy, BertPolicy, DistilBertPolicy,
+                CLIPTextPolicy, MegatronPolicy):
         if cls.matches(hf_config):
             return cls()
     raise ValueError(

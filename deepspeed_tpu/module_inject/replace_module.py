@@ -85,7 +85,7 @@ def inject_hf_model(model_or_path, hf_config=None, dtype=None, **overrides):
     params = policy.convert(loader.get, cfg)
     loader.close()
     params = jax.tree_util.tree_map(lambda x: np.asarray(x, np.float32), params)
-    model = policy.model_class(cfg)  # CausalLMModel, or e.g. BertEncoderModel
+    model = policy.build_model(cfg)  # CausalLMModel, BertEncoderModel, ClipTextModel, ...
     _check_tree(model, params)
     return model, params
 

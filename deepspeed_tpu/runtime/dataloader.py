@@ -65,11 +65,27 @@ class DeepSpeedDataLoader:
 
     def _iter_map_style(self):
         n = len(self.dataset)
-        order = np.arange(n)
         if self.data_sampler is not None:
-            order = np.asarray(list(iter(self.data_sampler)))
-        elif self.shuffle:
-            self._rng.shuffle(order)
+            it = iter(self.data_sampler)
+            try:
+                first = next(it)
+            except StopIteration:
+                return
+            if np.ndim(first) >= 1:
+                # batch-index sampler (e.g. DeepSpeedDataSampler): each item
+                # IS this rank's micro-batch index list — honor it as-is
+                # (the curriculum decides membership, order AND sharding)
+                import itertools
+                for idx_list in itertools.chain([first], it):
+                    yield self.collate_fn([self.dataset[int(i)] for i in idx_list])
+                return
+            # per-sample sampler (e.g. DifficultyDataSampler): it yields a
+            # scalar order; batch + shard it like a plain shuffle
+            order = np.asarray([int(first)] + [int(i) for i in it])
+        else:
+            order = np.arange(n)
+            if self.shuffle:
+                self._rng.shuffle(order)
         if self.num_shards > 1:
             # equal shard sizes keep multi-host collectives in lockstep: drop
             # the tail so every process sees the same number of batches

@@ -151,9 +151,8 @@ class DeepSpeedEngine:
                 raise ValueError(
                     "pipeline_parallel_size > 1 requires a model exposing pipeline_loss() and "
                     "pipeline_pattern() (all deepspeed_tpu.models with scan_layers=True do)")
-            if getattr(getattr(model, "cfg", None), "num_experts", 0) > 0:
-                logger.warning("pipeline parallelism: MoE load-balancing aux loss is not "
-                               "collected through the pipelined path and will be dropped")
+            # MoE aux loss flows through the pipeline's aux channel
+            # (spmd_pipeline with_aux; valid-tick masked, psum over pipe)
         self.planner = ShardingPlanner(self.mesh,
                                        self._config.zero_optimization,
                                        tp_rules=tp_rules,
@@ -271,11 +270,14 @@ class DeepSpeedEngine:
                                    "EVERY layer; the configured random_ltd_layer_id subset "
                                    "is ignored (use scan_layers=False for per-layer control)")
             self._ltd_current = None
-        if dict(dict(self._config.raw_config.get("data_efficiency", {}))
-                .get("data_sampling", {})).get("enabled"):
-            logger.warning("data_efficiency.data_sampling is not consumed by the engine; "
-                           "use runtime.data_pipeline.data_sampler.DeepSpeedDataSampler with "
-                           "your dataloader (see data_analyzer.py) — section has NO effect here")
+        # data_efficiency.data_sampling: consumed by deepspeed_io (reference
+        # builds the curriculum sampler into its dataloader,
+        # data_pipeline/data_sampler.py:36); flag it so deepspeed_io wires a
+        # DeepSpeedDataSampler when the user hands us the training_data
+        self._data_sampling_cfg = dict(dict(self._config.raw_config
+                                            .get("data_efficiency", {}))
+                                       .get("data_sampling", {}))
+        self._data_sampler = None
 
         # ---- timers / monitor / io ---------------------------------------
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
@@ -504,7 +506,19 @@ class DeepSpeedEngine:
     def _configure_optimizer(self, client_optimizer):
         """Build the optax gradient transformation (reference
         ``_configure_basic_optimizer`` engine.py:1197). The LR schedule is
-        passed as an optax schedule so it lives inside the compiled step."""
+        passed as an optax schedule so it lives inside the compiled step.
+        LoRA models with ``only_optimize_lora`` get the transformation
+        masked to adapter leaves — optimizer state is allocated for adapters
+        only (the DeepSpeed-Chat actor memory profile)."""
+        from .lora import LoRAModel
+        tx = self._configure_optimizer_inner(client_optimizer)
+        if isinstance(self.module, LoRAModel) and self.module.only_optimize_lora:
+            tx = optax.masked(tx, self.module.optimizer_mask)
+            log_dist("LoRA: optimizer masked to adapter leaves "
+                     f"(r={self.module.r}, alpha={self.module.alpha})", [0])
+        return tx
+
+    def _configure_optimizer_inner(self, client_optimizer):
         if client_optimizer is not None:
             if isinstance(client_optimizer, optax.GradientTransformation):
                 return client_optimizer
@@ -1297,11 +1311,37 @@ class DeepSpeedEngine:
                     f"global microbatch {global_micro} not divisible by process count "
                     f"{jax.process_count()}; adjust train_micro_batch_size_per_gpu")
             batch_size = global_micro // jax.process_count()
+        if (data_sampler is None and self._data_sampling_cfg.get("enabled")
+                and route in (None, "train") and self._data_sampler is None
+                and hasattr(dataset, "__len__")):
+            # train route only (reference wires ROUTE_TRAIN only): eval
+            # loaders must see one ordered pass, and the training sampler's
+            # checkpoint state must not be clobbered by later loaders
+            # curriculum-clustered sampling wired into the loader (reference
+            # builds DeepSpeedDataSampler inside deepspeed_io,
+            # data_pipeline/data_sampler.py:36). One feeding process = one
+            # "rank" of the sampler; it yields that process's micro-batch
+            # index lists.
+            from .data_pipeline.data_sampler import DeepSpeedDataSampler
+            data_sampler = DeepSpeedDataSampler(
+                {"data_sampling": self._data_sampling_cfg,
+                 "seed": self._data_sampling_cfg.get("seed", self._seed)},
+                one_epoch_total_samples=len(dataset),
+                micro_batch_size=batch_size,
+                data_parallel_rank=jax.process_index(),
+                data_parallel_size=jax.process_count(),
+                gradient_accumulation_steps=self.gradient_accumulation_steps(),
+                drop_last=self._config.dataloader_drop_last)
+            self._data_sampler = data_sampler
+            log_dist(f"deepspeed_io: DeepSpeedDataSampler wired "
+                     f"(curriculum={'on' if data_sampler.curriculum_enabled else 'off'}, "
+                     f"{len(dataset)} samples/epoch)", [0])
         return DeepSpeedDataLoader(dataset,
                                    batch_size=batch_size,
                                    collate_fn=collate_fn or self.collate_fn,
                                    drop_last=self._config.dataloader_drop_last,
                                    seed=self._seed,
+                                   data_sampler=data_sampler,
                                    num_shards=jax.process_count(),
                                    shard_index=jax.process_index())
 
@@ -1319,6 +1359,8 @@ class DeepSpeedEngine:
             "micro_steps": self.micro_steps,
             "skipped_steps": int(self.state.skipped_steps),
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
+            "data_sampler": (self._data_sampler.state_dict()
+                             if self._data_sampler is not None else None),
             "ds_config": self._config.raw_config,
         })
         if self.param_stream is not None:
@@ -1407,6 +1449,8 @@ class DeepSpeedEngine:
         self.micro_steps = client_sd.get("micro_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None and client_sd.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(client_sd["lr_scheduler"])
+        if self._data_sampler is not None and client_sd.get("data_sampler"):
+            self._data_sampler.load_state_dict(client_sd["data_sampler"])
         self.loaded_checkpoint_tag = tag
         return load_dir, client_sd
 

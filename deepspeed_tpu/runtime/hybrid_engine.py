@@ -55,12 +55,16 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         """Build the inference engine around the live training params instead
         of letting it materialize its own."""
         import dataclasses
+        from .lora import LoRAModel
         inf = self._infer
         inf._config = DeepSpeedInferenceConfig(infer_cfg)
         overrides = {"dtype": self.compute_dtype}
         if inf._config.kernel_inject:
             overrides["attention_impl"] = "flash"
-        inf.module = type(model)(dataclasses.replace(model.cfg, **overrides))
+        # generation always runs the INNER model over merged/fused weights;
+        # the LoRA wrapper only matters on the training side
+        inner = model.inner if isinstance(model, LoRAModel) else model
+        inf.module = type(inner)(dataclasses.replace(inner.cfg, **overrides))
         inf.model_config = inf.module.cfg
         inf.mesh = self.mesh
         inf.planner = self.planner
@@ -80,23 +84,34 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
     # ------------------------------------------------------------------ weights
     def _refresh_generation_params(self):
-        """Cast master -> compute dtype in the inference layout; cached until
-        the next optimizer step changes the weights."""
+        """Cast master -> compute dtype in the inference layout (merging LoRA
+        adapters unless they are already fused into base); cached until the
+        next optimizer step changes the weights."""
         step = int(self.state.step)
-        if self._gen_params_step == step and self._infer.params is not None:
+        fused = getattr(self, "_lora_fused", False)
+        if self._gen_params_step == (step, fused) and self._infer.params is not None:
             return
-        if self.offload_optimizer:
+        lora = self._lora()
+        cast = lambda t: jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x, self.compute_dtype), t)
+        if self.offload_optimizer and lora is None:
             # compute params ARE the live weights already
             self._infer.params = self.state.params
         else:
-            if "hybrid_cast" not in self._compiled:
-                shardings = self.planner.shardings(self.planner.master_specs(self.state.params))
-                self._compiled["hybrid_cast"] = jax.jit(
-                    lambda p: jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype), p),
-                    out_shardings=shardings)
+            key = "hybrid_cast_fused" if fused else "hybrid_cast"
+            if key not in self._compiled:
+                if lora is None:
+                    fn = cast
+                elif fused:
+                    fn = lambda p: cast(p["base"])
+                else:
+                    fn = lambda p: cast(lora.merge(p))
+                abstract = jax.eval_shape(fn, self.state.params)
+                shardings = self.planner.shardings(self.planner.master_specs(abstract))
+                self._compiled[key] = jax.jit(fn, out_shardings=shardings)
             with self.mesh:
-                self._infer.params = self._compiled["hybrid_cast"](self.state.params)
-        self._gen_params_step = step
+                self._infer.params = self._compiled[key](self.state.params)
+        self._gen_params_step = (step, fused)
 
     # ------------------------------------------------------------------ generate
     def generate(self, input_ids, **kwargs):
@@ -111,12 +126,40 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         self._refresh_generation_params()
         return self._infer.forward(input_ids, attention_mask)
 
-    # LoRA hooks (reference fuse_lora_weight :129): the functional parameter
-    # store has no fused/unfused duality — adapters would be extra pytree
-    # leaves merged by a model-level transform. Kept as explicit no-ops so
-    # RLHF scripts porting from the reference do not crash.
+    # ------------------------------------------------------------------ LoRA
+    # Reference fuse_lora_weight :129: DeepSpeed-Chat bakes the adapters into
+    # the base weights around the rollout phase so generation pays no per-call
+    # merge. Here the module is a runtime.lora.LoRAModel and fusing rewrites
+    # state.params["base"] in place (donated jit); generate() then skips the
+    # per-call merge by handing the INNER model the fused base directly.
+    def _lora(self):
+        from .lora import LoRAModel
+        return self.module if isinstance(self.module, LoRAModel) else None
+
     def fuse_lora_weight(self):
+        lora = self._lora()
+        if lora is None:
+            return None  # no adapters: API-parity no-op
+        if getattr(self, "_lora_fused", False):
+            return None
+        if "lora_fuse" not in self._compiled:
+            shardings = self.planner.shardings(self.planner.master_specs(self.state.params))
+            self._compiled["lora_fuse"] = jax.jit(lora.fuse_params, donate_argnums=(0, ),
+                                                  out_shardings=shardings)
+            self._compiled["lora_unfuse"] = jax.jit(lora.unfuse_params, donate_argnums=(0, ),
+                                                    out_shardings=shardings)
+        with self.mesh:
+            self.state = self.state._replace(params=self._compiled["lora_fuse"](self.state.params))
+        self._lora_fused = True
+        self._gen_params_step = None  # generation cache now stale
         return None
 
-    def unfuse_lora_weight(self):
+    def unfuse_lora_weight(self, quantize=False):
+        lora = self._lora()
+        if lora is None or not getattr(self, "_lora_fused", False):
+            return None
+        with self.mesh:
+            self.state = self.state._replace(params=self._compiled["lora_unfuse"](self.state.params))
+        self._lora_fused = False
+        self._gen_params_step = None
         return None

@@ -1,0 +1,198 @@
+"""LoRA: low-rank adapters as first-class pytree leaves.
+
+The reference treats LoRA as a core RLHF memory lever — DeepSpeed-Chat
+trains actors with adapters only and the hybrid engine fuses/unfuses them
+around generation (``runtime/hybrid_engine.py:129 fuse_lora_weight``).
+TPU-native form: ``LoRAModel`` wraps any zoo model and splits the parameter
+pytree into ``{"base": ..., "lora": ...}``:
+
+- ``base`` keeps the inner model's tree (frozen by default: the loss sees it
+  through ``stop_gradient``, so XLA dead-code-eliminates the entire base
+  backward pass and the optimizer holds state for adapters only — the
+  ``only_optimize_lora`` memory profile).
+- ``lora`` mirrors every kernel matched by ``target_modules`` with a pair
+  ``{"a": (..., in, r), "b": (..., r, out)}``; scanned stacks keep their
+  leading layer dim on both halves.
+
+The merge ``W + (alpha/r) * a @ b`` happens functionally inside ``loss``/
+``apply`` — there is no module surgery, and "fusing" for generation is just
+baking the same delta into the base leaves (``fuse_params``), which the
+hybrid engine does once per rollout phase instead of per call.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _slash(path):
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))))
+    return "/".join(parts)
+
+
+DEFAULT_TARGETS = (r"attn/(q|k|v|o)_proj/kernel", r"mlp/(gate|up|down)_proj/kernel")
+
+
+def _split_dims(path, ndim, scanned):
+    """(n_lead, n_in) split of a kernel's dims under the zoo layouts:
+    (in, out) MLP / 2-D, (in, heads, hd) qkv, (heads, hd, out) o_proj —
+    each with a leading layer dim when scanned."""
+    lead = 1 if scanned else 0
+    nd = ndim - lead
+    if nd == 2:
+        n_in = 1
+    elif "o_proj" in path:
+        n_in = 2  # (heads, hd) jointly form the input
+    else:
+        n_in = 1  # (in, heads, hd): heads*hd form the output
+    return lead, n_in
+
+
+class LoRAModel:
+    """Engine-facing wrapper: ``params = {"base", "lora"}``; delegates the
+    zoo model protocol with path adjustments."""
+
+    def __init__(self, inner, r=8, alpha=16.0, target_modules=DEFAULT_TARGETS,
+                 only_optimize_lora=True, rng_seed=0):
+        self.inner = inner
+        self.cfg = getattr(inner, "cfg", None)
+        self.r = int(r)
+        self.alpha = float(alpha)
+        self.scale = self.alpha / self.r
+        self.patterns = [re.compile(p) for p in target_modules]
+        self.only_optimize_lora = bool(only_optimize_lora)
+        self._seed = rng_seed
+
+    # ---- params -----------------------------------------------------------
+    def _matches(self, path):
+        return any(p.search(path) for p in self.patterns)
+
+    def _adapter_shapes(self, path, shape):
+        scanned = path.split("/", 1)[0] == "layers"
+        lead, n_in = _split_dims(path, len(shape), scanned)
+        lead_s = shape[:lead]
+        in_s = shape[lead:lead + n_in]
+        out_s = shape[lead + n_in:]
+        return (lead_s + in_s + (self.r, ), lead_s + (self.r, ) + out_s)
+
+    def init_lora(self, base_params, rng):
+        """Adapter tree: ``a`` ~ N(0, 1/r) (reference kaiming-ish), ``b`` = 0
+        so training starts at the base function exactly."""
+        flat = jax.tree_util.tree_flatten_with_path(base_params)
+        out = {}
+        i = 0
+        for p, leaf in flat[0]:
+            path = _slash(p)
+            if getattr(leaf, "ndim", 0) >= 2 and self._matches(path):
+                sa, sb = self._adapter_shapes(path, tuple(leaf.shape))
+                ra = jax.random.fold_in(rng, i)
+                node = out
+                for part in path.split("/")[:-1]:
+                    node = node.setdefault(part, {})
+                # "lora_<leaf>" (not "<leaf>/a"): nesting under the kernel
+                # name would make TP-rule regexes ending in /kernel match the
+                # adapter leaves and demand the base kernel's rank
+                node["lora_" + path.split("/")[-1]] = {
+                    "a": jax.random.normal(ra, sa, jnp.float32) / np.sqrt(self.r),
+                    "b": jnp.zeros(sb, jnp.float32),
+                }
+                i += 1
+        if not out:
+            raise ValueError(f"LoRA target_modules matched no kernels: "
+                             f"{[p.pattern for p in self.patterns]}")
+        return out
+
+    def init_params(self, rng):
+        base = self.inner.init_params(rng)
+        return {"base": base, "lora": self.init_lora(base, jax.random.fold_in(rng, 0x10A))}
+
+    def merge(self, params):
+        """Effective inner-model params: base + scale * a@b on every adapted
+        leaf (traceable; runs inside the compiled step)."""
+        base, lora = params["base"], params["lora"]
+
+        # path-keyed merge: align adapter pairs to base leaves by path
+        flat_b = jax.tree_util.tree_flatten_with_path(base)
+        lora_flat = {}
+        for p, leaf in jax.tree_util.tree_flatten_with_path(lora)[0]:
+            path = _slash(p)
+            lora_flat.setdefault(path.rsplit("/", 1)[0], {})[path.rsplit("/", 1)[1]] = leaf
+        out = []
+        for p, w in flat_b[0]:
+            path = _slash(p)
+            head, _, last = path.rpartition("/")
+            pair = lora_flat.get((head + "/" if head else "") + "lora_" + last)
+            if pair is None:
+                out.append(w)
+                continue
+            a, bm = pair["a"], pair["b"]
+            scanned = path.split("/", 1)[0] == "layers"
+            lead, n_in = _split_dims(path, w.ndim, scanned)
+            lead_s = w.shape[:lead]
+            in_n = int(np.prod(w.shape[lead:lead + n_in], dtype=np.int64))
+            out_n = int(np.prod(w.shape[lead + n_in:], dtype=np.int64))
+            al = a.reshape(lead_s + (in_n, self.r)).astype(jnp.float32)
+            bl = bm.reshape(lead_s + (self.r, out_n)).astype(jnp.float32)
+            delta = (self.scale * (al @ bl)).reshape(w.shape)
+            out.append((w.astype(jnp.float32) + delta).astype(w.dtype))
+        return jax.tree_util.tree_unflatten(flat_b[1], out)
+
+    def fuse_params(self, params):
+        """Bake the adapters into base (generation-time fuse). Returns a new
+        ``{"base": merged, "lora": unchanged}`` tree."""
+        return {"base": self.merge(params), "lora": params["lora"]}
+
+    def unfuse_params(self, params):
+        """Inverse of ``fuse_params`` (subtract the delta): negate the 'b'
+        halves so a@b flips sign exactly once."""
+        def flip(node):
+            if isinstance(node, dict) and "a" in node and "b" in node \
+                    and not isinstance(node["a"], dict):
+                return {"a": node["a"], "b": -node["b"]}
+            return {k: flip(v) for k, v in node.items()} if isinstance(node, dict) else node
+        merged = self.merge({"base": params["base"], "lora": flip(params["lora"])})
+        return {"base": merged, "lora": params["lora"]}
+
+    # ---- model protocol ---------------------------------------------------
+    def _train_view(self, params):
+        base = params["base"]
+        if self.only_optimize_lora:
+            base = jax.lax.stop_gradient(base)
+        return self.merge({"base": base, "lora": params["lora"]})
+
+    def loss(self, params, batch, rng):
+        return self.inner.loss(self._train_view(params), batch, rng)
+
+    def apply(self, params, *a, **kw):
+        return self.inner.apply(self.merge(params), *a, **kw)
+
+    def apply_with_cache(self, params, *a, **kw):
+        return self.inner.apply_with_cache(self.merge(params), *a, **kw)
+
+    def init_cache(self, *a, **kw):
+        return self.inner.init_cache(*a, **kw)
+
+    def tp_rules(self):
+        # re.search, so inner patterns still hit "base/..." paths; adapter
+        # leaves are small and stay replicated
+        return self.inner.tp_rules() if hasattr(self.inner, "tp_rules") else []
+
+    def expert_pattern(self):
+        return self.inner.expert_pattern() if hasattr(self.inner, "expert_pattern") else None
+
+    def pipeline_pattern(self):
+        return None  # LoRA + PP not composed (reference RLHF actors run ZeRO)
+
+    def optimizer_mask(self, params):
+        """optax.masked mask: True = trainable (adapters; base too unless
+        only_optimize_lora)."""
+        t = self.only_optimize_lora
+        return {"base": jax.tree_util.tree_map(lambda _: not t, params["base"]),
+                "lora": jax.tree_util.tree_map(lambda _: True, params["lora"])}
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)

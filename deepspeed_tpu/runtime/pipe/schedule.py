@@ -30,22 +30,29 @@ def num_pipeline_steps(num_microbatches, num_stages):
     return num_microbatches + num_stages - 1
 
 
-def spmd_pipeline(stage_fn, stage_params, x_stream, mesh=None, remat=False):
+def spmd_pipeline(stage_fn, stage_params, x_stream, mesh=None, remat=False, with_aux=False):
     """Run ``x_stream`` through a ``pipe``-partitioned layer stack.
 
-    ``stage_fn(local_params, x, t) -> y``: applies one stage's layer slice at
-    pipeline step ``t`` (an i32 scalar; use it to decorrelate per-step rngs);
-    ``x``/``y`` may be pytrees — non-activation leaves (e.g. an attention
-    mask) ride along with their microbatch through every stage;
-    ``stage_params``: pytree whose leaves have leading layer dim divisible by
-    the ``pipe`` axis size (sharded dim 0 across stages);
-    ``x_stream``: pytree of (M, ...) microbatch streams entering stage 0.
-    Returns the stream leaving the last stage, replicated over pipe.
+    ``stage_fn(local_params, x, t) -> y`` (or ``(y, aux)`` with
+    ``with_aux=True``): applies one stage's layer slice at pipeline step
+    ``t`` (an i32 scalar; use it to decorrelate per-step rngs); ``x``/``y``
+    may be pytrees — non-activation leaves (e.g. an attention mask) ride
+    along with their microbatch through every stage; ``stage_params``:
+    pytree whose leaves have leading layer dim divisible by the ``pipe``
+    axis size (sharded dim 0 across stages); ``x_stream``: pytree of
+    (M, ...) microbatch streams entering stage 0.
+
+    Returns the stream leaving the last stage, replicated over pipe; with
+    ``with_aux`` also a scalar: the sum of ``aux`` over every VALID
+    (stage, microbatch) tick, psum'd across stages — fill/drain ticks
+    compute on garbage activations and are masked out. This is how
+    per-stage side losses (MoE load-balancing aux, reference
+    ``engine.py:2880`` composes MoE under PP) survive the pipeline.
     """
     mesh = mesh or dist.get_mesh()
     n_stages = mesh.shape[dist.PIPE_AXIS]
     if n_stages == 1:
-        return _single_stage(stage_fn, stage_params, x_stream, remat)
+        return _single_stage(stage_fn, stage_params, x_stream, remat, with_aux)
     M = jax.tree_util.tree_leaves(x_stream)[0].shape[0]
     steps = num_pipeline_steps(M, n_stages)
     fn = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
@@ -59,14 +66,21 @@ def spmd_pipeline(stage_fn, stage_params, x_stream, mesh=None, remat=False):
         pvary = lambda v: jax.lax.pvary(v, (dist.PIPE_AXIS, ))
         state = tmap(lambda x: pvary(jnp.zeros_like(x[0])), xs)
         out_stream = tmap(lambda x: pvary(jnp.zeros_like(x)), xs)
+        aux_total = pvary(jnp.zeros((), jnp.float32))
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
         def step(carry, t):
-            state, out_stream = carry
+            state, out_stream, aux_total = carry
             feed = tmap(lambda x: jax.lax.dynamic_index_in_dim(x, jnp.minimum(t, M - 1), 0,
                                                                keepdims=False), xs)
             cur = tmap(lambda f, s: jnp.where(stage == 0, f, s), feed, state)
-            y = fn(local_params, cur, t)
+            out = fn(local_params, cur, t)
+            y, aux = out if with_aux else (out, None)
+            if with_aux:
+                # stage s holds microbatch t-s; outside [0, M) it's fill/drain
+                mb = t - stage
+                valid = (mb >= 0) & (mb < M)
+                aux_total = aux_total + jnp.where(valid, aux.astype(jnp.float32), 0.0)
             nxt = tmap(lambda v: jax.lax.ppermute(v, dist.PIPE_AXIS, perm), y)
             out_idx = t - (n_stages - 1)
             write = (stage == n_stages - 1) & (out_idx >= 0)
@@ -74,24 +88,30 @@ def spmd_pipeline(stage_fn, stage_params, x_stream, mesh=None, remat=False):
                 lambda os, v: jnp.where(
                     write, jax.lax.dynamic_update_index_in_dim(os, v, jnp.maximum(out_idx, 0), 0),
                     os), out_stream, y)
-            return (nxt, out_stream), None
+            return (nxt, out_stream, aux_total), None
 
-        (_, out_stream), _ = jax.lax.scan(step, (state, out_stream), jnp.arange(steps))
+        (_, out_stream, aux_total), _ = jax.lax.scan(
+            step, (state, out_stream, aux_total), jnp.arange(steps))
         # deliver the last stage's stream to every stage (head/loss run replicated)
         out_stream = tmap(
             lambda os: jax.lax.psum(jnp.where(stage == n_stages - 1, os, jnp.zeros_like(os)),
                                     dist.PIPE_AXIS), out_stream)
+        if with_aux:
+            return out_stream, jax.lax.psum(aux_total, dist.PIPE_AXIS)
         return out_stream
 
     in_specs = (jax.tree_util.tree_map(lambda _: P(dist.PIPE_AXIS), stage_params),
                 jax.tree_util.tree_map(lambda _: P(), x_stream))
+    out_specs = jax.tree_util.tree_map(lambda _: P(), x_stream)
+    if with_aux:
+        out_specs = (out_specs, P())
     with dist.manual_axes({dist.PIPE_AXIS}):
         return jax.shard_map(run, mesh=mesh, in_specs=in_specs,
-                             out_specs=jax.tree_util.tree_map(lambda _: P(), x_stream),
+                             out_specs=out_specs,
                              axis_names={dist.PIPE_AXIS})(stage_params, x_stream)
 
 
-def _single_stage(stage_fn, stage_params, x_stream, remat):
+def _single_stage(stage_fn, stage_params, x_stream, remat, with_aux=False):
     fn = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
     M = jax.tree_util.tree_leaves(x_stream)[0].shape[0]
 
@@ -99,4 +119,8 @@ def _single_stage(stage_fn, stage_params, x_stream, remat):
         x, t = x_and_t
         return fn(stage_params, x, t)
 
-    return jax.lax.map(one, (x_stream, jnp.arange(M)))
+    out = jax.lax.map(one, (x_stream, jnp.arange(M)))
+    if with_aux:
+        stream, aux = out
+        return stream, jnp.sum(aux.astype(jnp.float32))
+    return out

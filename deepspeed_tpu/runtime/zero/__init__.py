@@ -1,0 +1,3 @@
+from .config import DeepSpeedZeroConfig, ZeroStageEnum  # noqa: F401
+from .sharding import ShardingPlanner, TensorParallelRules  # noqa: F401
+from .tiling import TiledLinear, tiled_linear  # noqa: F401

@@ -541,16 +541,20 @@ class ParamStreamRunner:
         acc_dtype = self._grad_dtype if self.gas == 1 else np.float32
         fetches = []
         tied_shared = [k for k in self.plan["tail"] if k in self.plan["embed"]]
+        import threading
+        acc_lock = threading.Lock()  # tail + embed fetches can target the
+        # same tied-embedding slot from different pool threads
 
         def accumulate(name, path, host):
-            slot = grads.setdefault(name, {})
-            if path in slot:
-                np.add(slot[path], np.asarray(host, slot[path].dtype), out=slot[path])
-            else:
-                # fp32 whenever a slot can receive >1 contribution (gas>1, or
-                # the tied embedding's two vjp sources)
-                dt = np.float32 if (name == "embed" and tied_shared) else acc_dtype
-                slot[path] = np.array(host, dt, copy=True)
+            with acc_lock:
+                slot = grads.setdefault(name, {})
+                if path in slot:
+                    np.add(slot[path], np.asarray(host, slot[path].dtype), out=slot[path])
+                else:
+                    # fp32 whenever a slot can receive >1 contribution (gas>1,
+                    # or the tied embedding's two vjp sources)
+                    dt = np.float32 if (name == "embed" and tied_shared) else acc_dtype
+                    slot[path] = np.array(host, dt, copy=True)
 
         def sink(name, dev_tree):
             def fetch(dev_tree=dev_tree, name=name):

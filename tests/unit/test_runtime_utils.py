@@ -141,3 +141,33 @@ def test_activation_checkpointing_api():
     key = ckpt.model_parallel_cuda_manual_seed(17)
     assert key is not None
     ckpt.reset()
+
+
+def test_tiled_linear_matches_dense():
+    """zero.tiling.TiledLinear (reference runtime/zero/tiling.py:32): tiled
+    forward/backward == dense linear for every split combination."""
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.runtime.zero.tiling import TiledLinear, tiled_linear
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 24)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((24, 36)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((36, )), jnp.float32)
+    dense = x @ k + b
+    for ins, outs in [(1, 1), (2, 3), (4, 6), (24, 36)]:
+        got = tiled_linear(x, k, b, ins, outs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(dense), atol=1e-5)
+    # gradients flow tile-by-tile (remat) and match dense
+    g_dense = jax.grad(lambda k: jnp.sum(jnp.square(x @ k)))(k)
+    g_tiled = jax.grad(lambda k: jnp.sum(jnp.square(tiled_linear(x, k, None, 3, 4))))(k)
+    np.testing.assert_allclose(np.asarray(g_tiled), np.asarray(g_dense), atol=1e-4)
+
+    # module surface
+    mod = TiledLinear(features=36, in_splits=2, out_splits=3)
+    params = mod.init(jax.random.key(0), x)
+    out = mod.apply(params, x)
+    assert out.shape == (4, 36)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="not divisible"):
+        tiled_linear(x, k, None, 5, 1)
