@@ -1,0 +1,3 @@
+from .zero_checkpoint import (get_fp32_state_dict_from_zero_checkpoint,  # noqa: F401
+                              load_universal_checkpoint_params,
+                              reference_checkpoint_to_params)

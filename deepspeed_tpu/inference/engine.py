@@ -73,8 +73,18 @@ class InferenceEngine:
             raise ValueError("init_inference expects a deepspeed_tpu model (CausalLMModel or preset "
                              f"name); got {type(model)}")
 
-        # dtype + kernel selection are model-config switches
-        overrides = {"dtype": cfg.dtype, "decode_block_kv": cfg.decode_block_kv}
+        # dtype + kernel selection are model-config switches. dtype 'int8'
+        # means INT8 WEIGHTS + bf16 compute (reference csrc int8
+        # dequant-GEMM serving): the memory-bound decode loop reads half
+        # the HBM bytes through the Pallas quant matmul.
+        self._int8_weights = cfg.dtype == jnp.int8
+        compute_dtype = jnp.bfloat16 if self._int8_weights else cfg.dtype
+        overrides = {"dtype": compute_dtype, "decode_block_kv": cfg.decode_block_kv}
+        if self._int8_weights and hasattr(model.cfg, "int8_weights"):
+            overrides["int8_weights"] = True
+        elif self._int8_weights:
+            raise ValueError(f"dtype=int8 requires a model with int8 weight support "
+                             f"(CausalLMModel family); got {type(model)}")
         if cfg.kernel_inject and hasattr(model.cfg, "scan_layers"):
             overrides["attention_impl"] = "flash"
             # unrolled layers: the KV cache becomes per-layer tensors that
@@ -108,29 +118,49 @@ class InferenceEngine:
             f"max_out_tokens={cfg.max_out_tokens}", [0])
 
     # ------------------------------------------------------------------ params
-    def _adapt_layout(self, params):
+    def _adapt_layout(self, params, host=False):
         """Convert between stacked ('layers', scan form) and per-layer
         ('layer_i', unrolled form) parameter trees so checkpoints/params from
         either model layout serve under the other (kernel_inject runs
-        unrolled; training models usually scan)."""
+        unrolled; training models usually scan). ``host=True`` stays in
+        numpy (the int8 quantize path must not touch HBM)."""
         scan = getattr(self.model_config, "scan_layers", None)
         if params is None or scan is None or not isinstance(params, dict):
             return params
+        stack = (lambda *xs: np.stack(xs)) if host else (lambda *xs: jnp.stack(xs))
+        take = (lambda x, i: np.asarray(x)[i]) if host else (lambda x, i: x[i])
         L = self.model_config.num_layers
         if not scan and "layers" in params:
             params = dict(params)
             stacked = params.pop("layers")
             for i in range(L):
-                params[f"layer_{i}"] = jax.tree_util.tree_map(lambda x, i=i: x[i], stacked)
+                params[f"layer_{i}"] = jax.tree_util.tree_map(lambda x, i=i: take(x, i), stacked)
         elif scan and "layer_0" in params:
             params = dict(params)
             layers = [params.pop(f"layer_{i}") for i in range(L)]
-            params["layers"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+            params["layers"] = jax.tree_util.tree_map(stack, *layers)
         return params
 
     def _materialize_params(self, params):
         if params is None and self._config.checkpoint:
             params = self._load_checkpoint_host(self._config.checkpoint)
+        if self._int8_weights and params is None:
+            logger.warning("init_inference(int8): no checkpoint/params given; quantizing "
+                           "random weights")
+            import dataclasses as _dc
+            bf16_module = type(self.module)(_dc.replace(self.model_config,
+                                                        int8_weights=False))
+            params = jax.tree_util.tree_map(
+                lambda x: np.asarray(x),
+                bf16_module.init_params(jax.random.key(0)))
+        if self._int8_weights:
+            # host-side quantize BEFORE placement: the bf16 tree never
+            # reaches HBM (the point of int8 serving is halving those bytes)
+            host = jax.tree_util.tree_map(np.asarray, params)
+            params = self.module.quantize_params(self._adapt_layout(host, host=True))
+            shardings = self.planner.shardings(self.planner.master_specs(params))
+            with self.mesh:
+                return jax.device_put(params, shardings)
         params = self._adapt_layout(params)
         shardings = self.planner.shardings(self.planner.master_specs(
             params if params is not None else jax.eval_shape(self.module.init_params, jax.random.key(0))))

@@ -6,10 +6,9 @@ Each runner builds the command line that starts ONE bootstrap process per
 TPU host (JAX's one-process-per-host model — the reference's one-per-GPU
 fan-out happens inside the JAX runtime instead). Rendezvous env:
 
-- pdsh exports ``COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/
-  ``JAX_PROCESS_ID`` per host (%n is pdsh's per-host rank substitution is
-  not available, so the process id comes from the sorted host list via a
-  tiny env-shim on the remote side — the same trick the ssh loop uses).
+- pdsh exports ``COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES`` to every
+  host and relies on pdsh's ``%n`` per-host rank substitution for
+  ``JAX_PROCESS_ID``.
 - MPI runners rely on ``comm.init_distributed``'s rank discovery from the
   MPI/Slurm environment (``OMPI_COMM_WORLD_RANK``, ``PMI_RANK``,
   ``SLURM_PROCID`` — reference ``comm.py:591 mpi_discovery``).
@@ -167,11 +166,17 @@ RUNNERS = {
 }
 
 
-def get_runner(name, args, world_info):
+def get_runner(name, args, world_info, require=False):
+    """``require=True`` (the launch path): fail cleanly when the backend
+    binary is absent instead of letting subprocess die on FileNotFoundError;
+    command-construction callers (tests, dry runs) leave it False."""
     if name not in RUNNERS:
         raise ValueError(f"unknown launcher {name!r}; choose from {sorted(RUNNERS)} or 'ssh'")
     runner = RUNNERS[name](args, world_info)
     if not runner.backend_exists():
+        if require:
+            raise RuntimeError(f"launcher backend {name!r} not found on PATH "
+                               f"(is {name} installed on this host?)")
         logger.warning(f"launcher backend {name!r} not found on PATH; the command is built "
                        f"anyway (it may run on the target cluster)")
     return runner

@@ -211,7 +211,9 @@ def main(argv=None):
 
     coordinator = args.master_addr or hosts[0]
 
-    if len(hosts) == 1 and not args.force_multi:
+    if len(hosts) == 1 and not args.force_multi and args.launcher == "ssh":
+        # a non-default --launcher skips this shortcut: inside a Slurm/MPI
+        # allocation the backend itself does the fan-out even from one host
         env = dict(os.environ)
         env.update({"COORDINATOR_ADDRESS": f"{coordinator}:{args.master_port}",
                     "JAX_NUM_PROCESSES": "1", "JAX_PROCESS_ID": "0"})
@@ -225,7 +227,7 @@ def main(argv=None):
         # multinode_runner.get_cmd); rank discovery happens in
         # comm.init_distributed from the backend's env
         from .multinode_runner import get_runner
-        runner = get_runner(args.launcher, args, {h: 1 for h in hosts})
+        runner = get_runner(args.launcher, args, {h: 1 for h in hosts}, require=True)
         cmd, env = runner.get_cmd(dict(os.environ), hosts)
         if args.launcher_args:
             cmd = cmd[:1] + shlex.split(args.launcher_args) + cmd[1:]

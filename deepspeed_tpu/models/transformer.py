@@ -77,6 +77,12 @@ class TransformerConfig:
     attention_block_q: int = 512
     attention_block_kv: int = 512
     decode_block_kv: int = 256  # KV block per decode-kernel step
+    # int8 weight serving (reference csrc int8 dequant-GEMM inference path):
+    # projections read int8 weights + per-group scales through the Pallas
+    # quant matmul — halves the HBM bytes of the memory-bound decode loop.
+    # Serving-only: params must come from CausalLMModel.quantize_params.
+    int8_weights: bool = False
+    int8_group_size: int = 0  # 0 = one scale group per contraction dim
 
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash"):
@@ -409,6 +415,67 @@ def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype, alibi=None)
     return out.reshape(B, nh, T, hd)
 
 
+def _pick_block(n, cap, mult):
+    """Largest divisor of n that is <= cap and a multiple of ``mult`` (the
+    Mosaic tiling constraint: blocks must tile 8x128 unless they span the
+    whole dim). Falls back to the full dim when no such divisor exists."""
+    if n <= cap:
+        return n
+    d = cap - cap % mult
+    while d >= mult:
+        if n % d == 0:
+            return d
+        d -= mult
+    return n
+
+
+import os as _os
+
+_QMM_IMPL = _os.environ.get("DSTPU_QMM_IMPL", "xla")
+
+
+def _qmm2d(x2d, qw, scales, out_dtype=None):
+    """int8 matmul: ``x @ (dequant(qw))`` without a persistent bf16 weight.
+
+    Default path is XLA: the s8->bf16 convert + scale multiply fuse into the
+    dot's operand read, so HBM sees only int8 weight bytes (measured at the
+    decode shapes: the fusion streams ~2x faster than the Pallas tile loop,
+    whose small-M blocks leave the memory pipeline underfed; set
+    DSTPU_QMM_IMPL=pallas to compare)."""
+    M, K = x2d.shape
+    G, N = scales.shape
+    if _QMM_IMPL == "pallas":
+        from ..ops.pallas.quant_matmul import quant_matmul
+        return quant_matmul(x2d, qw, scales,
+                            block_m=_pick_block(M, 256, 8),
+                            block_n=_pick_block(N, 256, 128),
+                            block_k=_pick_block(K // G, 512, 128),
+                            out_dtype=out_dtype or x2d.dtype)
+    w = qw.astype(x2d.dtype)
+    if G == 1:
+        w = w * scales[0].astype(x2d.dtype)
+    else:
+        w = (w.reshape(G, K // G, N) * scales[:, None, :].astype(x2d.dtype)).reshape(K, N)
+    return jnp.matmul(x2d, w, preferred_element_type=jnp.float32).astype(
+        out_dtype or x2d.dtype)
+
+
+def _q_groups(k, group_size):
+    """Scale-group count for a contraction of k: group_size (default 128)
+    when it divides k, else one group — the same rule quantize_params uses,
+    so module param shapes and quantized trees always agree."""
+    gs = group_size or 128
+    return k // gs if k % gs == 0 else 1
+
+
+def _q_param(mod, name, k, n, group_size):
+    """Declare (int8 weight, fp32 scales) params for a (k, n) contraction."""
+    qw = mod.param(name + "_q", nn.initializers.zeros, (k, n), jnp.int8)
+    sc = mod.param(name + "_scale", nn.initializers.ones,
+                   (_q_groups(k, group_size), n), jnp.float32)
+    return qw, sc
+
+
 class HeadProjection(nn.Module):
     """q/k/v projection emitting head-major ``(B, heads, T, head_dim)``
     directly — the matmul's output layout IS the attention layout, so no
@@ -418,12 +485,21 @@ class HeadProjection(nn.Module):
     head_dim: int
     use_bias: bool
     dtype: Any
+    int8: bool = False
+    int8_groups: int = 0  # scale-group SIZE (0 = default rule)
 
     @nn.compact
     def __call__(self, x):  # (B, T, H) -> (B, heads, T, head_dim)
-        kernel = self.param("kernel", nn.initializers.normal(0.02),
-                            (x.shape[-1], self.heads, self.head_dim), jnp.float32)
-        y = jnp.einsum("bth,hnd->bntd", x, kernel.astype(self.dtype))
+        B, T, H = x.shape
+        if self.int8:
+            qw, sc = _q_param(self, "kernel", H, self.heads * self.head_dim,
+                              self.int8_groups)
+            y = _qmm2d(x.reshape(B * T, H).astype(self.dtype), qw, sc)
+            y = y.reshape(B, T, self.heads, self.head_dim).transpose(0, 2, 1, 3)
+        else:
+            kernel = self.param("kernel", nn.initializers.normal(0.02),
+                                (x.shape[-1], self.heads, self.head_dim), jnp.float32)
+            y = jnp.einsum("bth,hnd->bntd", x, kernel.astype(self.dtype))
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, (self.heads, self.head_dim), jnp.float32)
             y = y + bias.astype(self.dtype)[None, :, None, :]
@@ -436,13 +512,20 @@ class OutProjection(nn.Module):
     features: int
     use_bias: bool
     dtype: Any
+    int8: bool = False
+    int8_groups: int = 0  # scale-group SIZE (0 = default rule)
 
     @nn.compact
     def __call__(self, x):  # (B, heads, T, hd) -> (B, T, features)
-        n, d = x.shape[1], x.shape[-1]
-        kernel = self.param("kernel", nn.initializers.normal(0.02),
-                            (n, d, self.features), jnp.float32)
-        y = jnp.einsum("bntd,ndh->bth", x, kernel.astype(self.dtype))
+        B, n, T, d = x.shape
+        if self.int8:
+            qw, sc = _q_param(self, "kernel", n * d, self.features, self.int8_groups)
+            x2 = x.transpose(0, 2, 1, 3).reshape(B * T, n * d).astype(self.dtype)
+            y = _qmm2d(x2, qw, sc).reshape(B, T, self.features)
+        else:
+            kernel = self.param("kernel", nn.initializers.normal(0.02),
+                                (n, d, self.features), jnp.float32)
+            y = jnp.einsum("bntd,ndh->bth", x, kernel.astype(self.dtype))
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros, (self.features, ), jnp.float32)
             y = y + bias.astype(self.dtype)
@@ -464,9 +547,10 @@ class Attention(nn.Module):
         nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_size
         use_bias = cfg.attn_bias if cfg.attn_bias is not None else cfg.norm == "layernorm"
         # bhtd layout end-to-end: projections emit head-major
-        q = HeadProjection(nh, hd, use_bias, cfg.dtype, name="q_proj")(x)
-        k = HeadProjection(nkv, hd, use_bias, cfg.dtype, name="k_proj")(x)
-        v = HeadProjection(nkv, hd, use_bias, cfg.dtype, name="v_proj")(x)
+        i8, i8g = cfg.int8_weights, cfg.int8_group_size
+        q = HeadProjection(nh, hd, use_bias, cfg.dtype, i8, i8g, name="q_proj")(x)
+        k = HeadProjection(nkv, hd, use_bias, cfg.dtype, i8, i8g, name="k_proj")(x)
+        v = HeadProjection(nkv, hd, use_bias, cfg.dtype, i8, i8g, name="v_proj")(x)
 
         if cfg.pos_embedding == "rope":
             if position_ids is not None:
@@ -570,8 +654,30 @@ class Attention(nn.Module):
                 if ulysses is not None:
                     out = _constrain(out, seq_q)
 
-        out = OutProjection(H, use_bias, cfg.dtype, name="o_proj")(out)
+        out = OutProjection(H, use_bias, cfg.dtype, cfg.int8_weights,
+                            cfg.int8_group_size, name="o_proj")(out)
         return out, new_cache
+
+
+class QuantDense(nn.Module):
+    """nn.Dense over (int8 weight, fp32 group scales) via the Pallas quant
+    matmul (serving path; params come from ``quantize_params``)."""
+
+    features: int
+    use_bias: bool
+    dtype: Any
+    groups: int = 0  # scale-group SIZE (0 = default rule)
+
+    @nn.compact
+    def __call__(self, x):
+        K = x.shape[-1]
+        qw, sc = _q_param(self, "kernel", K, self.features, self.groups)
+        y = _qmm2d(x.reshape(-1, K).astype(self.dtype), qw, sc)
+        y = y.reshape(x.shape[:-1] + (self.features, ))
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros, (self.features, ), jnp.float32)
+            y = y + bias.astype(self.dtype)
+        return y
 
 
 class MLP(nn.Module):
@@ -580,8 +686,12 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        dense = partial(nn.Dense, use_bias=cfg.norm == "layernorm", dtype=cfg.dtype,
-                        param_dtype=jnp.float32, kernel_init=nn.initializers.normal(0.02))
+        if cfg.int8_weights:
+            dense = partial(QuantDense, use_bias=cfg.norm == "layernorm", dtype=cfg.dtype,
+                            groups=cfg.int8_group_size)
+        else:
+            dense = partial(nn.Dense, use_bias=cfg.norm == "layernorm", dtype=cfg.dtype,
+                            param_dtype=jnp.float32, kernel_init=nn.initializers.normal(0.02))
         if cfg.activation in ("swiglu", "geglu"):
             gate = dense(cfg.ffn_size, name="gate_proj")(x)
             up = dense(cfg.ffn_size, name="up_proj")(x)
@@ -751,7 +861,23 @@ class CausalLM(nn.Module):
         if return_hidden:
             return x
         # logits matmul runs in compute dtype (MXU rate); CE upcasts to fp32
-        if cfg.tie_embeddings:
+        if cfg.int8_weights:
+            # one int8 vocab projection covers both tied and untied heads
+            # (vocab padded to a lane multiple; quantize_params builds it)
+            Vpad = -(-cfg.vocab_size // 128) * 128
+            qw = self.param("logits_q", nn.initializers.zeros,
+                            (cfg.hidden_size, Vpad), jnp.int8)
+            sc = self.param("logits_scale", nn.initializers.ones,
+                            (_q_groups(cfg.hidden_size, cfg.int8_group_size), Vpad),
+                            jnp.float32)
+            Bx, Tx, Hx = x.shape
+            logits = _qmm2d(x.reshape(Bx * Tx, Hx), qw, sc)
+            logits = logits.reshape(Bx, Tx, Vpad)[..., :cfg.vocab_size]
+            if cfg.lm_head_bias:
+                lb = self.param("logits_bias", nn.initializers.zeros,
+                                (cfg.vocab_size, ), jnp.float32)
+                logits = logits + lb.astype(logits.dtype)
+        elif cfg.tie_embeddings:
             logits = emb.attend(x)
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias, dtype=cfg.dtype,
@@ -802,6 +928,87 @@ class CausalLMModel:
         return self.module.apply({"params": params}, input_ids, attn_mask)
 
     # ---- generation (KV cache) -------------------------------------------
+    def quantize_params(self, params, group_size=None, dtype=None):
+        """bf16/fp32 param tree -> the int8 serving tree an
+        ``int8_weights=True`` model expects: every projection kernel becomes
+        (int8 weight, fp32 per-group scales) in matmul layout, the vocab
+        projection becomes a padded ``logits_q``, and everything else casts
+        to the compute dtype. Host-side numpy — call before device placement
+        (reference ``replace_module`` int8 path / ``weight_quantizer``)."""
+        import numpy as np
+        cfg = self.cfg
+        if cfg.num_experts > 0:
+            raise NotImplementedError("int8 serving does not cover MoE experts yet")
+        gs_cfg = group_size if group_size is not None else (cfg.int8_group_size or 128)
+        dtype = np.dtype(jnp.dtype(dtype or cfg.dtype).name)
+
+        def quant(w):  # (..., K, N) -> int8 same shape + (..., G, N) scales
+            w = np.asarray(w, np.float32)
+            K = w.shape[-2]
+            gs = gs_cfg if gs_cfg and K % gs_cfg == 0 else K
+            G = K // gs
+            grouped = w.reshape(w.shape[:-2] + (G, gs, w.shape[-1]))
+            scale = np.abs(grouped).max(axis=-2, keepdims=True) / 127.0
+            scale = np.where(scale == 0, 1.0, scale)
+            q = np.clip(np.round(grouped / scale), -127, 127).astype(np.int8)
+            return (q.reshape(w.shape),
+                    np.ascontiguousarray(scale[..., 0, :], dtype=np.float32))
+
+        def to_dtype(x):
+            x = np.asarray(x)
+            return x.astype(dtype) if np.issubdtype(x.dtype, np.floating) else x
+
+        def conv_layer(sub):
+            out = {}
+            for k, v in sub.items():
+                if isinstance(v, dict):
+                    out[k] = conv_layer(v)
+                else:
+                    out[k] = to_dtype(v)
+            # rewrite projection kernels in place
+            for name in ("q_proj", "k_proj", "v_proj"):
+                node = out.get("attn", {}).get(name) if "attn" in out else out.get(name)
+                if node is not None and "kernel" in node:
+                    w = np.asarray(node.pop("kernel"), np.float32)
+                    w2 = w.reshape(w.shape[:-2] + (w.shape[-2] * w.shape[-1], ))  # (.., H, n*hd)
+                    node["kernel_q"], node["kernel_scale"] = quant(w2)
+            node = out.get("attn", {}).get("o_proj") if "attn" in out else out.get("o_proj")
+            if node is not None and "kernel" in node:
+                w = np.asarray(node.pop("kernel"), np.float32)
+                w2 = w.reshape(w.shape[:-3] + (w.shape[-3] * w.shape[-2], w.shape[-1]))
+                node["kernel_q"], node["kernel_scale"] = quant(w2)
+            mlp = out.get("mlp", out if "up_proj" in out else None)
+            if mlp is not None:
+                for name in ("gate_proj", "up_proj", "down_proj"):
+                    node = mlp.get(name)
+                    if node is not None and "kernel" in node:
+                        w = np.asarray(node.pop("kernel"), np.float32)
+                        node["kernel_q"], node["kernel_scale"] = quant(w)
+            return out
+
+        params = dict(params)
+        out = {}
+        Vpad = -(-cfg.vocab_size // 128) * 128
+        H = cfg.hidden_size
+        if cfg.tie_embeddings:
+            table = np.asarray(params["embed"]["embedding"], np.float32)  # (V, H)
+            head = table.T
+        else:
+            head = np.asarray(params["lm_head"]["kernel"], np.float32)  # (H, V)
+        head_p = np.zeros((H, Vpad), np.float32)
+        head_p[:, :cfg.vocab_size] = head
+        out["logits_q"], out["logits_scale"] = quant(head_p)
+        if cfg.lm_head_bias and "lm_head" in params and "bias" in params["lm_head"]:
+            out["logits_bias"] = np.asarray(params["lm_head"]["bias"], np.float32)
+        for k, v in params.items():
+            if k == "lm_head":
+                continue  # folded into logits_q
+            if k == "layers" or k.startswith("layer_"):
+                out[k] = conv_layer(v)
+            else:
+                out[k] = jax.tree_util.tree_map(to_dtype, v)
+        return out
+
     def init_cache(self, batch_size, max_len, dtype=None):
         """Preallocated KV cache — the analogue of the reference's inference
         workspace KV arena (``csrc/transformer/inference/includes/
@@ -1126,28 +1333,48 @@ class CausalLMModel:
         """
         t = dist.TENSOR_AXIS
         e = dist.EXPERT_AXIS
+        # int8 serving kernels are flattened to matmul layout; the column
+        # dim (last) splits over tensor for qkv/gate/up + the vocab head,
+        # matching scale columns. Row-split kernels (o/down) stay replicated
+        # under int8 (their per-column scales span the full contraction).
         if self.cfg.scan_layers:
             # scanned layers carry a leading L dim on every block param
-            return [
+            rules = [
                 (r"experts/(gate|up)_proj$", (None, e, None, t)),  # (L, E, H, F)
                 (r"experts/down_proj$", (None, e, t, None)),  # (L, E, F, H)
-                (r"attn/(q|k|v)_proj/kernel", (None, None, t, None)),  # (L, H, heads, hd)
-                (r"attn/o_proj/kernel", (None, t, None, None)),  # (L, heads, hd, H)
-                (r"mlp/(gate|up)_proj/kernel", (None, None, t)),  # col
-                (r"mlp/down_proj/kernel", (None, t, None)),  # row
-                (r"embed/embedding", (t, None)),
-                (r"lm_head/kernel", (None, t)),
+                (r"attn/(q|k|v)_proj/kernel$", (None, None, t, None)),  # (L, H, heads, hd)
+                (r"attn/o_proj/kernel$", (None, t, None, None)),  # (L, heads, hd, H)
+                (r"mlp/(gate|up)_proj/kernel$", (None, None, t)),  # col
+                (r"mlp/down_proj/kernel$", (None, t, None)),  # row
+                (r"embed/embedding$", (t, None)),
+                (r"lm_head/kernel$", (None, t)),
             ]
-        return [
+            if self.cfg.int8_weights:
+                rules += [
+                    (r"(q|k|v|gate|up)_proj/kernel_q$", (None, None, t)),  # (L, K, N)
+                    (r"(q|k|v|gate|up)_proj/kernel_scale$", (None, None, t)),  # (L, G, N)
+                    (r"logits_q$", (None, t)),
+                    (r"logits_scale$", (None, t)),
+                ]
+            return rules
+        rules = [
             (r"experts/(gate|up)_proj$", (e, None, t)),
             (r"experts/down_proj$", (e, t, None)),
-            (r"attn/(q|k|v)_proj/kernel", (None, t, None)),
-            (r"attn/o_proj/kernel", (t, None, None)),
-            (r"mlp/(gate|up)_proj/kernel", (None, t)),
-            (r"mlp/down_proj/kernel", (t, None)),
-            (r"embed/embedding", (t, None)),
-            (r"lm_head/kernel", (None, t)),
+            (r"attn/(q|k|v)_proj/kernel$", (None, t, None)),
+            (r"attn/o_proj/kernel$", (t, None, None)),
+            (r"mlp/(gate|up)_proj/kernel$", (None, t)),
+            (r"mlp/down_proj/kernel$", (t, None)),
+            (r"embed/embedding$", (t, None)),
+            (r"lm_head/kernel$", (None, t)),
         ]
+        if self.cfg.int8_weights:
+            rules += [
+                (r"(q|k|v|gate|up)_proj/kernel_q$", (None, t)),  # (K, N)
+                (r"(q|k|v|gate|up)_proj/kernel_scale$", (None, t)),  # (G, N)
+                (r"logits_q$", (None, t)),
+                (r"logits_scale$", (None, t)),
+            ]
+        return rules
 
     def expert_pattern(self):
         return r"moe/experts/" if self.cfg.num_experts > 0 else None

@@ -54,7 +54,13 @@ class DeepSpeedDataLoader:
         self.epoch = 0
         self._rng = np.random.default_rng(seed)
         self.len = None
-        if hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
+        if data_sampler is not None and hasattr(data_sampler, "total_samples"):
+            # batch-index samplers own membership AND epoch count; length
+            # derives from the sampler, not the dataset (a DeepSpeedDataSampler
+            # spans num_epochs worth of micro-batches)
+            mb = getattr(data_sampler, "micro_batch_size", batch_size)
+            self.len = int(data_sampler.total_samples) // max(1, int(mb))
+        elif hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
             n = len(dataset) // num_shards
             self.len = n // batch_size if drop_last else (n + batch_size - 1) // batch_size
 
@@ -86,6 +92,7 @@ class DeepSpeedDataLoader:
             order = np.arange(n)
             if self.shuffle:
                 self._rng.shuffle(order)
+        n = len(order)  # shard equalization must use the SAMPLED length
         if self.num_shards > 1:
             # equal shard sizes keep multi-host collectives in lockstep: drop
             # the tail so every process sees the same number of batches

@@ -278,6 +278,7 @@ class DeepSpeedEngine:
                                             .get("data_efficiency", {}))
                                        .get("data_sampling", {}))
         self._data_sampler = None
+        self._pending_sampler_state = None  # checkpoint state loaded pre-sampler
 
         # ---- timers / monitor / io ---------------------------------------
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
@@ -1312,6 +1313,11 @@ class DeepSpeedEngine:
                     f"{jax.process_count()}; adjust train_micro_batch_size_per_gpu")
             batch_size = global_micro // jax.process_count()
         if (data_sampler is None and self._data_sampling_cfg.get("enabled")
+                and route in (None, "train") and self._data_sampler is not None):
+            # a later train loader (e.g. per-epoch rebuild) REUSES the live
+            # sampler: its curriculum position and checkpoint state carry over
+            data_sampler = self._data_sampler
+        elif (data_sampler is None and self._data_sampling_cfg.get("enabled")
                 and route in (None, "train") and self._data_sampler is None
                 and hasattr(dataset, "__len__")):
             # train route only (reference wires ROUTE_TRAIN only): eval
@@ -1333,6 +1339,12 @@ class DeepSpeedEngine:
                 gradient_accumulation_steps=self.gradient_accumulation_steps(),
                 drop_last=self._config.dataloader_drop_last)
             self._data_sampler = data_sampler
+            if self._pending_sampler_state is not None:
+                # checkpoint loaded before the sampler existed: apply now
+                data_sampler.load_state_dict(self._pending_sampler_state)
+                self._pending_sampler_state = None
+                log_dist("deepspeed_io: restored data-sampler state from the loaded "
+                         "checkpoint", [0])
             log_dist(f"deepspeed_io: DeepSpeedDataSampler wired "
                      f"(curriculum={'on' if data_sampler.curriculum_enabled else 'off'}, "
                      f"{len(dataset)} samples/epoch)", [0])
@@ -1449,8 +1461,13 @@ class DeepSpeedEngine:
         self.micro_steps = client_sd.get("micro_steps", 0)
         if load_lr_scheduler_states and self.lr_scheduler is not None and client_sd.get("lr_scheduler"):
             self.lr_scheduler.load_state_dict(client_sd["lr_scheduler"])
-        if self._data_sampler is not None and client_sd.get("data_sampler"):
-            self._data_sampler.load_state_dict(client_sd["data_sampler"])
+        if client_sd.get("data_sampler"):
+            if self._data_sampler is not None:
+                self._data_sampler.load_state_dict(client_sd["data_sampler"])
+            else:
+                # loader not built yet (load-then-deepspeed_io order): stash
+                # and apply when the sampler is created
+                self._pending_sampler_state = client_sd["data_sampler"]
         self.loaded_checkpoint_tag = tag
         return load_dir, client_sd
 

@@ -1,0 +1,138 @@
+"""Reference-checkpoint import (VERDICT r3 item 8): consolidate DeepSpeed
+ZeRO stage-2/3 checkpoint fixtures (exact reference file layout) into fp32
+state dicts, convert into the native pytree, and continue training.
+
+Format parity target: ``deepspeed/utils/zero_to_fp32.py`` +
+``deepspeed/checkpoint/universal_checkpoint.py:12``.
+"""
+
+import os
+from collections import OrderedDict
+
+import numpy as np
+import pytest
+import torch
+import transformers
+
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import (get_fp32_state_dict_from_zero_checkpoint,
+                                      load_universal_checkpoint_params,
+                                      reference_checkpoint_to_params)
+from deepspeed_tpu.comm import comm
+
+
+def _tiny_gpt2():
+    cfg = transformers.GPT2Config(vocab_size=128, n_embd=32, n_layer=2, n_head=4,
+                                  n_positions=64)
+    torch.manual_seed(0)
+    return transformers.GPT2LMHeadModel(cfg).eval(), cfg
+
+
+def _write_zero2_checkpoint(d, model, ws=2):
+    """Fixture in the reference's stage-2 layout: one param group, fp32 flat
+    vector padded to 2*ws and split across ranks."""
+    os.makedirs(d, exist_ok=True)
+    names = [n for n, _ in model.named_parameters()]
+    shapes = OrderedDict((n, p.shape) for n, p in model.named_parameters())
+    flat = torch.cat([p.detach().float().reshape(-1) for _, p in model.named_parameters()])
+    align = 2 * ws
+    pad = (-flat.numel()) % align
+    flat = torch.cat([flat, torch.zeros(pad)])
+    parts = flat.chunk(ws)
+    sd = model.state_dict()
+    buffer_names = [n for n, _ in model.named_buffers() if n in sd]
+    torch.save({"module": sd, "param_shapes": [shapes], "buffer_names": buffer_names,
+                "shared_params": [["lm_head.weight", "transformer.wte.weight"]],
+                "dp_world_size": ws, "ds_version": "0.9.2"},
+               os.path.join(d, "mp_rank_00_model_states.pt"))
+    for r in range(ws):
+        torch.save({"optimizer_state_dict": {
+            "zero_stage": 2, "partition_count": ws,
+            "single_partition_of_fp32_groups": [parts[r].clone()]}},
+            os.path.join(d, f"zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
+    return names
+
+
+def _write_zero3_checkpoint(d, model, ws=2):
+    """Stage-3 layout: every param partitioned to ceil(n/ws) fragments; each
+    rank's flat group concatenates its fragment of every param."""
+    os.makedirs(d, exist_ok=True)
+    shapes = OrderedDict((n, p.shape) for n, p in model.named_parameters())
+    rank_frags = [[] for _ in range(ws)]
+    for _, p in model.named_parameters():
+        v = p.detach().float().reshape(-1)
+        part = -(-v.numel() // ws)
+        padded = torch.cat([v, torch.zeros(part * ws - v.numel())])
+        for r in range(ws):
+            rank_frags[r].append(padded[r * part:(r + 1) * part])
+    sd = model.state_dict()
+    buffer_names = [n for n, _ in model.named_buffers() if n in sd]
+    for r in range(ws):
+        torch.save({"module": sd if r == 0 else {}, "param_shapes": [shapes],
+                    "buffer_names": buffer_names, "shared_params": [],
+                    "ds_version": "0.9.2"},
+                   os.path.join(d, f"zero_pp_rank_{r}_mp_rank_00_model_states.pt"))
+        torch.save({"optimizer_state_dict": {
+            "zero_stage": 3, "partition_count": ws,
+            "fp32_flat_groups": [torch.cat(rank_frags[r])]}},
+            os.path.join(d, f"zero_pp_rank_{r}_mp_rank_00_optim_states.pt"))
+
+
+@pytest.mark.parametrize("writer,stage", [(_write_zero2_checkpoint, 2),
+                                          (_write_zero3_checkpoint, 3)])
+def test_zero_to_fp32_roundtrip(tmp_path, writer, stage):
+    model, _ = _tiny_gpt2()
+    tag = str(tmp_path / "global_step5")
+    writer(tag, model)
+    with open(tmp_path / "latest", "w") as f:
+        f.write("global_step5")
+    sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path))
+    ref = {n: p.detach().float().numpy() for n, p in model.named_parameters()}
+    for n, v in ref.items():
+        np.testing.assert_allclose(sd[n], v, atol=0, err_msg=f"{n} (stage {stage})")
+
+
+def test_reference_checkpoint_into_native_model(tmp_path):
+    """End to end: ZeRO-2 fixture -> native pytree via the GPT-2 policy;
+    logits match the original torch module; an engine seeded from it
+    continues training (losses finite + falling)."""
+    model_t, hf_cfg = _tiny_gpt2()
+    tag = str(tmp_path / "global_step9")
+    _write_zero2_checkpoint(tag, model_t)
+    with open(tmp_path / "latest", "w") as f:
+        f.write("global_step9")
+
+    model, params = reference_checkpoint_to_params(str(tmp_path), hf_cfg,
+                                                   dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, (2, 16)).astype(np.int32)
+    with torch.no_grad():
+        ref_logits = model_t(torch.from_numpy(ids).long()).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(ids)))
+    np.testing.assert_allclose(got, ref_logits, rtol=2e-3, atol=2e-3)
+
+    comm._state["mesh"] = None
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_batch_size": 8,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 10**9}, rng_seed=0)
+    batch = {"input_ids": rng.integers(0, 128, (8, 16)).astype(np.int32)}
+    losses = [float(engine.train_batch(batch=batch)) for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_universal_checkpoint_folder(tmp_path):
+    model, _ = _tiny_gpt2()
+    tag = tmp_path / "global_step3"
+    for n, p in model.named_parameters():
+        d = tag / "zero" / n
+        os.makedirs(d, exist_ok=True)
+        torch.save(p.detach().float(), d / "fp32.pt")
+    with open(tmp_path / "latest", "w") as f:
+        f.write("global_step3")
+    sd = load_universal_checkpoint_params(str(tmp_path))
+    for n, p in model.named_parameters():
+        np.testing.assert_allclose(sd[n], p.detach().float().numpy(), atol=0)
