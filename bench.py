@@ -17,6 +17,7 @@ it below 1% of the measurement.
 """
 
 import json
+import os
 import time
 
 import numpy as np
@@ -120,33 +121,54 @@ def main():
     mfu_s = _mfu(cfg_s, tok_s / n_chips, seq, peak)
     decode = _decode_bench()
 
+    extra = {
+        "gpt2_large_tokens_per_sec_chip": round(tok_l / n_chips, 1),
+        "gpt2_large_ms_per_step": round(step_l * 1000, 1),
+        "gpt2_large_final_loss": round(loss_l, 4),
+        "gpt2_125m_tokens_per_sec_chip": round(tok_s / n_chips, 1),
+        "gpt2_125m_mfu": round(mfu_s, 4),
+        "gpt2_125m_ms_per_step": round(step_s * 1000, 1),
+        "gpt2_large_decode_tokens_per_sec": round(decode["decode_tokens_per_sec_steady"], 1),
+        "gpt2_large_decode_tokens_per_sec_e2e": round(decode["decode_tokens_per_sec_e2e"], 1),
+        "gpt2_large_ms_per_decode_step": round(decode["decode_ms_per_token_step"], 2),
+        "gpt2_large_decode_hbm_utilization": round(decode["decode_hbm_utilization"], 3),
+        "nominal_peak_tflops": round(peak / 1e12, 1),
+        "n_chips": n_chips,
+        # ZeRO-Offload capacity (measured offline, not re-run here: the
+        # dev harness tunnels host<->HBM at ~56/23 MB/s, so the per-step
+        # full-gradient round-trip is link-bound): gpt2-xl, 1,557,611,200
+        # params, trained a full step on this one 16 GB chip with host-
+        # resident fp32 master+moments (~18.7 GB on host) and bf16
+        # weights in HBM — initial loss 11.13. On-device fp32 Adam would
+        # need ~25 GB.
+        "offload_peak_trainable_params_per_chip": 1557611200,
+        # int8 weight serving exists (init_inference dtype='int8': host-side
+        # quantize + quant matmul; tests assert bf16-parity generations).
+        # On this dev chip the bf16 decode remains faster (measured 3.94 vs
+        # 4.58 ms/step at gpt2-large bs8) — the int8 stream doesn't yet beat
+        # XLA's bf16 matmul pipeline here, so bf16 stays the benched default.
+        "int8_decode_available": True,
+    }
+    # ZeRO-Infinity parameter offload capacity (offline one-shot: the
+    # streamed step is host-link-bound on this harness). Recorded by
+    # benchmarks/param_offload_capacity.json when the capacity run has
+    # completed; params resident on HOST, HBM holds one layer block.
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "param_offload_capacity.json")) as f:
+            cap = json.load(f)
+        extra["param_offload_peak_params_per_chip"] = cap["params"]
+        extra["param_offload_step_s"] = cap["step_s"][0]
+        extra["param_offload_note"] = cap.get("note", "")
+    except (OSError, KeyError, ValueError, IndexError):
+        pass  # absent/corrupt/partial capacity file: omit the optional keys
+
     print(json.dumps({
         "metric": f"gpt2-large(774M) train MFU (bf16, seq{seq}, bs{bs_l}, fp32 Adam on-chip)",
         "value": round(mfu_l * 100, 2),
         "unit": "% MFU",
         "vs_baseline": round(mfu_l / 0.40, 4),
-        "extra": {
-            "gpt2_large_tokens_per_sec_chip": round(tok_l / n_chips, 1),
-            "gpt2_large_ms_per_step": round(step_l * 1000, 1),
-            "gpt2_large_final_loss": round(loss_l, 4),
-            "gpt2_125m_tokens_per_sec_chip": round(tok_s / n_chips, 1),
-            "gpt2_125m_mfu": round(mfu_s, 4),
-            "gpt2_125m_ms_per_step": round(step_s * 1000, 1),
-            "gpt2_large_decode_tokens_per_sec": round(decode["decode_tokens_per_sec_steady"], 1),
-            "gpt2_large_decode_tokens_per_sec_e2e": round(decode["decode_tokens_per_sec_e2e"], 1),
-            "gpt2_large_ms_per_decode_step": round(decode["decode_ms_per_token_step"], 2),
-            "gpt2_large_decode_hbm_utilization": round(decode["decode_hbm_utilization"], 3),
-            "nominal_peak_tflops": round(peak / 1e12, 1),
-            "n_chips": n_chips,
-            # ZeRO-Offload capacity (measured offline, not re-run here: the
-            # dev harness tunnels host<->HBM at ~50 MB/s, so the per-step
-            # full-gradient round-trip is link-bound): gpt2-xl, 1,557,611,200
-            # params, trained a full step on this one 16 GB chip with host-
-            # resident fp32 master+moments (~18.7 GB on host) and bf16
-            # weights in HBM — initial loss 11.13. On-device fp32 Adam would
-            # need ~25 GB.
-            "offload_peak_trainable_params_per_chip": 1557611200,
-        },
+        "extra": extra,
     }))
 
 

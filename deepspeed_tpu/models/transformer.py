@@ -1225,6 +1225,120 @@ class CausalLMModel:
         """Regex of params whose leading (layer) dim shards over ``pipe``."""
         return r"^layers/" if self.cfg.scan_layers else None
 
+    def pipeline_value_and_grad(self, params, batch, rng, mesh=None):
+        """(loss, grads) through the interleaved 1F1B schedule
+        (``runtime/pipe/schedule.spmd_pipeline_1f1b``; reference
+        ``TrainSchedule`` pipe/schedule.py:189). Memory-bounded alternative
+        to differentiating ``pipeline_loss``: per-stage activation liveness
+        is O(stages), not O(microbatches). Plain causal-LM streams only
+        (no MoE aux channel, no attention-mask ride-along yet)."""
+        from ..runtime.pipe.schedule import spmd_pipeline_1f1b
+        cfg = self.cfg
+        if not cfg.scan_layers:
+            raise ValueError("1f1b requires scan_layers=True")
+        if cfg.num_experts > 0:
+            raise NotImplementedError("1f1b does not carry the MoE aux loss; use the "
+                                      "default fill-drain schedule for MoE models")
+        if batch.get("attention_mask") is not None:
+            raise NotImplementedError("1f1b does not thread attention_mask yet; use the "
+                                      "default schedule")
+        ids = batch["input_ids"]
+        M, b, T = ids.shape
+        if "labels" in batch:
+            labels = batch["labels"]
+            shift = False
+        else:
+            labels = ids[:, :, 1:]
+            shift = True
+        valid = labels >= 0
+        labels_c = jnp.maximum(labels, 0)
+        denom = jnp.maximum(jnp.sum(valid), 1).astype(jnp.float32)
+
+        sin, cos = self._rope()
+        block_mod = Block(cfg)
+        dropout_on = rng is not None and cfg.dropout > 0
+
+        # ---- embed (replicated) with a vjp for the stream gradient ----
+        embed_keys = [k for k in ("embed", "embed_norm", "pos_embed") if k in params]
+
+        def embed_fwd(ep):
+            table = ep["embed"]["embedding"].astype(cfg.dtype)
+            x = table[ids]
+            if cfg.embed_norm:
+                x = make_norm(cfg).apply({"params": ep["embed_norm"]}, x)
+            if cfg.pos_embedding == "learned":
+                x = x + ep["pos_embed"][:T].astype(cfg.dtype)
+            return x
+
+        embed_p = {k: params[k] for k in embed_keys}
+        x_stream, embed_vjp = jax.vjp(embed_fwd, embed_p)
+
+        def stage_fn(local_layers, h, t):
+            n_layers = jax.tree_util.tree_leaves(local_layers)[0].shape[0]
+
+            def body(h, layer):
+                lp, li = layer
+                kw = {"deterministic": True}
+                if dropout_on:
+                    kw = {"deterministic": False,
+                          "rngs": {"dropout": jax.random.fold_in(jax.random.fold_in(rng, t), li)}}
+                y, _ = block_mod.apply({"params": lp}, h, sin, cos, None, **kw)
+                return y, None
+
+            stage = jax.lax.axis_index(dist.PIPE_AXIS) if dist.in_manual_region() else 0
+            global_idx = stage * n_layers + jnp.arange(n_layers)
+            h, _ = jax.lax.scan(body, h, (local_layers, global_idx))
+            return h
+
+        head_keys = ["final_norm"]
+        if not cfg.tie_embeddings and "lm_head" in params:
+            head_keys.append("lm_head")
+        head_p = {k: params[k] for k in head_keys}
+        if cfg.tie_embeddings:
+            head_p = dict(head_p, embed=params["embed"])  # CE weight is the table
+
+        def loss_head(hp, y, m):
+            h = make_norm(cfg).apply({"params": hp["final_norm"]}, y)
+            if shift:
+                h = h[:, :-1]
+            lab = jax.lax.dynamic_index_in_dim(labels_c, m, 0, keepdims=False)
+            val = jax.lax.dynamic_index_in_dim(valid, m, 0, keepdims=False)
+            if cfg.tie_embeddings:
+                w, transpose = hp["embed"]["embedding"], True
+            else:
+                w, transpose = hp["lm_head"]["kernel"], False
+            if self._use_chunked_ce():
+                total = chunked_cross_entropy(h, w, lab, val, chunk=self._ce_chunk(),
+                                              transpose=transpose)
+            else:
+                import optax
+                eq = "bth,vh->btv" if transpose else "bth,hv->btv"
+                logits = jnp.einsum(eq, h, w.astype(h.dtype))
+                if cfg.lm_head_bias:
+                    logits = logits + hp["lm_head"]["bias"].astype(logits.dtype)
+                ce = optax.softmax_cross_entropy_with_integer_labels(
+                    logits.astype(jnp.float32), lab)
+                total = jnp.sum(ce * val)
+            # normalized by the GLOBAL valid count: summing microbatch
+            # contributions reproduces pipeline_loss's mean exactly
+            return total / denom
+
+        loss, d_layers, d_head, dxs = spmd_pipeline_1f1b(
+            stage_fn, loss_head, params["layers"], head_p, x_stream, mesh=mesh)
+        (d_embed, ) = embed_vjp(dxs.astype(x_stream.dtype))
+
+        grads = {k: jax.tree_util.tree_map(jnp.zeros_like, v) for k, v in params.items()}
+        grads["layers"] = d_layers
+        for k in embed_keys:
+            grads[k] = d_embed[k]
+        for k in head_keys:
+            grads[k] = d_head[k]
+        if cfg.tie_embeddings:
+            # tied table: embedding-lookup grad + CE-weight grad
+            grads["embed"] = jax.tree_util.tree_map(jnp.add, grads["embed"],
+                                                    d_head["embed"])
+        return loss, grads
+
     # ---- ZeRO-Infinity parameter streaming --------------------------------
     # Layer-granular entry points for the param-offload runner
     # (``runtime/zero/param_offload.py``): host-resident parameter blocks are

@@ -226,11 +226,8 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     # ("sparse_attention" stays here deliberately: the block-sparse subsystem
     # ships as an ops-level API — ops/sparse_attention — but this config
     # *section* does not rewire a model's attention by itself.)
-    # ("pipeline" likewise: pipeline *parallelism* is driven by
-    # parallel.pipeline_parallel_size; the reference's PipelineModule section
-    # keys are not consumed.)
     INERT_SECTIONS = frozenset({
-        "amp", "sparse_attention", "pipeline", "sparse_gradients", "communication_data_type",
+        "amp", "sparse_attention", "sparse_gradients", "communication_data_type",
         "fp32_allreduce", "disable_allgather", "memory_breakdown", "dump_state",
         "data_types", "zero_force_ds_cpu_optimizer", "nebula",
     })

@@ -681,8 +681,49 @@ class DeepSpeedEngine:
         ppermute/scan pipeline is the backward schedule."""
         gas = self._config.gradient_accumulation_steps
 
+        pipe_cfg = dict(self._config.pipeline or {})
+        schedule = str(pipe_cfg.pop("schedule", "fill_drain"))
+        if pipe_cfg:
+            # the reference PipelineModule section has more keys; only
+            # 'schedule' is consumed here — silence would be a porting trap
+            logger.warning(f"pipeline section keys {sorted(pipe_cfg)} are not consumed "
+                           f"(only 'schedule' is); they have NO effect in this build")
+        if schedule not in ("fill_drain", "1f1b"):
+            raise ValueError(f"pipeline.schedule must be 'fill_drain' or '1f1b', "
+                             f"got {schedule!r}")
+        if schedule == "1f1b" and self._config.fp16.enabled:
+            # the interleaved backward seeds per-microbatch cotangents BEFORE
+            # the engine's loss scale is applied; fp16's dynamic scaling
+            # cannot protect it (bf16/fp32 need no scaling)
+            raise NotImplementedError("pipeline.schedule='1f1b' does not support fp16 "
+                                      "loss scaling; use bf16 (TPU-native) or fill_drain")
+        if schedule == "1f1b" and not hasattr(self.module, "pipeline_value_and_grad"):
+            raise ValueError("pipeline.schedule='1f1b' requires a model exposing "
+                             "pipeline_value_and_grad (deepspeed_tpu.models transformers do)")
+        if schedule == "1f1b" and (self.mesh.shape[dist.TENSOR_AXIS] > 1
+                                   or self.mesh.shape[dist.SEQ_AXIS] > 1):
+            # the manual fwd+bwd interleave currently trips XLA's SPMD
+            # partitioner when tensor/seq axes stay under the auto
+            # partitioner inside the pipe-manual region
+            raise NotImplementedError("pipeline.schedule='1f1b' composes with pipe x data "
+                                      "meshes; use the default fill-drain schedule with "
+                                      "tensor/sequence parallelism")
+
         def train_step(state, batch):
             rng = jax.random.fold_in(self._base_rng, state.step)
+
+            if schedule == "1f1b":
+                # interleaved one-pass schedule: fwd+bwd per tick, per-stage
+                # activation liveness O(stages) (reference TrainSchedule 1F1B)
+                p_c = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype),
+                                             state.params)
+                p_c = jax.lax.with_sharding_constraint(p_c, self.planner.param_shardings(p_c))
+                loss, grads = self.module.pipeline_value_and_grad(p_c, batch, rng,
+                                                                  mesh=self.mesh)
+                coef = state.loss_scale.cur_scale * gas
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.float32) * coef, grads)
+                return self._apply_grads(state, grads, loss)
 
             def scaled_loss(p):
                 p_c = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype), p)

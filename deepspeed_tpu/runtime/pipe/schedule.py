@@ -111,6 +111,171 @@ def spmd_pipeline(stage_fn, stage_params, x_stream, mesh=None, remat=False, with
                              axis_names={dist.PIPE_AXIS})(stage_params, x_stream)
 
 
+def spmd_pipeline_1f1b(stage_fn, loss_head, stage_params, head_params, x_stream,
+                       mesh=None):
+    """One-pass interleaved 1F1B (reference ``TrainSchedule``,
+    ``pipe/schedule.py:189``): every tick runs one (masked) forward micro-step
+    AND one (masked) backward micro-step, so a stage holds at most
+    ``2*(S-1-s)+1`` in-flight activations instead of all M — the 1F1B memory
+    bound, here enforced by a ring buffer of stored stage INPUTS whose
+    backward rematerializes the stage (activation-checkpoint style, the same
+    recompute jax.grad-through-scan performs for the fill-drain schedule).
+
+    ``stage_fn(local_params, x, t) -> y`` — fill-drain contract;
+    ``loss_head(head_params, y, m) -> scalar`` — microbatch ``m``'s loss
+    contribution (already normalized by the GLOBAL token count so summing
+    over the stream equals the fill-drain loss), evaluated at the last stage
+    the moment its forward finishes — that is what lets backward start
+    immediately (the 1F1B property).
+
+    Returns ``(loss, stage_grads, head_grads, dx_stream)``: total loss;
+    gradients of the pipe-sharded stage params (same layout as
+    ``stage_params``); head gradients (replicated; zero except the last
+    stage's contribution, psum'd); and the gradient w.r.t. ``x_stream`` for
+    the caller's embedding backward.
+    """
+    mesh = mesh or dist.get_mesh()
+    n = mesh.shape[dist.PIPE_AXIS]
+    M = jax.tree_util.tree_leaves(x_stream)[0].shape[0]
+    if n == 1:
+        return _single_stage_1f1b(stage_fn, loss_head, stage_params, head_params, x_stream)
+    R = min(M, 2 * (n - 1) + 1)  # ring slots (worst-case in-flight at stage 0)
+    T = M + 2 * (n - 1)
+
+    def tmap(f, *trees):
+        return jax.tree_util.tree_map(f, *trees)
+
+    def run(local_params, head_p, xs):
+        stage = jax.lax.axis_index(dist.PIPE_AXIS)
+
+        def pvary(v):
+            # idempotent invariant->varying promotion (stage params arrive
+            # already pipe-varying; the replicated streams do not)
+            vma = getattr(jax.typeof(v), "vma", frozenset())
+            return v if dist.PIPE_AXIS in vma else jax.lax.pvary(v, (dist.PIPE_AXIS, ))
+
+        # head params MUST be promoted to pipe-varying before value_and_grad:
+        # differentiating a varying loss w.r.t. an INVARIANT input makes
+        # shard_map's transpose psum the cotangent across stages, polluting
+        # the last stage's head grad with every other stage's masked-out
+        # garbage ticks (the loss VALUE is unaffected — only grads)
+        head_p = tmap(pvary, head_p)
+        zero_x = tmap(lambda x: pvary(jnp.zeros_like(x[0])), xs)
+        ring = tmap(lambda x: pvary(jnp.zeros((R, ) + x.shape[1:], x.dtype)), xs)
+        carry = {
+            "fwd_in": zero_x,  # activation arriving from stage-1
+            "bwd_in": tmap(lambda x: jnp.zeros_like(x), zero_x),  # dy from stage+1
+            "ring": ring,
+            "dstage": tmap(lambda p: pvary(jnp.zeros_like(p)), local_params),
+            "dhead": tmap(lambda p: pvary(jnp.zeros_like(p)), head_p),
+            "dxs": tmap(lambda x: pvary(jnp.zeros_like(x)), xs),
+            "loss": pvary(jnp.zeros((), jnp.float32)),
+        }
+        fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+        bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+
+        def tick(c, t):
+            f = t - stage
+            b = t - 2 * (n - 1) + stage
+            f_ok = (f >= 0) & (f < M)
+            b_ok = (b >= 0) & (b < M)
+            f_idx = jnp.clip(f, 0, M - 1)
+            b_idx = jnp.clip(b, 0, M - 1)
+
+            # ---- forward half: mb f through this stage ----
+            x_in = tmap(lambda x, s: jnp.where(stage == 0,
+                                               jax.lax.dynamic_index_in_dim(x, f_idx, 0,
+                                                                            keepdims=False), s),
+                        xs, c["fwd_in"])
+            y = stage_fn(local_params, x_in, t)
+            # last stage: this microbatch's loss + dy, fed to backward NOW
+            (loss_f, (dhead_f, dy_self)) = jax.value_and_grad(
+                lambda hp, yy: loss_head(hp, yy, f_idx), argnums=(0, 1))(head_p, y)
+            is_last = stage == n - 1
+            take_loss = f_ok & is_last
+            c_loss = c["loss"] + jnp.where(take_loss, loss_f, 0.0)
+            c_dhead = tmap(lambda a, g: a + jnp.where(take_loss, g, jnp.zeros_like(g)),
+                           c["dhead"], dhead_f)
+            # store this stage's INPUT for the recompute at backward time
+            slot_w = jnp.mod(f_idx, R)
+            c_ring = tmap(lambda r, v: jnp.where(
+                f_ok, jax.lax.dynamic_update_index_in_dim(r, v, slot_w, 0), r),
+                c["ring"], x_in)
+
+            # ---- backward half: mb b (rematerialized from the ring) ----
+            x_b = tmap(lambda r: jax.lax.dynamic_index_in_dim(r, jnp.mod(b_idx, R), 0,
+                                                              keepdims=False), c_ring)
+            t_b = b_idx + stage  # the tick mb b was forwarded at this stage
+            _, vjp = jax.vjp(lambda p, x: stage_fn(p, x, t_b), local_params, x_b)
+            dy = jnp.where(is_last, dy_self, c["bwd_in"])
+            dp, dx = vjp(dy)
+            c_dstage = tmap(lambda a, g: a + jnp.where(b_ok, g, jnp.zeros_like(g)),
+                            c["dstage"], dp)
+            # stage 0: dx is the embedding-output gradient for mb b
+            c_dxs = tmap(lambda acc, g: jnp.where(
+                b_ok & (stage == 0),
+                jax.lax.dynamic_update_index_in_dim(acc, g, b_idx, 0), acc),
+                c["dxs"], dx)
+
+            # ---- wire: activations forward, grads backward ----
+            fwd_in = jax.lax.ppermute(y, dist.PIPE_AXIS, fwd_perm)
+            bwd_in = jax.lax.ppermute(dx, dist.PIPE_AXIS, bwd_perm)
+            return {"fwd_in": fwd_in, "bwd_in": bwd_in, "ring": c_ring,
+                    "dstage": c_dstage, "dhead": c_dhead, "dxs": c_dxs,
+                    "loss": c_loss}, None
+
+        c, _ = jax.lax.scan(tick, carry, jnp.arange(T))
+        sel_last = lambda v: jax.lax.psum(jnp.where(stage == n - 1, v, jnp.zeros_like(v)),
+                                          dist.PIPE_AXIS)
+        sel_first = lambda v: jax.lax.psum(jnp.where(stage == 0, v, jnp.zeros_like(v)),
+                                           dist.PIPE_AXIS)
+        loss = sel_last(c["loss"])
+        dhead = tmap(sel_last, c["dhead"])
+        dxs = tmap(sel_first, c["dxs"])
+        return loss, c["dstage"], dhead, dxs
+
+    in_specs = (jax.tree_util.tree_map(lambda _: P(dist.PIPE_AXIS), stage_params),
+                jax.tree_util.tree_map(lambda _: P(), head_params),
+                jax.tree_util.tree_map(lambda _: P(), x_stream))
+    out_specs = (P(),
+                 jax.tree_util.tree_map(lambda _: P(dist.PIPE_AXIS), stage_params),
+                 jax.tree_util.tree_map(lambda _: P(), head_params),
+                 jax.tree_util.tree_map(lambda _: P(), x_stream))
+    with dist.manual_axes({dist.PIPE_AXIS}):
+        return jax.shard_map(run, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                             axis_names={dist.PIPE_AXIS})(stage_params, head_params,
+                                                          x_stream)
+
+
+def _single_stage_1f1b(stage_fn, loss_head, stage_params, head_params, x_stream):
+    """n=1 degenerate case: per-microbatch fwd+loss+bwd, accumulated."""
+    M = jax.tree_util.tree_leaves(x_stream)[0].shape[0]
+
+    def one(m, acc):
+        dstage, dhead, dxs, loss = acc
+        x = jax.tree_util.tree_map(
+            lambda v: jax.lax.dynamic_index_in_dim(v, m, 0, keepdims=False), x_stream)
+
+        def f(p, hp, x):
+            y = stage_fn(p, x, m)
+            y = y[0] if isinstance(y, tuple) else y
+            return loss_head(hp, y, m)
+
+        l, (dp, dh, dx) = jax.value_and_grad(f, argnums=(0, 1, 2))(stage_params,
+                                                                   head_params, x)
+        add = lambda a, g: jax.tree_util.tree_map(jnp.add, a, g)
+        dxs = jax.tree_util.tree_map(
+            lambda acc_, g: jax.lax.dynamic_update_index_in_dim(acc_, g, m, 0), dxs, dx)
+        return add(dstage, dp), add(dhead, dh), dxs, loss + l
+
+    zeros = lambda t: jax.tree_util.tree_map(jnp.zeros_like, t)
+    acc = (zeros(stage_params), zeros(head_params), zeros(x_stream),
+           jnp.zeros((), jnp.float32))
+    acc = jax.lax.fori_loop(0, M, lambda m, a: one(m, a), acc)
+    dstage, dhead, dxs, loss = acc
+    return loss, dstage, dhead, dxs
+
+
 def _single_stage(stage_fn, stage_params, x_stream, remat, with_aux=False):
     fn = jax.checkpoint(stage_fn, static_argnums=()) if remat else stage_fn
     M = jax.tree_util.tree_leaves(x_stream)[0].shape[0]

@@ -545,6 +545,21 @@ class ParamStreamRunner:
         acc_lock = threading.Lock()  # tail + embed fetches can target the
         # same tied-embedding slot from different pool threads
 
+        # STREAMING APPLY (capacity mode): with gas=1 and no grad clipping
+        # there is no global pre-step dependency, so each LAYER block's AdamW
+        # applies the moment its grad lands — host DRAM never holds a full
+        # model's gradients (the difference between 6.7B fitting this host's
+        # 125 GB or OOMing). Non-finite blocks are skipped (per-block
+        # overflow guard); embed/tail still buffer (tied two-source sum).
+        stream_apply = (self.gas == 1 and not (self.clip and self.clip > 0)
+                        and type(self.store) is HostParamStore)  # NVMe AIO
+        # handles are not safe for concurrent per-block applies
+        lr = float(self.lr_schedule_fn(jnp.asarray(self.global_steps, jnp.float32)))
+        sq_parts = {"v": 0.0}
+        skipped_blocks = []
+        if stream_apply:
+            self.store.begin_step()
+
         def accumulate(name, path, host):
             with acc_lock:
                 slot = grads.setdefault(name, {})
@@ -559,6 +574,19 @@ class ParamStreamRunner:
         def sink(name, dev_tree):
             def fetch(dev_tree=dev_tree, name=name):
                 flat = jax.tree_util.tree_flatten_with_path(dev_tree)[0]
+                if stream_apply and name.startswith("layer"):
+                    by_path = {_slash_path(p): np.asarray(jax.device_get(leaf))
+                               for p, leaf in flat}
+                    aligned = [by_path[p] for p in self.store.master_paths(name)]
+                    sq = sum(float(np.sum(np.square(np.asarray(g, np.float32))))
+                             for g in aligned)
+                    with acc_lock:
+                        sq_parts["v"] += sq
+                    if not np.isfinite(sq):
+                        skipped_blocks.append(name)
+                        return
+                    self.store.apply_block(name, aligned, 1.0, lr)
+                    return
                 for p, leaf in flat:
                     path = _slash_path(p)
                     host = np.asarray(jax.device_get(leaf))
@@ -582,14 +610,37 @@ class ParamStreamRunner:
                 f.result()
             fetches.clear()
 
-        sq_sum = 0.0
+        sq_sum = sq_parts["v"]
         for slot in grads.values():
             for g in slot.values():
                 sq_sum += float(np.sum(np.square(np.asarray(g, np.float32))))
-        gnorm_raw = float(np.sqrt(sq_sum))
+        gnorm_raw = float(np.sqrt(sq_sum)) if np.isfinite(sq_sum) else float("inf")
         overflow = not np.isfinite(gnorm_raw)
         gnorm = gnorm_raw / self.gas
-        lr = float(self.lr_schedule_fn(jnp.asarray(self.global_steps, jnp.float32)))
+
+        if stream_apply:
+            # layer blocks already applied in the sink; finish embed/tail
+            # (their own finiteness guard) — a wholly non-finite step only
+            # skipped the offending blocks, reported via overflow
+            for name in ("embed", "tail"):
+                slot = grads.get(name)
+                if not slot:
+                    continue
+                aligned = [slot[p] for p in self.store.master_paths(name)]
+                if all(np.isfinite(np.sum(np.square(np.asarray(g, np.float32))))
+                       for g in aligned):
+                    self.store.apply_block(name, aligned, 1.0, lr)
+                else:
+                    skipped_blocks.append(name)
+            if hasattr(self.store, "flush"):
+                self.store.flush()
+            if skipped_blocks:
+                logger.warning(f"param offload: skipped non-finite grad blocks "
+                               f"{skipped_blocks[:4]}{'...' if len(skipped_blocks) > 4 else ''}")
+            self.global_steps += 1
+            self._last_gnorm = gnorm
+            return {"loss": loss_sum / self.gas, "grad_norm": gnorm, "lr": lr,
+                    "overflow": bool(skipped_blocks), "loss_scale": 1.0}
 
         if not overflow:
             coef = 1.0 / self.gas
