@@ -1,0 +1,73 @@
+"""Spatial (diffusion) ops: the UNet/VAE elementwise surface.
+
+Counterpart of reference ``csrc/spatial/csrc/opt_bias_add.cu`` +
+``pt_binding.cpp`` (the diffusers acceleration kernels: ``bias_add``,
+``bias_add_add``, ``bias_add_bias_add`` over NCHW activations) and the
+channels-last groupnorm the injected UNet path leans on. On TPU these are
+pure fusion targets — XLA folds the adds into the surrounding conv/matmul
+epilogues, so the value of this module is API parity plus the NHWC layout
+contract (TPU convs want channels-last; the reference's NCHW kernels do
+not): conversion utilities included.
+
+The reference's ``generic_injection`` rewrites diffusers' attention modules;
+here diffusion attention runs through the same Pallas flash/decode kernels
+as the language models (``ops/pallas``) once tensors are in (B, heads, T,
+head_dim) — ``spatial_attention`` below does the NHWC<->bhtd plumbing.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def nchw_to_nhwc(x):
+    return jnp.transpose(x, (0, 2, 3, 1))
+
+
+def nhwc_to_nchw(x):
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def bias_add(activation, bias):
+    """NHWC bias add (reference ``opt_bias_add``): activation (B, H, W, C)
+    + bias (C,)."""
+    return activation + bias.astype(activation.dtype)
+
+
+def bias_add_add(activation, bias, other):
+    """activation + bias + other (reference ``opt_bias_add_add``: the UNet
+    residual epilogue)."""
+    return activation + bias.astype(activation.dtype) + other
+
+
+def bias_add_bias_add(activation, bias, other, other_bias):
+    """(activation + bias) + (other + other_bias) — reference
+    ``opt_bias_add_bias_add``, the dual-stream epilogue."""
+    return (activation + bias.astype(activation.dtype)
+            + other + other_bias.astype(activation.dtype))
+
+
+def group_norm_nhwc(x, scale, bias, groups=32, eps=1e-5):
+    """GroupNorm over NHWC (B, H, W, C) with fp32 statistics — the UNet/VAE
+    normalization the reference runs via torch GroupNorm between its fused
+    kernels."""
+    B, H, W, C = x.shape
+    if C % groups:
+        raise ValueError(f"channels {C} not divisible by groups {groups}")
+    xg = x.astype(jnp.float32).reshape(B, H, W, groups, C // groups)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xn = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(B, H, W, C)
+    return (xn * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def spatial_attention(q, k, v, heads, block_q=256, block_kv=256):
+    """Self-attention over flattened spatial tokens (the diffusers
+    ``Attention`` block): q/k/v (B, H*W, C) -> (B, H*W, C), computed through
+    the Pallas flash kernel in bhtd layout (non-causal)."""
+    from .pallas.flash_attention import flash_attention
+    B, T, C = q.shape
+    hd = C // heads
+    to_bhtd = lambda t: jnp.transpose(t.reshape(B, T, heads, hd), (0, 2, 1, 3))
+    out = flash_attention(to_bhtd(q), to_bhtd(k), to_bhtd(v), False,
+                          min(block_q, T), min(block_kv, T), None)
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(B, T, C)

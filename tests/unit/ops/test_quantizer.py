@@ -163,3 +163,4 @@ def test_megatron_unknown_partitioned_key_raises(tmp_path):
         paths.append(p)
     with pytest.raises(ValueError, match="no known partitioning rule"):
         SDLoaderFactory.get_sd_loader(paths).load()
+
