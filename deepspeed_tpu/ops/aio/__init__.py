@@ -63,6 +63,18 @@ def aio_available():
     return _build_lib() is not None
 
 
+def aligned_empty(shape, dtype=np.float32, align=4096):
+    """Uninitialized array whose data pointer is ``align``-byte aligned —
+    buffers allocated this way let the native pool's O_DIRECT fast path fire
+    (the analogue of the reference's pinned aio buffers,
+    ``csrc/aio/py_lib/deepspeed_pin_tensor.cpp``)."""
+    dtype = np.dtype(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    raw = np.empty(nbytes + align, np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + nbytes].view(dtype).reshape(shape)
+
+
 class AsyncIOHandle:
     """``async_pread``/``async_pwrite``/``wait`` over host numpy buffers.
 
@@ -127,7 +139,6 @@ class AsyncIOHandle:
     def wait(self):
         if self._h is None:
             first_err = None
-            n = len(self._pending_sync)
             for arr, filename, is_write, off in self._pending_sync:
                 try:
                     view = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
@@ -150,7 +161,7 @@ class AsyncIOHandle:
             self._keepalive.clear()
             if first_err is not None:
                 raise OSError(f"async IO request failed: {first_err}") from first_err
-            return n
+            return 0  # native-contract parity: number of FAILED requests
         failed = self._lib.ds_aio_wait(self._h)
         self._keepalive.clear()
         if failed:

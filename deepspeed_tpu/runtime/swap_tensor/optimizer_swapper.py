@@ -27,7 +27,7 @@ import numpy as np
 
 import jax
 
-from ...ops.aio import AsyncIOHandle
+from ...ops.aio import AsyncIOHandle, aligned_empty
 from ...utils.logging import log_dist
 from ..zero.offload import HostOffloadOptimizer, _TRANSFER_POOL
 
@@ -89,7 +89,7 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
     def _read_block(self, i):
         blk = self.blocks[i]
         paths = self._paths(i)
-        bufs = {kind: np.empty(blk.size, np.float32) for kind in ("master", "m", "v")}
+        bufs = {kind: aligned_empty((blk.size, ), np.float32) for kind in ("master", "m", "v")}
         for kind, buf in bufs.items():
             self._read_h.async_pread(buf, paths[kind])
         if not self.pipeline_read:
@@ -128,7 +128,7 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
         """Serial file read of one owned block (debug/full-leaf accessors;
         must run on the caller thread — the AIO handles are not re-entrant)."""
         blk = self.blocks[i]
-        buf = np.empty(blk.size, np.float32)
+        buf = aligned_empty((blk.size, ), np.float32)
         self._read_h.async_pread(buf, self._paths(i)[kind])
         self._read_h.wait()
         return buf
@@ -152,7 +152,7 @@ class NVMeOffloadOptimizer(HostOffloadOptimizer):
     def _iter_state_blocks(self):
         for kind in ("master", "m", "v"):
             for i, blk in enumerate(self.blocks):
-                buf = np.empty(blk.size, np.float32)
+                buf = aligned_empty((blk.size, ), np.float32)
                 self._read_h.async_pread(buf, self._paths(i)[kind])
                 self._read_h.wait()
                 yield kind, i, buf

@@ -47,6 +47,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...comm import comm as dist
 from ...ops.adam.cpu_adam import DeepSpeedCPUAdam, f32_to_bf16
+from ...ops.aio import aligned_empty
 from ...utils.logging import log_dist, logger
 from .offload import _TRANSFER_POOL, _slash_path
 
@@ -224,7 +225,7 @@ class NVMeParamStore(HostParamStore):
         if name in self._prefetched:
             return
         n = self._block_size(name)
-        bufs = tuple(np.empty(n, np.float32) for _ in range(3))
+        bufs = tuple(aligned_empty((n, ), np.float32) for _ in range(3))
         for buf, kind in zip(bufs, ("master", "m", "v")):
             self._read_h.async_pread(buf, self._file(name, kind))
         self._prefetched[name] = bufs
@@ -267,7 +268,7 @@ class NVMeParamStore(HostParamStore):
             arrays = {}
             n = self._block_size(name)
             for kind in ("master", "m", "v"):
-                buf = np.empty(n, np.float32)
+                buf = aligned_empty((n, ), np.float32)
                 self._read_h.async_pread(buf, self._file(name, kind))
                 self._read_h.wait()
                 off = 0
@@ -796,7 +797,7 @@ class ParamStreamRunner:
             return b["master"]
         # nvme tier: masters live on disk; reassemble from the flat file
         n = self.store._block_size(name)
-        buf = np.empty(n, np.float32)
+        buf = aligned_empty((n, ), np.float32)
         self.store._read_h.async_pread(buf, self.store._file(name, "master"))
         self.store._read_h.wait()
         out, off = {}, 0

@@ -181,3 +181,28 @@ def test_nvme_requires_path():
     with pytest.raises(ValueError, match="nvme_path"):
         make_engine(base_config(zero_optimization={
             "stage": 2, "offload_optimizer": {"device": "nvme"}}))
+
+
+def test_aligned_empty_and_odirect_roundtrip(tmp_path):
+    """aligned_empty gives 4096-aligned buffers (the O_DIRECT fast-path
+    contract); a write/read roundtrip through the pool preserves bytes for
+    aligned AND unaligned (tail-buffered) request sizes."""
+    from deepspeed_tpu.ops.aio import AsyncIOHandle, aligned_empty
+
+    buf = aligned_empty((1 << 20, ), np.uint8)
+    assert buf.ctypes.data % 4096 == 0
+    f32 = aligned_empty((333, ), np.float32)
+    assert f32.ctypes.data % 4096 == 0 and f32.dtype == np.float32
+
+    h = AsyncIOHandle(thread_count=2)
+    rng = np.random.default_rng(0)
+    for n in (1 << 20, (1 << 20) + 1234):  # aligned bulk + buffered tail
+        src = aligned_empty((n, ), np.uint8)
+        src[:] = rng.integers(0, 255, n, dtype=np.uint8)
+        path = str(tmp_path / f"blob{n}.bin")
+        h.async_pwrite(src, path)
+        assert h.wait() == 0
+        dst = aligned_empty((n, ), np.uint8)
+        h.async_pread(dst, path)
+        assert h.wait() == 0
+        np.testing.assert_array_equal(dst, src)
