@@ -1,0 +1,118 @@
+"""Decompose gpt2-large decode-step cost on the real chip: int8 matmul
+stack vs decode attention vs logits head. Run one component:
+  python benchmarks/decode_decompose.py {matmuls|attn|logits|bf16mm}
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+from deepspeed_tpu.ops.pallas.quant_matmul import quant_matmul
+from deepspeed_tpu.ops.pallas.decode_attention import decode_attention
+
+L, B, H, F = 36, 8, 1280, 5120
+nh = nkv = 20
+hd = 64
+S = 512
+R = 16
+r = np.random.default_rng(0)
+
+
+def q8(k, n):
+    return (jnp.asarray(r.integers(-127, 127, (L, k, n)), jnp.int8),
+            jnp.asarray(r.standard_normal((L, k // 128, n)).astype(np.float32) * 0.01))
+
+
+def timeit(f, *args):
+    print("  tracing/compiling...", flush=True)
+    g = jax.jit(f)
+    t0 = time.perf_counter()
+    y = g(*args)
+    print(f"  dispatched: {time.perf_counter()-t0:.1f}s", flush=True)
+    float(jnp.sum(y))
+    print(f"  compile+first: {time.perf_counter()-t0:.1f}s", flush=True)
+
+    def t(n):
+        best = 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                y = g(*args)
+            float(jnp.sum(y))
+            best = min(best, time.perf_counter() - t0)
+        return best
+    return (t(9) - t(1)) / (8 * R)
+
+
+def main():
+    which = sys.argv[1]
+    if which in ("matmuls", "bf16mm"):
+        x0 = jnp.asarray(r.standard_normal((B, H)), jnp.bfloat16)
+        if which == "matmuls":
+            qkv_w, o_w, up_w, down_w = q8(H, 3 * H), q8(H, H), q8(H, F), q8(F, H)
+
+            def step(x):
+                def rep(i, x):
+                    def layer(x, w):
+                        (qkvq, qkvs), (oq, os_), (upq, ups), (dnq, dns) = w
+                        y = quant_matmul(x, qkvq, qkvs)
+                        y = quant_matmul(y[:, :H], oq, os_)
+                        h = quant_matmul(y, upq, ups)
+                        return quant_matmul(jax.nn.gelu(h), dnq, dns).astype(x.dtype), None
+                    x, _ = jax.lax.scan(layer, x, (qkv_w, o_w, up_w, down_w))
+                    return x
+                return jax.lax.fori_loop(0, R, rep, x)
+            mb = L * (H * 3 * H + H * H + 2 * H * F) / 1e6
+        else:
+            ws = (jnp.asarray(r.standard_normal((L, H, 3 * H)), jnp.bfloat16),
+                  jnp.asarray(r.standard_normal((L, H, H)), jnp.bfloat16),
+                  jnp.asarray(r.standard_normal((L, H, F)), jnp.bfloat16),
+                  jnp.asarray(r.standard_normal((L, F, H)), jnp.bfloat16))
+
+            def step(x):
+                def rep(i, x):
+                    def layer(x, w):
+                        qkv, o, up, dn = w
+                        y = jnp.matmul(x, qkv)
+                        y = jnp.matmul(y[:, :H], o)
+                        h = jnp.matmul(y, up)
+                        return jnp.matmul(jax.nn.gelu(h), dn).astype(x.dtype), None
+                    x, _ = jax.lax.scan(layer, x, ws)
+                    return x
+                return jax.lax.fori_loop(0, R, rep, x)
+            mb = 2 * L * (H * 3 * H + H * H + 2 * H * F) / 1e6
+        dt = timeit(step, x0)
+        print(f"{which}/step: {dt*1e3:.2f} ms ({mb:.0f} MB -> {mb/1e3/dt:.0f} GB/s)", flush=True)
+    elif which == "attn":
+        kc = jnp.asarray(r.standard_normal((L, B, nkv, S, hd)), jnp.bfloat16)
+        vc = jnp.asarray(r.standard_normal((L, B, nkv, S, hd)), jnp.bfloat16)
+        x0 = jnp.asarray(r.standard_normal((B, nh, hd)), jnp.float32)
+        starts = jnp.zeros((B, ), jnp.int32)
+
+        def step(acc):
+            def rep(i, acc):
+                def layer(acc, kv):
+                    k, v = kv
+                    o = decode_attention((1e-6 * acc).astype(jnp.bfloat16), k, v,
+                                         starts, 176, block_kv=256)
+                    return acc + o, None
+                acc, _ = jax.lax.scan(layer, acc, (kc, vc))
+                return acc * 0.5
+            return jax.lax.fori_loop(0, R, rep, acc)
+        dt = timeit(step, x0)
+        mb = 2 * L * B * nkv * S * hd * 2 / 1e6
+        print(f"attn/step(S=512,end=176): {dt*1e3:.2f} ms (full cache {mb:.0f} MB)", flush=True)
+    elif which == "logits":
+        lw = (jnp.asarray(r.integers(-127, 127, (H, 51200)), jnp.int8),
+              jnp.asarray(r.standard_normal((10, 51200)).astype(np.float32) * 0.01))
+        x0 = jnp.asarray(r.standard_normal((B, H)), jnp.bfloat16)
+
+        def step(x):
+            def rep(i, x):
+                y = quant_matmul(x, *lw)
+                return (x + 1e-9 * y[:, :H]).astype(x.dtype)
+            return jax.lax.fori_loop(0, R, rep, x)
+        dt = timeit(step, x0)
+        print(f"logits/step: {dt*1e3:.2f} ms (65 MB -> {65/1e3/dt:.0f} GB/s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -82,6 +82,13 @@ class InferenceEngine:
         overrides = {"dtype": compute_dtype, "decode_block_kv": cfg.decode_block_kv}
         if self._int8_weights and hasattr(model.cfg, "int8_weights"):
             overrides["int8_weights"] = True
+            if hasattr(model.cfg, "int8_fused_qkv"):
+                # fused [q;k;v] matmul: fewer/larger pallas calls per decode
+                # step; tp>1 FORCES split projections (the fused N axis
+                # concatenates [q;k;v], so a plain column shard would split
+                # across component boundaries and quantize_params' qkv_q
+                # matches no tp_rules pattern)
+                overrides["int8_fused_qkv"] = cfg.tensor_parallel.tp_size == 1
         elif self._int8_weights:
             raise ValueError(f"dtype=int8 requires a model with int8 weight support "
                              f"(CausalLMModel family); got {type(model)}")

@@ -83,6 +83,11 @@ class TransformerConfig:
     # Serving-only: params must come from CausalLMModel.quantize_params.
     int8_weights: bool = False
     int8_group_size: int = 0  # 0 = one scale group per contraction dim
+    # fuse q/k/v into ONE int8 matmul (fewer, larger Pallas calls — the
+    # decode loop is per-call-overhead-sensitive). tp=1 serving only: the
+    # fused N axis concatenates [q;k;v] so a plain column shard would split
+    # across component boundaries. The engine enables it when tp==1.
+    int8_fused_qkv: bool = False
 
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash"):
@@ -415,41 +420,34 @@ def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype, alibi=None)
     return out.reshape(B, nh, T, hd)
 
 
-def _pick_block(n, cap, mult):
-    """Largest divisor of n that is <= cap and a multiple of ``mult`` (the
-    Mosaic tiling constraint: blocks must tile 8x128 unless they span the
-    whole dim). Falls back to the full dim when no such divisor exists."""
-    if n <= cap:
-        return n
-    d = cap - cap % mult
-    while d >= mult:
-        if n % d == 0:
-            return d
-        d -= mult
-    return n
-
+from ..ops.pallas.quant_matmul import pick_block as _pick_block
 
 import os as _os
 
-_QMM_IMPL = _os.environ.get("DSTPU_QMM_IMPL", "xla")
+_QMM_IMPL = _os.environ.get("DSTPU_QMM_IMPL", "pallas")
 
 
 def _qmm2d(x2d, qw, scales, out_dtype=None):
     """int8 matmul: ``x @ (dequant(qw))`` without a persistent bf16 weight.
 
-    Default path is XLA: the s8->bf16 convert + scale multiply fuse into the
-    dot's operand read, so HBM sees only int8 weight bytes (measured at the
-    decode shapes: the fusion streams ~2x faster than the Pallas tile loop,
-    whose small-M blocks leave the memory pipeline underfed; set
-    DSTPU_QMM_IMPL=pallas to compare)."""
+    Default path is the Pallas w8a16 kernel (one-pass s8->bf16 widen, group
+    scales applied to the (M, N) partials after the dot): measured 469 GB/s
+    of int8 bytes at the decode shapes vs 387 for the best XLA lowering
+    (whose dequant only half-fuses into the dot) and 169 for a naive
+    dequantize-then-dot tile loop — see ``benchmarks/qmm_microbench.py``.
+    Set DSTPU_QMM_IMPL=xla to compare.
+
+    Under tensor parallelism the XLA path is used instead: pallas_call is
+    opaque to the GSPMD partitioner, so tensor-sharded kernel_q operands
+    would be all-gathered per call rather than computed shard-local."""
     M, K = x2d.shape
     G, N = scales.shape
-    if _QMM_IMPL == "pallas":
+    tp_sharded = dist.has_mesh() and not dist.in_manual_region() \
+        and dist.get_mesh().shape[dist.TENSOR_AXIS] > 1
+    if _QMM_IMPL == "pallas" and not tp_sharded:
         from ..ops.pallas.quant_matmul import quant_matmul
         return quant_matmul(x2d, qw, scales,
                             block_m=_pick_block(M, 256, 8),
-                            block_n=_pick_block(N, 256, 128),
-                            block_k=_pick_block(K // G, 512, 128),
                             out_dtype=out_dtype or x2d.dtype)
     w = qw.astype(x2d.dtype)
     if G == 1:
@@ -548,9 +546,23 @@ class Attention(nn.Module):
         use_bias = cfg.attn_bias if cfg.attn_bias is not None else cfg.norm == "layernorm"
         # bhtd layout end-to-end: projections emit head-major
         i8, i8g = cfg.int8_weights, cfg.int8_group_size
-        q = HeadProjection(nh, hd, use_bias, cfg.dtype, i8, i8g, name="q_proj")(x)
-        k = HeadProjection(nkv, hd, use_bias, cfg.dtype, i8, i8g, name="k_proj")(x)
-        v = HeadProjection(nkv, hd, use_bias, cfg.dtype, i8, i8g, name="v_proj")(x)
+        if i8 and cfg.int8_fused_qkv:
+            # one [q;k;v] int8 matmul (reference fused qkv_gemm_int8,
+            # pt_binding.cpp): 3 small pallas calls -> 1 wide one
+            qw, sc = _q_param(self, "qkv", H, (nh + 2 * nkv) * hd, i8g)
+            y = _qmm2d(x.reshape(B * T, H).astype(cfg.dtype), qw, sc)
+            if use_bias:
+                qkv_b = self.param("qkv_bias", nn.initializers.zeros,
+                                   ((nh + 2 * nkv) * hd, ), jnp.float32)
+                y = y + qkv_b.astype(y.dtype)
+            q, k, v = jnp.split(y, [nh * hd, (nh + nkv) * hd], axis=-1)
+            q = q.reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+            k = k.reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
+            v = v.reshape(B, T, nkv, hd).transpose(0, 2, 1, 3)
+        else:
+            q = HeadProjection(nh, hd, use_bias, cfg.dtype, i8, i8g, name="q_proj")(x)
+            k = HeadProjection(nkv, hd, use_bias, cfg.dtype, i8, i8g, name="k_proj")(x)
+            v = HeadProjection(nkv, hd, use_bias, cfg.dtype, i8, i8g, name="v_proj")(x)
 
         if cfg.pos_embedding == "rope":
             if position_ids is not None:
@@ -863,8 +875,10 @@ class CausalLM(nn.Module):
         # logits matmul runs in compute dtype (MXU rate); CE upcasts to fp32
         if cfg.int8_weights:
             # one int8 vocab projection covers both tied and untied heads
-            # (vocab padded to a lane multiple; quantize_params builds it)
-            Vpad = -(-cfg.vocab_size // 128) * 128
+            # (vocab padded to a 2048 multiple so the quant-matmul kernel
+            # gets wide n-blocks — 50304's largest divisor under the block
+            # cap is a DMA-starving 384; quantize_params builds the padding)
+            Vpad = -(-cfg.vocab_size // 2048) * 2048
             qw = self.param("logits_q", nn.initializers.zeros,
                             (cfg.hidden_size, Vpad), jnp.int8)
             sc = self.param("logits_scale", nn.initializers.ones,
@@ -966,12 +980,28 @@ class CausalLMModel:
                 else:
                     out[k] = to_dtype(v)
             # rewrite projection kernels in place
-            for name in ("q_proj", "k_proj", "v_proj"):
-                node = out.get("attn", {}).get(name) if "attn" in out else out.get(name)
-                if node is not None and "kernel" in node:
+            attn_scope = out.get("attn") if "attn" in out else out
+            if cfg.int8_fused_qkv and all(
+                    "kernel" in attn_scope.get(n, {}) for n in ("q_proj", "k_proj", "v_proj")):
+                ws, biases = [], []
+                for name in ("q_proj", "k_proj", "v_proj"):
+                    node = attn_scope.pop(name)
                     w = np.asarray(node.pop("kernel"), np.float32)
-                    w2 = w.reshape(w.shape[:-2] + (w.shape[-2] * w.shape[-1], ))  # (.., H, n*hd)
-                    node["kernel_q"], node["kernel_scale"] = quant(w2)
+                    ws.append(w.reshape(w.shape[:-2] + (w.shape[-2] * w.shape[-1], )))
+                    if "bias" in node:
+                        b = np.asarray(node.pop("bias"), np.float32)
+                        biases.append(b.reshape(b.shape[:-2] + (-1, )))
+                attn_scope["qkv_q"], attn_scope["qkv_scale"] = quant(
+                    np.concatenate(ws, axis=-1))
+                if biases:
+                    attn_scope["qkv_bias"] = np.concatenate(biases, axis=-1)
+            else:
+                for name in ("q_proj", "k_proj", "v_proj"):
+                    node = attn_scope.get(name)
+                    if node is not None and "kernel" in node:
+                        w = np.asarray(node.pop("kernel"), np.float32)
+                        w2 = w.reshape(w.shape[:-2] + (w.shape[-2] * w.shape[-1], ))  # (.., H, n*hd)
+                        node["kernel_q"], node["kernel_scale"] = quant(w2)
             node = out.get("attn", {}).get("o_proj") if "attn" in out else out.get("o_proj")
             if node is not None and "kernel" in node:
                 w = np.asarray(node.pop("kernel"), np.float32)
@@ -988,7 +1018,7 @@ class CausalLMModel:
 
         params = dict(params)
         out = {}
-        Vpad = -(-cfg.vocab_size // 128) * 128
+        Vpad = -(-cfg.vocab_size // 2048) * 2048  # wide n-blocks for the kernel
         H = cfg.hidden_size
         if cfg.tie_embeddings:
             table = np.asarray(params["embed"]["embedding"], np.float32)  # (V, H)

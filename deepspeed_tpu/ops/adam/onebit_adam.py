@@ -55,6 +55,13 @@ def _group_size(axis_name):
 
 
 def _init_onebit_state(params, n):
+    # NOTE: server_error leaves are sized chunk_len(size, n) with the DP
+    # group size n baked in, so a OneBit/ZeroOne checkpoint can only be
+    # restored at the SAME data-parallel size — unlike the repo's
+    # layout-free fused/offload states (a resize restore fails with a
+    # shape mismatch; resume such runs with load_optimizer_states=False
+    # for a fresh optimizer). The reference has the same restriction
+    # (onebit/adam.py keeps per-worker server chunks).
     zeros = jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, jnp.float32), params)
     server = jax.tree_util.tree_map(
         lambda p: jnp.zeros((chunk_len(_size(p), n), ), jnp.float32), params)

@@ -1,15 +1,27 @@
-"""Pallas weight-only-quantized matmul (w8a16 / w4-ready).
+"""Pallas weight-only-quantized matmul (w8a16).
 
 Serving counterpart of the reference's CUDA dequant+GEMM inference kernels
-(``csrc/transformer/inference/csrc/gelu.cu`` fused bias/dequant paths and the
-``ds_quantizer`` ops): activations stay bf16, weights stream from HBM as
-int8 and are dequantized block-by-block in VMEM right before the MXU — the
-bf16 weight matrix never exists in HBM, halving weight bandwidth (the
-decode-time bottleneck).
+(``csrc/transformer/inference/csrc/pt_binding.cpp`` int8 ``qkv_gemm``/
+``mlp_gemm`` variants and the ``ds_quantizer`` ops): activations stay bf16,
+weights stream from HBM as int8 and hit the MXU straight after an
+int8->bf16 widen — the bf16 weight matrix never exists in HBM, halving
+weight bandwidth (the decode-time bottleneck).
+
+Kernel design (microbenched on v5e, ``benchmarks/qmm_microbench.py``):
+- The int8 block is converted bf16 in ONE VPU pass (no fp32 round-trip)
+  and fed to the MXU; the per-group quantization scale is applied to the
+  tiny ``(block_m, block_n)`` fp32 partial sum AFTER the dot — K*N scale
+  multiplies become M*N (M is the batch, ~8 at decode). This measured
+  ~2.8x the naive dequantize-then-dot tile loop (469 vs 169 GB/s of int8
+  bytes at decode shapes; bf16 streaming roof ~690 GB/s).
+- Scales load once per n-tile as a ``(G, block_n)`` block reused across
+  the k grid, not replicated per k-step.
+- ``block_k`` = one quantization group so each k-block sees exactly one
+  scale row; ``block_n`` as large as divides N (fewer grid steps keep the
+  DMA pipeline fed — block_n 2560 beat 512 by 1.7x).
 
 Layout: x (M, K) bf16; qw (K, N) int8; scales (G, N) fp32 with group size
-K/G along the contraction dim. Requires block_k <= group size and
-group_size % block_k == 0 so each k-block sees one scale row.
+K/G along the contraction dim.
 """
 
 import functools
@@ -24,27 +36,52 @@ def _interpret():
     return jax.default_backend() == "cpu"
 
 
-def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk):
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, acc_ref, *, nk, bk, gsize, ng):
     k = pl.program_id(2)
 
     @pl.when(k == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    # dequantize the int8 block in VMEM: one fp32 scale row per k-block (the
-    # scale rows arrive 8x-replicated to satisfy Mosaic's sublane tiling;
-    # row 0 of the block is the group's scale)
-    w = w_ref[...].astype(jnp.float32) * s_ref[0:1, :]
-    acc_ref[...] += jax.lax.dot_general(x_ref[...], w.astype(x_ref.dtype),
-                                        (((1, ), (0, )), ((), ())),
-                                        preferred_element_type=jnp.float32)
+    # one-pass widen to the activation dtype; MXU does the heavy lifting.
+    # A k-block spans ng quantization groups (big DMA blocks at group-level
+    # quality): one dot per group, scale applied to the (bm, bn) partial.
+    x = x_ref[...]
+    w = w_ref[...]
+    acc = jnp.zeros_like(acc_ref)
+    span = min(gsize, bk)
+    for t in range(ng):
+        part = jax.lax.dot_general(x[:, t * span:(t + 1) * span],
+                                   w[t * span:(t + 1) * span, :].astype(x.dtype),
+                                   (((1, ), (0, )), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        acc += part * s_ref[(k * bk) // gsize + t, :][None, :]
+    acc_ref[...] += acc
 
     @pl.when(k == nk - 1)
     def _flush():
         o_ref[...] = acc_ref[...].astype(o_ref.dtype)
 
 
-def quant_matmul(x, qw, scales, block_m=256, block_n=256, block_k=512, out_dtype=None):
+def pick_block(n, cap, mult=128):
+    """Largest multiple of ``mult`` <= cap dividing n, else n itself (Mosaic
+    tiling: blocks must tile ``mult``x128 unless they span the whole dim).
+    Shared by this kernel's defaults and the model-side callers."""
+    if n <= cap:
+        return n
+    d = cap - cap % mult
+    while d >= mult:
+        if n % d == 0:
+            return d
+        d -= mult
+    return n
+
+
+def _pick_bn(n, cap=4096):
+    return pick_block(n, cap, 128)
+
+
+def quant_matmul(x, qw, scales, block_m=256, block_n=None, block_k=None, out_dtype=None):
     """``x @ dequantize(qw, scales)`` without materializing the bf16 weight.
 
     x: (M, K); qw: (K, N) int8; scales: (G, N) fp32, G | K. Returns (M, N)
@@ -64,30 +101,49 @@ def quant_matmul(x, qw, scales, block_m=256, block_n=256, block_k=512, out_dtype
     if K % G != 0:
         raise ValueError(f"groups {G} must divide K={K}")
     gsize = K // G
-    block_m = min(block_m, M)
-    block_n = min(block_n, N)
-    block_k = min(block_k, gsize)
-    if gsize % block_k != 0:
-        raise ValueError(f"group size {gsize} must be a multiple of block_k {block_k}")
-    if M % block_m or N % block_n or K % block_k:
-        raise ValueError(f"shape ({M},{K})x({K},{N}) not divisible by blocks "
-                         f"({block_m},{block_k},{block_n})")
+    bm = min(block_m, M)
+    if block_k is None:
+        if gsize <= 1024:
+            # largest multiple of the group size dividing K under ~1MB blocks
+            bk = gsize
+            for cand in range(min(K, 1024) // gsize * gsize, gsize - 1, -gsize):
+                if K % cand == 0:
+                    bk = cand
+                    break
+        else:
+            # huge groups (e.g. G==1): sub-group k-blocks — any divisor of
+            # gsize works since consecutive blocks just reuse one scale row
+            bk = gsize
+            for cand in range(1024 - 1024 % 128, 127, -128):
+                if gsize % cand == 0:
+                    bk = cand
+                    break
+    else:
+        bk = min(block_k, K)
+    if bk % gsize and gsize % bk:
+        raise ValueError(f"block_k {bk} must divide or be a multiple of group size {gsize}")
+    if K % bk:
+        raise ValueError(f"block_k {bk} must divide K={K}")
+    ng = max(1, bk // gsize)
+    bn = block_n or _pick_bn(N)
+    if M % bm or N % bn:
+        raise ValueError(f"shape ({M},{K})x({K},{N}) not divisible by blocks ({bm},{bk},{bn})")
     out_dtype = out_dtype or x.dtype
-    nk = K // block_k
-    # 8x-replicate scale rows: Mosaic block shapes need >=8 sublanes, and a
-    # (G, N) array cannot hand out (1, block_n) blocks
-    scales8 = jnp.repeat(scales, 8, axis=0)
+    nk = K // bk
+    Gpad = -(-G // 8) * 8
+    if Gpad != G:  # Mosaic block sublanes must be a multiple of 8
+        scales = jnp.pad(scales, ((0, Gpad - G), (0, 0)))
 
     return pl.pallas_call(
-        functools.partial(_qmm_kernel, nk=nk),
-        grid=(M // block_m, N // block_n, nk),
+        functools.partial(_qmm_kernel, nk=nk, bk=bk, gsize=gsize, ng=ng),
+        grid=(M // bm, N // bn, nk),
         in_specs=[
-            pl.BlockSpec((block_m, block_k), lambda i, j, k: (i, k)),
-            pl.BlockSpec((block_k, block_n), lambda i, j, k: (k, j)),
-            pl.BlockSpec((8, block_n), lambda i, j, k: (k * block_k // gsize, j)),
+            pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((bk, bn), lambda i, j, k: (k, j)),
+            pl.BlockSpec((Gpad, bn), lambda i, j, k: (0, j)),  # revisited, one DMA per j
         ],
-        out_specs=pl.BlockSpec((block_m, block_n), lambda i, j, k: (i, j)),
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, k: (i, j)),
         out_shape=jax.ShapeDtypeStruct((M, N), out_dtype),
-        scratch_shapes=[pltpu.VMEM((block_m, block_n), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         interpret=_interpret(),
-    )(x, qw, scales8)
+    )(x, qw, scales)

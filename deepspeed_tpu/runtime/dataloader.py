@@ -58,8 +58,11 @@ class DeepSpeedDataLoader:
             # batch-index samplers own membership AND epoch count; length
             # derives from the sampler, not the dataset (a DeepSpeedDataSampler
             # spans num_epochs worth of micro-batches)
-            mb = getattr(data_sampler, "micro_batch_size", batch_size)
-            self.len = int(data_sampler.total_samples) // max(1, int(mb))
+            # per-RANK batches: the sampler hands each rank one micro-batch
+            # per micro_batch_size*data_parallel_size consumed samples
+            mbdp = getattr(data_sampler, "micro_batch_times_data_parallel_size",
+                           getattr(data_sampler, "micro_batch_size", batch_size))
+            self.len = int(data_sampler.total_samples) // max(1, int(mbdp))
         elif hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
             n = len(dataset) // num_shards
             self.len = n // batch_size if drop_last else (n + batch_size - 1) // batch_size

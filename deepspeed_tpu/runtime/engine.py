@@ -1417,17 +1417,23 @@ class DeepSpeedEngine:
             "ds_config": self._config.raw_config,
         })
         if self.param_stream is not None:
-            # param offload: every block (master + moments) is host-resident;
-            # the runner writes them per block, plus the latest tag
+            # param offload: every block (master + moments) is host-resident
+            # and replicated across processes, so only rank 0 writes the
+            # store/client files into a shared checkpoint dir (a per-rank
+            # write would race on the same npz/meta/json paths)
             tag_dir = os.path.join(save_dir, str(tag))
-            self.param_stream.save_checkpoint(tag_dir)
-            if save_latest and jax.process_index() == 0:
-                with open(os.path.join(save_dir, "latest"), "w") as f:
-                    f.write(str(tag))
-            with open(os.path.join(tag_dir, "client_state.json"), "w") as f:
-                import json as _json
-                _json.dump({k: v for k, v in client_sd.items()
-                            if isinstance(v, (int, float, str, bool, dict, list, type(None)))}, f)
+            if jax.process_index() == 0:
+                self.param_stream.save_checkpoint(tag_dir)
+                with open(os.path.join(tag_dir, "client_state.json"), "w") as f:
+                    import json as _json
+                    _json.dump({k: v for k, v in client_sd.items()
+                                if isinstance(v, (int, float, str, bool, dict, list, type(None)))}, f)
+                if save_latest:
+                    with open(os.path.join(save_dir, "latest"), "w") as f:
+                        f.write(str(tag))
+            # non-zero ranks must not report success (or start a dependent
+            # load/eviction) while rank 0 is still writing
+            dist.barrier()
             log_dist(f"saved param-offload checkpoint {save_dir}/{tag}", [0])
             return True
         # grad_acc is in-flight facade scratch, not training state — always
@@ -1459,7 +1465,8 @@ class DeepSpeedEngine:
             if tag_used is None:
                 return None, None
             tag_dir = os.path.join(os.path.abspath(load_dir), str(tag_used))
-            if not self.param_stream.load_checkpoint(tag_dir):
+            load_opt = load_optimizer_states and not load_module_only
+            if not self.param_stream.load_checkpoint(tag_dir, load_optimizer_states=load_opt):
                 return None, None
             client_sd = {}
             cs = os.path.join(tag_dir, "client_state.json")
@@ -1467,6 +1474,9 @@ class DeepSpeedEngine:
                 import json as _json
                 with open(cs) as f:
                     client_sd = _json.load(f)
+            if load_module_only:
+                self.loaded_checkpoint_tag = tag_used
+                return load_dir, client_sd
             self.global_steps = client_sd.get("global_steps", self.param_stream.global_steps)
             self.param_stream.global_steps = self.global_steps
             self.global_samples = client_sd.get("global_samples", 0)

