@@ -93,6 +93,11 @@ def init_inference(model=None, config=None, params=None, **kwargs):
         config_dict = dict(config or {})
         config_dict.update(kwargs)
         ds_inference_config = DeepSpeedInferenceConfig(config_dict)
+    if getattr(model, "is_diffusion", False) or hasattr(model, "unet") or hasattr(model, "vae"):
+        # diffusers path (reference generic_injection,
+        # module_inject/replace_module.py:184): UNet/VAE serving engines
+        from .inference.diffusion import build_diffusion_engine
+        return build_diffusion_engine(model, ds_inference_config, params)
     return InferenceEngine(model, config=ds_inference_config, params=params)
 
 

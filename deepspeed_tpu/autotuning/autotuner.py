@@ -64,10 +64,18 @@ class CostModel:
 class Autotuner:
 
     def __init__(self, model_factory, base_config, tuning_config=None, steps_per_trial=5,
-                 warmup_steps=2, make_batch=None):
+                 warmup_steps=2, make_batch=None, model_name=None, model_overrides=None,
+                 seq_len=128):
         """``model_factory``: () -> model (fresh per trial — engines mutate
         model config for remat); ``base_config``: engine config dict the
-        candidates overlay; ``make_batch``: (global_batch_size) -> batch dict."""
+        candidates overlay; ``make_batch``: (global_batch_size) -> batch dict.
+
+        Launcher mode (``autotuning.launcher = "subprocess"``; reference
+        behavior — trials as launched jobs through
+        ``autotuning/scheduler.ResourceManager``): requires ``model_name``
+        (a zoo preset; the model must be reconstructable in the child
+        process). ``autotuning.slots`` configures the resources (see
+        scheduler.py) and ``autotuning.exps_dir`` the experiment folder."""
         self.model_factory = model_factory
         self.base_config = dict(base_config)
         tc = dict(tuning_config if tuning_config is not None
@@ -82,6 +90,21 @@ class Autotuner:
         self.steps_per_trial = steps_per_trial
         self.warmup_steps = warmup_steps
         self.make_batch = make_batch
+        self.launcher = tc.get("launcher", "inproc")
+        self.model_name = model_name or tc.get("model")
+        self.model_overrides = dict(model_overrides or tc.get("model_overrides") or {})
+        self.seq_len = int(tc.get("seq_len", seq_len))
+        self._rm = None
+        if self.launcher == "subprocess":
+            if not self.model_name:
+                raise ValueError("autotuning.launcher='subprocess' needs a zoo preset "
+                                 "name (model_name / autotuning.model) so trials can "
+                                 "rebuild the model in their own process")
+            from .scheduler import ResourceManager
+            self._rm = ResourceManager(slots=tc.get("slots"),
+                                       exps_dir=tc.get("exps_dir"),
+                                       trial_timeout=int(tc.get("trial_timeout", 600)))
+        self._exp_counter = 0
         self.results = []
 
     def candidates(self):
@@ -123,12 +146,30 @@ class Autotuner:
         dt = time.perf_counter() - t0
         return engine.train_batch_size() * self.steps_per_trial / dt
 
+    def _exp_for(self, cand):
+        micro_bs, stage, remat = cand
+        self._exp_counter += 1
+        return {"exp_id": f"exp{self._exp_counter:03d}_mbs{micro_bs}_z{stage}_r{remat}",
+                "config": self._trial_config(micro_bs, stage, remat),
+                "model": self.model_name, "model_overrides": self.model_overrides,
+                "seq_len": self.seq_len, "steps": self.steps_per_trial,
+                "warmup": self.warmup_steps}
+
+    def _run_trial_subprocess(self, cand):
+        res = self._rm.schedule_experiments([self._exp_for(cand)])[0]
+        if res.get("samples_per_sec") is None:
+            raise RuntimeError(res.get("error") or "trial produced no result")
+        return res["samples_per_sec"]
+
     def _measure(self, cand, best):
         micro_bs, stage, remat = cand
         cfg = self._trial_config(micro_bs, stage, remat)
         label = f"micro_bs={micro_bs} zero={stage} remat={remat}"
         try:
-            samples_per_sec = self._run_trial(cfg)
+            if self.launcher == "subprocess":
+                samples_per_sec = self._run_trial_subprocess(cand)
+            else:
+                samples_per_sec = self._run_trial(cfg)
         except Exception as e:  # RESOURCE_EXHAUSTED, bad combos, ...
             logger.warning(f"autotuner: trial {label} failed: {type(e).__name__}: {e}")
             self.results.append({"config": label, "samples_per_sec": None})
@@ -148,12 +189,35 @@ class Autotuner:
         the grid."""
         if self.tuner_type == "model_based":
             return self._tune_model_based()
+        if self.launcher == "subprocess" and self._rm is not None and len(self._rm.slots) > 1:
+            return self._tune_subprocess_batch()
         best = None
         for cand in self.candidates():
             best, _ = self._measure(cand, best)
         if best is None:
             raise RuntimeError("autotuner: every trial failed")
         log_dist(f"autotuner: best = {json.dumps(self.results, default=str)}", [0])
+        return best
+
+    def _tune_subprocess_batch(self):
+        """Grid/random with multiple resource slots: every experiment goes
+        to the ResourceManager at once and runs slots-wide in parallel (the
+        reference's scheduler parcels nodes per experiment the same way)."""
+        cands = self.candidates()
+        exps = [self._exp_for(c) for c in cands]
+        results = self._rm.schedule_experiments(exps)
+        best = None
+        for cand, res in zip(cands, results):
+            micro_bs, stage, remat = cand
+            label = f"micro_bs={micro_bs} zero={stage} remat={remat}"
+            sps = res.get("samples_per_sec")
+            self.results.append({"config": label,
+                                 "samples_per_sec": None if sps is None else round(sps, 2)})
+            if sps is not None and (best is None or sps > best[1]):
+                best = (self._trial_config(micro_bs, stage, remat), sps)
+        if best is None:
+            raise RuntimeError("autotuner: every trial failed")
+        log_dist(f"autotuner(subprocess): best = {json.dumps(self.results, default=str)}", [0])
         return best
 
     def _tune_model_based(self):

@@ -1,0 +1,57 @@
+"""Autotuning trial runner — one experiment as its own process.
+
+Launched by ``autotuning/scheduler.ResourceManager`` (the reference runs
+each trial as a full launcher job, ``autotuning/autotuner.py`` ->
+``launcher/runner.py:348 run_autotuning``): builds an engine from the trial
+config, times a few steps, writes one JSON result file. Running out of
+memory or failing to compile kills only THIS process — the scheduler
+records the failure and moves on (the reference's 'untunable' marking).
+
+Usage: python -m deepspeed_tpu.autotuning.trial --exp <exp.json>
+where exp.json = {"config": {...engine config...}, "model": <preset name>,
+"model_overrides": {...}, "seq_len": N, "steps": k, "warmup": w,
+"result_path": <out.json>}.
+"""
+
+import argparse
+import json
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--exp", required=True)
+    args = ap.parse_args()
+    with open(args.exp) as f:
+        exp = json.load(f)
+
+    result = {"samples_per_sec": None, "error": None}
+    try:
+        import numpy as np
+        import deepspeed_tpu
+        from deepspeed_tpu.models import get_model
+
+        model = get_model(exp["model"], **(exp.get("model_overrides") or {}))
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=exp["config"])
+        gbs = engine.train_batch_size()
+        T = int(exp.get("seq_len", 128))
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": rng.integers(0, model.cfg.vocab_size, (gbs, T)).astype(np.int32)}
+        for _ in range(int(exp.get("warmup", 2))):
+            engine.train_batch(batch=batch)
+        steps = int(exp.get("steps", 5))
+        t0 = time.perf_counter()
+        loss = 0.0
+        for _ in range(steps):
+            loss = engine.train_batch(batch=batch)
+        float(loss)  # fence
+        dt = time.perf_counter() - t0
+        result["samples_per_sec"] = gbs * steps / dt
+    except Exception as e:  # noqa: BLE001 — the whole point is isolation
+        result["error"] = f"{type(e).__name__}: {e}"
+    with open(exp["result_path"], "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -142,7 +142,20 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag=None):
     else:
         raise ValueError(f"unsupported zero stage {stage}")
 
-    # tied/shared params point at their storage twin (reference shared_params)
+    # tied/shared params point at their storage twin. The reference WRITER
+    # stores no explicit list — its reader derives pairs by comparing
+    # data_ptr() across the module state dict (zero_to_fp32.py:123-131);
+    # mirror that, keeping an explicit "shared_params" key as a fallback.
+    trained = set(out)
+    module_sd = ms0.get("module") or {}
+    for name, t in module_sd.items():
+        if name in trained or not hasattr(t, "data_ptr"):
+            continue
+        for partner, pt in module_sd.items():
+            if (partner != name and partner in out and hasattr(pt, "data_ptr")
+                    and pt.data_ptr() == t.data_ptr()):
+                out[name] = out[partner]
+                break
     for pair in ms0.get("shared_params", ()) or ():
         if pair[1] in out:
             out[pair[0]] = out[pair[1]]
@@ -166,6 +179,97 @@ def load_universal_checkpoint_params(checkpoint_dir, tag=None):
     if not out:
         raise FileNotFoundError(f"{zero_dir}: no <param>/fp32.pt entries")
     return out
+
+
+def load_megatron_3d_state_dict(checkpoint_dir, tag=None, version=0):
+    """Flat Megatron-named module state dict from a TP/PP-sharded reference
+    checkpoint (reference ``checkpoint/deepspeed_checkpoint.py:33`` +
+    ``reshape_3d_utils.py``): merges ``mp_rank_XX_model_states.pt`` TP
+    shards, or stitches pipeline-parallel per-layer files
+    ``layer_XX-model_YY-model_states.pt`` (PipelineModule.ckpt_layer_path)
+    across both the TP and PP axes.
+
+    Pipeline layer files are classified by CONTENT (embedding / transformer
+    layer / final norm) rather than index, since layer numbering depends on
+    the module list (dropout/lambda layers own no files). Returns names the
+    MegatronPolicy understands: ``word_embeddings.weight``,
+    ``position_embeddings.weight``, ``layers.{i}.*``,
+    ``final_layernorm.{weight,bias}``."""
+    from ..runtime.state_dict_factory import MegatronSDLoader
+    d = _resolve_tag_dir(checkpoint_dir, tag)
+    layer_files = glob.glob(os.path.join(d, "layer_*-model_*-model_states.pt"))
+    if not layer_files:
+        mp_files = _natural(glob.glob(os.path.join(d, "mp_rank_*_model_states.pt")))
+        if not mp_files:
+            raise FileNotFoundError(
+                f"{d}: neither layer_XX-model_YY-model_states.pt nor "
+                f"mp_rank_XX_model_states.pt files (not a Megatron-DeepSpeed checkpoint)")
+        return MegatronSDLoader(mp_files, version=version).load(mp_world_size=len(mp_files))
+
+    groups = {}
+    for f in layer_files:
+        m = re.match(r".*layer_(\d+)-model_(\d+)-model_states\.pt$", f)
+        if not m:
+            continue
+        groups.setdefault(int(m.group(1)), {})[int(m.group(2))] = f
+    merger = MegatronSDLoader([], version=version)
+
+    def load_file(path):
+        sd = _torch_load(path)
+        if "module" in sd:
+            sd = sd["module"]
+        return {k: _np(v) for k, v in sd.items() if hasattr(v, "shape")}
+
+    out = {}
+    transformer_idx = 0
+    for li in sorted(groups):
+        sds = [load_file(groups[li][tp]) for tp in sorted(groups[li])]
+        sd = sds[0] if len(sds) == 1 else merger.merge_state_dicts(sds)
+        if "word_embeddings.weight" in sd:
+            out["word_embeddings.weight"] = sd["word_embeddings.weight"]
+            if "position_embeddings.weight" in sd:
+                out["position_embeddings.weight"] = sd["position_embeddings.weight"]
+        elif any(("attention" in k) or ("mlp" in k) for k in sd):
+            for k, v in sd.items():
+                out[f"layers.{transformer_idx}.{k}"] = v
+            transformer_idx += 1
+        elif set(sd) <= {"weight", "bias"}:  # final norm layer
+            out["final_layernorm.weight"] = sd["weight"]
+            if "bias" in sd:
+                out["final_layernorm.bias"] = sd["bias"]
+        else:
+            logger.warning(f"layer_{li:02d}: unrecognized pipeline layer keys "
+                           f"{sorted(sd)[:4]} — skipped")
+    logger.info(f"megatron-3d import: tp={max(len(g) for g in groups.values())}, "
+                f"{transformer_idx} transformer layers, {len(out)} tensors")
+    return out
+
+
+def megatron_3d_checkpoint_to_params(checkpoint_dir, model_config, tag=None, version=0):
+    """(params pytree) for a zoo model from a TP/PP-sharded Megatron-DeepSpeed
+    checkpoint dir — the import-side half of reference 3D interop."""
+    from ..module_inject.policy import MegatronPolicy
+    sd = load_megatron_3d_state_dict(checkpoint_dir, tag=tag, version=version)
+    return MegatronPolicy(version=version).convert(sd.__getitem__, model_config)
+
+
+def export_reference_fp32(params, hf_config, out_path, **overrides):
+    """Consolidated-fp32 EXPORT (the reference's ``zero_to_fp32.py`` output,
+    ``engine.py:3136``): write this framework's param pytree as a
+    ``pytorch_model.bin``-style torch state dict in the source module's
+    names, consumable by torch/HF/the reference. The inverse of
+    ``InjectionPolicy.convert`` (policies that support it implement
+    ``deconvert``)."""
+    import torch
+    from ..module_inject.policy import get_policy
+    policy = get_policy(hf_config)
+    cfg = policy.build_config(hf_config, **overrides)
+    sd = policy.deconvert(params, cfg)
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)) or ".", exist_ok=True)
+    torch.save({k: torch.from_numpy(np.ascontiguousarray(v, dtype=np.float32))
+                for k, v in sd.items()}, out_path)
+    logger.info(f"export_reference_fp32: {len(sd)} tensors -> {out_path}")
+    return out_path
 
 
 def reference_checkpoint_to_params(checkpoint_dir, hf_config, tag=None, dtype=None,

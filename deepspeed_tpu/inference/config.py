@@ -66,6 +66,9 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     # TPU additions
     decode_block_kv = ConfigField(default=256, help="KV block streamed per decode-kernel step")
     mp_size = ConfigField(default=None, help="deprecated alias for tensor_parallel.tp_size")
+    fused_decode_block = ConfigField(
+        default=True, help="use the fused per-layer decode kernel (one pallas call per "
+        "layer: qkv->attention->o->mlp) when the int8 serving config allows it")
 
     def __init__(self, param_dict=None):
         super().__init__(param_dict)

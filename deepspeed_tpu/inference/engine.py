@@ -264,6 +264,103 @@ class InferenceEngine:
     __call__ = forward
 
     # ------------------------------------------------------------------ generate
+    def _fused_decode_eligible(self):
+        """True when the decode loop can use the fused per-layer kernel
+        (``ops/pallas/decode_block.py`` — the reference's fused
+        qkv_gemm/softmax_context/mlp_gemm pass, pt_binding.cpp:1745):
+        int8 fused-qkv serving, layernorm + sequential residual + ungated
+        MLP, no rope/alibi, MHA (nh == kv), unrolled layers, tp=1."""
+        mc = self.model_config
+        return (getattr(mc, "int8_weights", False)
+                and getattr(mc, "int8_fused_qkv", False)
+                and getattr(mc, "scan_layers", True) is False
+                and getattr(mc, "num_experts", 0) == 0
+                and not getattr(mc, "parallel_residual", False)
+                and getattr(mc, "norm", "") == "layernorm"
+                and not getattr(mc, "embed_norm", False)
+                and mc.pos_embedding in ("learned", "none")
+                and mc.activation in ("gelu", "gelu_exact", "quick_gelu", "relu")
+                and mc.kv_heads == mc.num_heads
+                and (mc.rotary_dim or 0) == 0
+                and getattr(mc, "attn_scale", None) is None
+                and not getattr(mc, "local_attention_layers", ())
+                and not getattr(mc, "act_quant_bits", 0)
+                and self.mesh.shape[dist.TENSOR_AXIS] == 1
+                and self._config.fused_decode_block)
+
+    def _fast_tree(self):
+        """Per-layer tuples for the fused decode kernel, derived once from
+        the quantized param tree. Built EAGERLY (no jit wrapper): the int8
+        kernels and embedding pass through by reference — a jit'd rebuild
+        would copy every weight into fresh buffers and double resident
+        model memory; only the small norm/bias/scale leaves convert."""
+        if getattr(self, "_fast_tree_cache", None) is not None:
+            return self._fast_tree_cache
+
+        def build(params):
+            mc = self.model_config
+            layers = []
+            for i in range(mc.num_layers):
+                lp = params[f"layer_{i}"]
+                at, mlp = lp["attn"], lp["mlp"]
+                f32 = lambda x: jnp.asarray(x, jnp.float32)
+                norms = jnp.stack([f32(lp["attn_norm"]["scale"]), f32(lp["attn_norm"]["bias"]),
+                                   f32(lp["mlp_norm"]["scale"]), f32(lp["mlp_norm"]["bias"])])
+                qkv = (at["qkv_q"], f32(at["qkv_scale"]), f32(at["qkv_bias"]))
+                o = (at["o_proj"]["kernel_q"], f32(at["o_proj"]["kernel_scale"]),
+                     f32(at["o_proj"]["bias"]))
+                up = (mlp["up_proj"]["kernel_q"], f32(mlp["up_proj"]["kernel_scale"]),
+                      f32(mlp["up_proj"]["bias"]))
+                down = (mlp["down_proj"]["kernel_q"], f32(mlp["down_proj"]["kernel_scale"]),
+                        f32(mlp["down_proj"]["bias"]))
+                layers.append((norms, qkv, o, up, down))
+            head = {
+                "final_scale": f32(params["final_norm"]["scale"]),
+                "final_bias": f32(params["final_norm"]["bias"]),
+                "embed": params["embed"]["embedding"],
+                "logits_q": params["logits_q"],
+                "logits_scale": f32(params["logits_scale"]),
+            }
+            if self.model_config.pos_embedding == "learned":
+                head["pos_embed"] = params["pos_embed"]
+            if "logits_bias" in params:
+                head["logits_bias"] = f32(params["logits_bias"])
+            return tuple(layers), head
+
+        with self.mesh:
+            self._fast_tree_cache = build(self.params)
+        return self._fast_tree_cache
+
+    def _fused_step(self, layers, head, caches, tok, pos_rows, pos, pads):
+        """One fused-token decode step: embeds -> L fused layer kernels (+
+        XLA cache commits) -> final norm -> int8 logits. Returns
+        (logits (B, V) f32, new caches)."""
+        from ..ops.pallas.decode_block import fused_decode_block
+        from ..ops.pallas.quant_matmul import quant_matmul
+        mc = self.model_config
+        x = jnp.take(head["embed"], tok, axis=0)  # (B, H) bf16
+        if mc.pos_embedding == "learned":
+            x = x + jnp.take(head["pos_embed"], pos_rows, axis=0).astype(x.dtype)
+        cks, cvs = caches
+        new_ck, new_cv = [], []
+        for i, (norms, qkv, o, up, down) in enumerate(layers):
+            x, ck, cv = fused_decode_block(
+                x, norms, cks[i], cvs[i], qkv, o, up, down, pads, pos,
+                activation=mc.activation, eps=mc.layernorm_epsilon,
+                block_kv=mc.decode_block_kv)
+            new_ck.append(ck)
+            new_cv.append(cv)
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+        xn = ((x32 - mu) * jax.lax.rsqrt(var + mc.layernorm_epsilon)
+              * head["final_scale"] + head["final_bias"]).astype(x.dtype)
+        logits = quant_matmul(xn, head["logits_q"], head["logits_scale"],
+                              block_m=8)[:, :mc.vocab_size].astype(jnp.float32)
+        if "logits_bias" in head:
+            logits = logits + head["logits_bias"]
+        return logits, (tuple(new_ck), tuple(new_cv))
+
     def _build_generate(self, B, P, S, W, max_gen, do_sample, temperature, top_k, top_p, eos, pad,
                         padded):
         """``W``: cache write head after prefill (static). Uniform-length
@@ -271,8 +368,10 @@ class InferenceEngine:
         cache masking, which enables the flash prefill kernel; ragged batches
         are left-padded with W = P and per-row mask/positions."""
         model = self.module
+        fused = self._fused_decode_eligible()
+        fused_step = self._fused_step
 
-        def generate(params, cache, ids, pads, max_new, rng):
+        def generate(params, fast, cache, ids, pads, max_new, rng):
             # ids: (B, P); pads: (B,) left-pad counts (zeros when uniform)
             cache_mask = (jnp.arange(S)[None, :] >= pads[:, None]) if padded else None
             pos_prefill = jnp.maximum(jnp.arange(P)[None, :] - pads[:, None], 0) if padded else None
@@ -290,12 +389,18 @@ class InferenceEngine:
 
             def body(c):
                 cache, buf, done, t, rng, tok = c
-                pos = (W + t - pads)[:, None]  # (B, 1) true positions
-                logits, cache = model.apply_with_cache(params, tok[:, None], cache, W + t,
-                                                       cache_mask, pos)
+                if fused:
+                    # one pallas call per LAYER (reference fused decode pass)
+                    layers, head = fast
+                    logits2d, cache = fused_step(layers, head, cache, tok,
+                                                 W + t - pads, W + t, pads)
+                else:
+                    pos = (W + t - pads)[:, None]  # (B, 1) true positions
+                    logits, cache = model.apply_with_cache(params, tok[:, None], cache, W + t,
+                                                           cache_mask, pos)
+                    logits2d = logits[:, 0].astype(jnp.float32)
                 rng, sub = jax.random.split(rng)
-                nxt = _sample_tokens(sub, logits[:, 0].astype(jnp.float32), do_sample, temperature,
-                                     top_k, top_p)
+                nxt = _sample_tokens(sub, logits2d, do_sample, temperature, top_k, top_p)
                 if eos is not None:
                     nxt = jnp.where(done, pad, nxt)
                     new_done = done | (nxt == eos)
@@ -313,7 +418,7 @@ class InferenceEngine:
             # generate() call — no per-call allocation or init
             return buf, n_tokens, cache
 
-        return jax.jit(generate, donate_argnums=(1, ))
+        return jax.jit(generate, donate_argnums=(2, ))
 
     def generate(self, input_ids, max_new_tokens=64, do_sample=False, temperature=1.0, top_k=0,
                  top_p=1.0, eos_token_id=None, pad_token_id=0, seed=0):
@@ -363,8 +468,9 @@ class InferenceEngine:
         cache = self._cache_pool.pop((B, S), None)
         if cache is None:
             cache = self._init_cache(B, S)
+        fast = self._fast_tree() if self._fused_decode_eligible() else ()
         with self.mesh:
-            buf, _, cache = self._compiled[key](self.params, cache, jnp.asarray(ids),
+            buf, _, cache = self._compiled[key](self.params, fast, cache, jnp.asarray(ids),
                                                 jnp.asarray(pads),
                                                 jnp.asarray(max_new_tokens, jnp.int32),
                                                 jax.random.key(seed))

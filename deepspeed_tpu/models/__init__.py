@@ -114,6 +114,16 @@ def tiny():
                              num_kv_heads=2, max_seq_len=128, intermediate_size=128)
 
 
+@register("tiny-gpt2")
+def tiny_gpt2():
+    """Test-scale gpt2-style model (learned positions, layernorm, gelu,
+    MHA) — the shape the fused int8 decode-block kernel serves."""
+    return TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                             max_seq_len=128, intermediate_size=128,
+                             pos_embedding="learned", norm="layernorm",
+                             activation="gelu", tie_embeddings=True)
+
+
 @register("tiny-moe")
 def tiny_moe():
     return TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,

@@ -19,7 +19,7 @@ path and the Pallas flash kernel (``ops.pallas.flash_attention``).
 
 import dataclasses
 from functools import partial
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,6 +54,14 @@ class TransformerConfig:
     # fake-quantize each block's input with a straight-through gradient
     act_quant_bits: Optional[int] = None
     act_quant_symmetric: bool = True
+    # attention-score scale: None = 1/sqrt(head_size); GPT-Neo uses 1.0
+    # (HF GPTNeoSelfAttention applies no scaling)
+    attn_scale: Optional[float] = None
+    # GPT-Neo alternating local attention: layers listed in
+    # local_attention_layers see a sliding window of local_attention_window
+    # keys (reference containers/gptneo.py; HF attention_types)
+    local_attention_window: int = 0
+    local_attention_layers: Tuple[int, ...] = ()
     layernorm_epsilon: float = 1e-5
     dropout: float = 0.0
     # MoE (0 experts = dense)
@@ -100,6 +108,9 @@ class TransformerConfig:
                              f"got {self.sequence_parallel_impl!r}")
         if self.sequence_parallel_impl == "ring" and self.attention_impl != "flash":
             raise ValueError("sequence_parallel_impl='ring' requires attention_impl='flash'")
+        if self.local_attention_layers and self.scan_layers:
+            raise ValueError("local_attention_layers (per-layer windows) requires "
+                             "scan_layers=False — scanned layers share one program")
         if self.attention_impl == "flash":
             import importlib.util
             if importlib.util.find_spec("deepspeed_tpu.ops.pallas.flash_attention") is None:
@@ -387,13 +398,15 @@ def _sdpa_xla(q, k, v, mask_bias, dtype, interior_spec=None):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
-def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype, alibi=None):
+def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype, alibi=None, window=0):
     """Grouped-query attention against a KV cache, no head expansion.
 
     q: (B, nh, T, hd); ck/cv: (B, nkv, S, hd); cache_mask: optional (B, S)
     bool marking valid cache slots (left-pad masking). Query position ``i`` of
     this call sits at absolute cache position ``cache_index + i``. ``alibi``:
     optional (nh,) slopes adding ``-slope * (qpos - kpos)`` to the scores.
+    ``window``: >0 restricts each query to the last ``window`` keys (GPT-Neo
+    local attention).
     """
     B, nh, T, hd = q.shape
     nkv, S = ck.shape[1], ck.shape[2]
@@ -402,7 +415,10 @@ def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype, alibi=None)
     scores = jnp.einsum("bkgtd,bksd->bkgts", qg, ck).astype(jnp.float32) / jnp.sqrt(hd)
     kpos = jnp.arange(S)[None, :]
     qpos = cache_index + jnp.arange(T)[:, None]
-    bias = jnp.where(kpos <= qpos, 0.0, -1e30)  # (T, S)
+    keep = kpos <= qpos
+    if window:
+        keep = keep & (qpos - kpos < window)
+    bias = jnp.where(keep, 0.0, -1e30)  # (T, S)
     if alibi is not None:
         rel = (qpos - kpos).astype(jnp.float32)  # (T, S)
         bias = bias[None, None] - alibi.reshape(nkv, g)[:, :, None, None] * rel  # (nkv, g, T, S)
@@ -532,6 +548,7 @@ class OutProjection(nn.Module):
 
 class Attention(nn.Module):
     cfg: TransformerConfig
+    layer_idx: int = -1  # set on unrolled layers; drives local-window lookup
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, kv_cache=None, cache_index=None,
@@ -581,6 +598,14 @@ class Attention(nn.Module):
             q = rope_part(q)
             k = rope_part(k)
         alibi = alibi_slopes(nh) if cfg.pos_embedding == "alibi" else None
+        if cfg.attn_scale is not None:
+            # every downstream path divides scores by sqrt(hd); pre-scaling q
+            # by attn_scale*sqrt(hd) nets the configured scale (GPT-Neo: 1.0)
+            q = q * jnp.asarray(cfg.attn_scale * (hd ** 0.5), q.dtype)
+        # sliding-window (local) attention for this layer (GPT-Neo pattern)
+        window = (cfg.local_attention_window
+                  if (cfg.local_attention_window and self.layer_idx >= 0
+                      and self.layer_idx in cfg.local_attention_layers) else 0)
 
         if kv_cache is not None:
             # cache layout (B, nkv, S, hd): contiguous (S, hd) slabs per head,
@@ -596,10 +621,14 @@ class Attention(nn.Module):
                     starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
                 else:
                     starts = jnp.zeros((B, ), jnp.int32)
+                if window:
+                    # a sliding window is just a raised start for one query
+                    starts = jnp.maximum(starts, cache_index + 1 - window)
                 out = decode_attention(q[:, :, 0], ck, cv, starts, cache_index + 1,
                                        block_kv=cfg.decode_block_kv)[:, :, None]
             elif (cfg.attention_impl == "flash" and attn_mask is None and T >= 128
-                  and isinstance(cache_index, int) and cache_index == 0 and alibi is None):
+                  and isinstance(cache_index, int) and cache_index == 0 and alibi is None
+                  and not window):
                 # unpadded prefill: nothing earlier in the cache, so attention
                 # over the current tokens only — the flash kernel path
                 # (GQA-native: no head expansion)
@@ -609,13 +638,13 @@ class Attention(nn.Module):
                                               block_kv=cfg.attention_block_kv)
             else:
                 out = _cached_attention_xla(q, ck, cv, cache_index, attn_mask, cfg.dtype,
-                                            alibi=alibi)
+                                            alibi=alibi, window=window)
             out = out.astype(cfg.dtype)
             new_cache = (ck, cv)
         else:
             new_cache = None
             use_flash = (cfg.attention_impl == "flash" and T >= 128 and attn_mask is None
-                         and alibi is None)
+                         and alibi is None and not window)
             ring_possible = (cfg.sequence_parallel_impl == "ring" and dist.has_mesh()
                              and not dist.in_manual_region()
                              and dist.get_mesh().shape[dist.SEQ_AXIS] > 1)
@@ -653,7 +682,11 @@ class Attention(nn.Module):
                                                   block_q=cfg.attention_block_q,
                                                   block_kv=cfg.attention_block_kv)
                 else:
-                    bias = jnp.where(jnp.tril(jnp.ones((T, S), dtype=bool)), 0.0, -1e30)[None, None]
+                    keep = jnp.tril(jnp.ones((T, S), dtype=bool))
+                    if window:
+                        rel = jnp.arange(T)[:, None] - jnp.arange(S)[None, :]
+                        keep = keep & (rel < window)
+                    bias = jnp.where(keep, 0.0, -1e30)[None, None]
                     if alibi is not None:
                         rel = (jnp.arange(T)[:, None] - jnp.arange(S)[None, :]).astype(jnp.float32)
                         bias = bias - alibi[None, :, None, None] * rel[None, None]
@@ -724,6 +757,7 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     cfg: TransformerConfig
+    layer_idx: int = -1
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, deterministic=True, kv_cache=None,
@@ -735,8 +769,8 @@ class Block(nn.Module):
             x = fake_quantize(x, bits=cfg.act_quant_bits, groups=1,
                               symmetric=cfg.act_quant_symmetric)
         h = make_norm(cfg, name="attn_norm")(x)
-        h, new_cache = Attention(cfg, name="attn")(h, sin, cos, attn_mask, kv_cache,
-                                                   cache_index, position_ids)
+        h, new_cache = Attention(cfg, layer_idx=self.layer_idx, name="attn")(
+            h, sin, cos, attn_mask, kv_cache, cache_index, position_ids)
         if drop is not None:
             h = drop(h, deterministic=deterministic)
         if cfg.parallel_residual:
@@ -855,7 +889,7 @@ class CausalLM(nn.Module):
                 # per-layer tuple cache (init_cache, unrolled form); stacked
                 # arrays also index correctly for backward compatibility
                 layer_cache = None if kv_cache is None else (kv_cache[0][i], kv_cache[1][i])
-                blk = block(cfg, name=f"layer_{i}")
+                blk = block(cfg, layer_idx=i, name=f"layer_{i}")
                 if ltd_active and i in ltd_layers:
                     y, c = ltd_apply(
                         lambda xs_, ms_, ps_, blk=blk, lc=layer_cache: blk(
@@ -951,8 +985,6 @@ class CausalLMModel:
         (reference ``replace_module`` int8 path / ``weight_quantizer``)."""
         import numpy as np
         cfg = self.cfg
-        if cfg.num_experts > 0:
-            raise NotImplementedError("int8 serving does not cover MoE experts yet")
         gs_cfg = group_size if group_size is not None else (cfg.int8_group_size or 128)
         dtype = np.dtype(jnp.dtype(dtype or cfg.dtype).name)
 
@@ -1011,9 +1043,20 @@ class CausalLMModel:
             if mlp is not None:
                 for name in ("gate_proj", "up_proj", "down_proj"):
                     node = mlp.get(name)
-                    if node is not None and "kernel" in node:
+                    # isinstance: batched expert kernels are raw (E, K, N)
+                    # leaves (handled below), not {kernel: ...} dicts
+                    if isinstance(node, dict) and "kernel" in node:
                         w = np.asarray(node.pop("kernel"), np.float32)
                         node["kernel_q"], node["kernel_scale"] = quant(w)
+            experts = out.get("moe", {}).get("experts")
+            if experts is not None:
+                # batched (E, K, N) expert kernels -> per-expert group quant
+                # (reference moe_inference int8 experts); the tiny gate stays
+                # in the compute dtype
+                for name in ("gate_proj", "up_proj", "down_proj"):
+                    if name in experts:
+                        w = np.asarray(experts.pop(name), np.float32)
+                        experts[name + "_q"], experts[name + "_scale"] = quant(w)
             return out
 
         params = dict(params)
@@ -1349,12 +1392,13 @@ class CausalLMModel:
                 ce = optax.softmax_cross_entropy_with_integer_labels(
                     logits.astype(jnp.float32), lab)
                 total = jnp.sum(ce * val)
-            # normalized by the GLOBAL valid count: summing microbatch
-            # contributions reproduces pipeline_loss's mean exactly
-            return total / denom
+            # RAW per-microbatch sum: the schedule owns normalization
+            # (loss_denom) so the contract can't be mis-specified
+            return total
 
         loss, d_layers, d_head, dxs = spmd_pipeline_1f1b(
-            stage_fn, loss_head, params["layers"], head_p, x_stream, mesh=mesh)
+            stage_fn, loss_head, params["layers"], head_p, x_stream, mesh=mesh,
+            loss_denom=denom)
         (d_embed, ) = embed_vjp(dxs.astype(x_stream.dtype))
 
         grads = {k: jax.tree_util.tree_map(jnp.zeros_like, v) for k, v in params.items()}
@@ -1414,10 +1458,18 @@ class CausalLMModel:
         return (rope_table(cfg.rotary_dim or cfg.head_size, cfg.max_seq_len, cfg.rope_theta)
                 if cfg.pos_embedding == "rope" else (None, None))
 
-    def stream_layer(self, layer_tree, h, attn_mask=None):
+    def stream_layer(self, layer_tree, h, attn_mask=None, return_aux=False):
         """One transformer block (deterministic): ``layer_tree`` is a single
-        layer's params (the stacked leaves sliced at one index)."""
+        layer's params (the stacked leaves sliced at one index).
+        ``return_aux``: also return the MoE load-balancing aux loss (sowed
+        intermediates) so the streamed trainer can include its gradient."""
         sin, cos = self._rope()
+        if return_aux:
+            (y, _), inter = Block(self.cfg).apply({"params": layer_tree}, h, sin, cos,
+                                                  attn_mask, mutable=["intermediates"])
+            aux = jax.tree_util.tree_leaves(inter)
+            aux = sum(jnp.sum(a) for a in aux) if aux else jnp.zeros((), jnp.float32)
+            return y, aux
         y, _ = Block(self.cfg).apply({"params": layer_tree}, h, sin, cos, attn_mask)
         return y
 

@@ -65,6 +65,20 @@ class InjectionPolicy:
         (layers stacked along axis 0 when ``cfg.scan_layers``)."""
         raise NotImplementedError
 
+    def deconvert(self, params, cfg):
+        """Inverse of :meth:`convert`: native pytree -> {torch_name: np
+        ndarray} in the source module's naming, for reference-consumable
+        fp32 export (``checkpoint.export_reference_fp32``)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement reference export (deconvert)")
+
+    def _layer_view(self, params, cfg, i):
+        """Layer ``i``'s param subtree from stacked or unrolled trees."""
+        if cfg.scan_layers:
+            import jax
+            return jax.tree_util.tree_map(lambda x: np.asarray(x)[i], params["layers"])
+        return params[f"layer_{i}"]
+
     # -- shared assembly helpers -----------------------------------------
     def _assemble(self, cfg, top, layer_fn):
         layers = [layer_fn(i) for i in range(cfg.num_layers)]
@@ -141,6 +155,28 @@ class LlamaPolicy(InjectionPolicy):
             "down_proj": {"kernel": _t(get(q + "mlp.down_proj.weight"))},
         }}
 
+    def deconvert(self, params, cfg):
+        p = self.prefix
+        nh, nkv, hd, H = cfg.num_heads, cfg.kv_heads, cfg.head_size, cfg.hidden_size
+        arr = lambda x: np.asarray(x, np.float32)
+        out = {p + "embed_tokens.weight": arr(params["embed"]["embedding"]),
+               p + "norm.weight": arr(params["final_norm"]["scale"])}
+        if not cfg.tie_embeddings and "lm_head" in params:
+            out["lm_head.weight"] = _t(arr(params["lm_head"]["kernel"]))
+        for i in range(cfg.num_layers):
+            lp = self._layer_view(params, cfg, i)
+            q = f"{p}layers.{i}."
+            at = lp["attn"]
+            out[q + "input_layernorm.weight"] = arr(lp["attn_norm"]["scale"])
+            out[q + "post_attention_layernorm.weight"] = arr(lp["mlp_norm"]["scale"])
+            out[q + "self_attn.q_proj.weight"] = _t(arr(at["q_proj"]["kernel"]).reshape(H, nh * hd))
+            out[q + "self_attn.k_proj.weight"] = _t(arr(at["k_proj"]["kernel"]).reshape(H, nkv * hd))
+            out[q + "self_attn.v_proj.weight"] = _t(arr(at["v_proj"]["kernel"]).reshape(H, nkv * hd))
+            out[q + "self_attn.o_proj.weight"] = _t(arr(at["o_proj"]["kernel"]).reshape(nh * hd, H))
+            for name in ("gate_proj", "up_proj", "down_proj"):
+                out[q + f"mlp.{name}.weight"] = _t(arr(lp["mlp"][name]["kernel"]))
+        return out
+
 
 class MixtralPolicy(LlamaPolicy):
     """Mixtral: Llama attention + top-k MoE MLP (``block_sparse_moe``)."""
@@ -215,6 +251,114 @@ class GPT2Policy(InjectionPolicy):
             "embed": {"embedding": get("transformer.wte.weight")},
             "pos_embed": get("transformer.wpe.weight"),
             "final_norm": {"scale": get("transformer.ln_f.weight"), "bias": get("transformer.ln_f.bias")},
+        }
+        return self._assemble(cfg, top, layer)
+
+    def deconvert(self, params, cfg):
+        nh, hd, H = cfg.num_heads, cfg.head_size, cfg.hidden_size
+        arr = lambda x: np.asarray(x, np.float32)
+        out = {
+            "transformer.wte.weight": arr(params["embed"]["embedding"]),
+            "transformer.wpe.weight": arr(params["pos_embed"]),
+            "transformer.ln_f.weight": arr(params["final_norm"]["scale"]),
+            "transformer.ln_f.bias": arr(params["final_norm"]["bias"]),
+        }
+        for i in range(cfg.num_layers):
+            lp = self._layer_view(params, cfg, i)
+            q = f"transformer.h.{i}."
+            at = lp["attn"]
+            # Conv1D keeps (in, out); c_attn fuses [q|k|v] on the out dim
+            out[q + "attn.c_attn.weight"] = np.concatenate(
+                [arr(at[n]["kernel"]).reshape(H, nh * hd) for n in ("q_proj", "k_proj", "v_proj")],
+                axis=1)
+            out[q + "attn.c_attn.bias"] = np.concatenate(
+                [arr(at[n]["bias"]).reshape(-1) for n in ("q_proj", "k_proj", "v_proj")])
+            out[q + "attn.c_proj.weight"] = arr(at["o_proj"]["kernel"]).reshape(nh * hd, H)
+            out[q + "attn.c_proj.bias"] = arr(at["o_proj"]["bias"])
+            out[q + "ln_1.weight"] = arr(lp["attn_norm"]["scale"])
+            out[q + "ln_1.bias"] = arr(lp["attn_norm"]["bias"])
+            out[q + "ln_2.weight"] = arr(lp["mlp_norm"]["scale"])
+            out[q + "ln_2.bias"] = arr(lp["mlp_norm"]["bias"])
+            out[q + "mlp.c_fc.weight"] = arr(lp["mlp"]["up_proj"]["kernel"])
+            out[q + "mlp.c_fc.bias"] = arr(lp["mlp"]["up_proj"]["bias"])
+            out[q + "mlp.c_proj.weight"] = arr(lp["mlp"]["down_proj"]["kernel"])
+            out[q + "mlp.c_proj.bias"] = arr(lp["mlp"]["down_proj"]["bias"])
+        return out
+
+
+class GPTNeoPolicy(InjectionPolicy):
+    """GPT-Neo (reference ``containers/gptneo.py``): GPT-2-family layout but
+    with separate unbiased q/k/v Linears, UNSCALED attention scores (HF
+    GPTNeoSelfAttention applies no 1/sqrt(d)), and alternating global/local
+    (sliding-window) attention layers per ``config.attention_types``."""
+
+    architectures = ("GPTNeoForCausalLM", )
+    model_types = ("gpt_neo", )
+
+    @staticmethod
+    def _local_layers(hf):
+        layers = list(getattr(hf, "attention_layers", ()) or ())
+        if not layers:
+            # HF expansion: each [kinds, n] entry repeats the PATTERN n
+            # times ([["global","local"], 12] -> 24 layer entries)
+            for kinds, n in getattr(hf, "attention_types", ()) or ():
+                for _ in range(int(n)):
+                    layers.extend(list(kinds))
+        return tuple(i for i, kind in enumerate(layers) if kind == "local")
+
+    def build_config(self, hf, **overrides):
+        kw = dict(
+            vocab_size=hf.vocab_size,
+            hidden_size=hf.hidden_size,
+            intermediate_size=getattr(hf, "intermediate_size", None) or 4 * hf.hidden_size,
+            num_layers=hf.num_layers,
+            num_heads=hf.num_heads,
+            max_seq_len=hf.max_position_embeddings,
+            pos_embedding="learned",
+            norm="layernorm",
+            activation="gelu",
+            tie_embeddings=True,
+            attn_scale=1.0,
+            local_attention_window=int(getattr(hf, "window_size", 256)),
+            local_attention_layers=self._local_layers(hf),
+            layernorm_epsilon=float(getattr(hf, "layer_norm_epsilon", 1e-5)),
+            scan_layers=False,  # per-layer windows need unrolled layers
+        )
+        kw.update(overrides)
+        return TransformerConfig(**kw)
+
+    def convert(self, get, cfg):
+        nh, hd = cfg.num_heads, cfg.head_size
+
+        def layer(i):
+            q = f"transformer.h.{i}."
+            zero_hb = np.zeros((nh, hd), np.float32)  # q/k/v Linears are unbiased
+            return {
+                "attn_norm": {"scale": get(q + "ln_1.weight"), "bias": get(q + "ln_1.bias")},
+                "mlp_norm": {"scale": get(q + "ln_2.weight"), "bias": get(q + "ln_2.bias")},
+                "attn": {
+                    "q_proj": {"kernel": _heads_in(_t(get(q + "attn.attention.q_proj.weight")), nh, hd),
+                               "bias": zero_hb},
+                    "k_proj": {"kernel": _heads_in(_t(get(q + "attn.attention.k_proj.weight")), nh, hd),
+                               "bias": zero_hb},
+                    "v_proj": {"kernel": _heads_in(_t(get(q + "attn.attention.v_proj.weight")), nh, hd),
+                               "bias": zero_hb},
+                    "o_proj": {"kernel": _heads_out(_t(get(q + "attn.attention.out_proj.weight")), nh, hd),
+                               "bias": get(q + "attn.attention.out_proj.bias")},
+                },
+                "mlp": {
+                    "up_proj": {"kernel": _t(get(q + "mlp.c_fc.weight")),
+                                "bias": get(q + "mlp.c_fc.bias")},
+                    "down_proj": {"kernel": _t(get(q + "mlp.c_proj.weight")),
+                                  "bias": get(q + "mlp.c_proj.bias")},
+                },
+            }
+
+        top = {
+            "embed": {"embedding": get("transformer.wte.weight")},
+            "pos_embed": get("transformer.wpe.weight"),
+            "final_norm": {"scale": get("transformer.ln_f.weight"),
+                           "bias": get("transformer.ln_f.bias")},
         }
         return self._assemble(cfg, top, layer)
 
@@ -843,16 +987,16 @@ class MegatronPolicy(InjectionPolicy):
         return self._assemble(cfg, top, layer)
 
 
-replace_policies = [LlamaPolicy, MixtralPolicy, GPT2Policy, OPTPolicy, BloomPolicy,
-                    GPTJPolicy, GPTNeoXPolicy, BertPolicy, DistilBertPolicy,
+replace_policies = [LlamaPolicy, MixtralPolicy, GPT2Policy, GPTNeoPolicy, OPTPolicy,
+                    BloomPolicy, GPTJPolicy, GPTNeoXPolicy, BertPolicy, DistilBertPolicy,
                     CLIPTextPolicy, MegatronPolicy]
 
 
 def get_policy(hf_config):
     # Mixtral before Llama: both match model_type prefixes via architectures;
     # MegatronPolicy last — it matches only to raise its routing explanation
-    for cls in (MixtralPolicy, LlamaPolicy, GPT2Policy, OPTPolicy, BloomPolicy,
-                GPTJPolicy, GPTNeoXPolicy, BertPolicy, DistilBertPolicy,
+    for cls in (MixtralPolicy, LlamaPolicy, GPT2Policy, GPTNeoPolicy, OPTPolicy,
+                BloomPolicy, GPTJPolicy, GPTNeoXPolicy, BertPolicy, DistilBertPolicy,
                 CLIPTextPolicy, MegatronPolicy):
         if cls.matches(hf_config):
             return cls()

@@ -27,22 +27,46 @@ def _expert_constraint(x, spec):
 
 
 class Experts(nn.Module):
-    """Batched expert FFNs: weights (E, H, F)/(E, F, H)."""
+    """Batched expert FFNs: weights (E, H, F)/(E, F, H). ``int8`` serves
+    from per-expert group-quantized weights (reference
+    ``moe_inference.py``'s int8 expert path): each kernel becomes
+    (int8 (E, K, N), fp32 scales (E, G, N)) built by ``quantize_params``."""
     num_experts: int
     hidden: int
     ffn: int
     activation: str
     dtype: any
+    int8: bool = False
+    int8_groups: int = 0  # scale-group SIZE (0 = default rule, 128)
+
+    def _qparam(self, name, k, n):
+        E = self.num_experts
+        gs = self.int8_groups or 128
+        G = k // gs if k % gs == 0 else 1
+        q = self.param(name + "_q", nn.initializers.zeros, (E, k, n), jnp.int8)
+        s = self.param(name + "_scale", nn.initializers.ones, (E, G, n), jnp.float32)
+        return q, s
+
+    def _deq(self, q, s):
+        E, k, n = q.shape
+        G = s.shape[1]
+        return (q.astype(self.dtype).reshape(E, G, k // G, n)
+                * s[:, :, None, :].astype(self.dtype)).reshape(E, k, n)
 
     @nn.compact
     def __call__(self, x):  # x: (E, C, H)
         init = nn.initializers.normal(0.02)
         E, H, F = self.num_experts, self.hidden, self.ffn
-        gate_k = self.param("gate_proj", init, (E, H, F), jnp.float32)
-        up_k = self.param("up_proj", init, (E, H, F), jnp.float32)
-        down_k = self.param("down_proj", init, (E, F, H), jnp.float32)
         x = x.astype(self.dtype)
-        gk, uk, dk = (k.astype(self.dtype) for k in (gate_k, up_k, down_k))
+        if self.int8:
+            gk = self._deq(*self._qparam("gate_proj", H, F))
+            uk = self._deq(*self._qparam("up_proj", H, F))
+            dk = self._deq(*self._qparam("down_proj", F, H))
+        else:
+            gate_k = self.param("gate_proj", init, (E, H, F), jnp.float32)
+            up_k = self.param("up_proj", init, (E, H, F), jnp.float32)
+            down_k = self.param("down_proj", init, (E, F, H), jnp.float32)
+            gk, uk, dk = (k.astype(self.dtype) for k in (gate_k, up_k, down_k))
         if self.activation in ("swiglu", "geglu"):
             g = jnp.einsum("ech,ehf->ecf", x, gk)
             u = jnp.einsum("ech,ehf->ecf", x, uk)
@@ -95,7 +119,10 @@ class MoE(nn.Module):
 
         expert_in = jnp.einsum("nec,nh->ech", dispatch.astype(cfg.dtype), tokens)
         expert_in = _expert_constraint(expert_in, P(dist.EXPERT_AXIS, None, None))
-        expert_out = Experts(E, H, cfg.ffn_size, cfg.activation, cfg.dtype, name="experts")(expert_in)
+        expert_out = Experts(E, H, cfg.ffn_size, cfg.activation, cfg.dtype,
+                             int8=getattr(cfg, "int8_weights", False),
+                             int8_groups=getattr(cfg, "int8_group_size", 0),
+                             name="experts")(expert_in)
         expert_out = _expert_constraint(expert_out, P(dist.EXPERT_AXIS, None, None))
         out = jnp.einsum("nec,ech->nh", combine.astype(cfg.dtype), expert_out)
         if dist.has_mesh():

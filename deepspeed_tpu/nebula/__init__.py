@@ -1,0 +1,66 @@
+"""Nebula config shim (reference ``deepspeed/nebula/config.py`` +
+``constants.py``).
+
+The reference's Nebula integration is an Azure-hosted async tiered
+checkpoint service: the config block selects the
+``NebulaCheckpointEngine`` (``runtime/checkpoint_engine/
+nebula_checkpoint_engine.py:20``), which hands torch saves to the
+``torch_nebula`` SDK for background persistence with version retention.
+
+On TPU the capability is NATIVE: the Orbax checkpoint engine
+(``runtime/checkpoint_engine/engine.py``) already saves asynchronously
+(``checkpoint.async_save``) with commit/latest semantics and no external
+service. This module keeps the reference's CONFIG SURFACE so configs
+carrying a ``nebula`` block parse, map onto the native async engine where
+meaningful, and warn where they cannot.
+"""
+
+from ..utils.logging import logger
+
+NEBULA = "nebula"
+NEBULA_ENABLED = "enabled"
+NEBULA_ENABLED_DEFAULT = False
+NEBULA_ENABLE_NEBULA_LOAD = "enable_nebula_load"
+NEBULA_ENABLE_NEBULA_LOAD_DEFAULT = True
+NEBULA_LOAD_PATH = "nebula_load_path"
+NEBULA_LOAD_PATH_DEFAULT = None
+NEBULA_PERSISTENT_STORAGE_PATH = "persistent_storage_path"
+NEBULA_PERSISTENT_STORAGE_PATH_DEFAULT = None
+NEBULA_PERSISTENT_TIME_INTERVAL = "persistent_time_interval"
+NEBULA_PERSISTENT_TIME_INTERVAL_DEFAULT = 100
+NEBULA_NUM_OF_VERSION_IN_RETENTION = "num_of_version_in_retention"
+NEBULA_NUM_OF_VERSION_IN_RETENTION_DEFAULT = 2
+
+
+class DeepSpeedNebulaConfig:
+    """Parse the reference's ``nebula`` block; ``enabled`` maps onto the
+    native async (Orbax) checkpoint path."""
+
+    def __init__(self, param_dict=None):
+        nd = dict((param_dict or {}).get(NEBULA, {}) or {})
+        self.enabled = bool(nd.get(NEBULA_ENABLED, NEBULA_ENABLED_DEFAULT))
+        self.enable_nebula_load = bool(nd.get(NEBULA_ENABLE_NEBULA_LOAD,
+                                              NEBULA_ENABLE_NEBULA_LOAD_DEFAULT))
+        self.load_path = nd.get(NEBULA_LOAD_PATH, NEBULA_LOAD_PATH_DEFAULT)
+        self.persistent_storage_path = nd.get(NEBULA_PERSISTENT_STORAGE_PATH,
+                                              NEBULA_PERSISTENT_STORAGE_PATH_DEFAULT)
+        self.persistent_time_interval = int(nd.get(NEBULA_PERSISTENT_TIME_INTERVAL,
+                                                   NEBULA_PERSISTENT_TIME_INTERVAL_DEFAULT))
+        self.num_of_version_in_retention = int(nd.get(
+            NEBULA_NUM_OF_VERSION_IN_RETENTION, NEBULA_NUM_OF_VERSION_IN_RETENTION_DEFAULT))
+        if self.enabled:
+            logger.info("nebula.enabled: mapping onto the native async checkpoint "
+                        "engine (checkpoint.async_save=true) — there is no external "
+                        "Nebula service on TPU; persistence is Orbax commit/latest")
+        if self.persistent_storage_path:
+            logger.warning("nebula.persistent_storage_path is accepted for config "
+                           "parity but tiered persistence is handled by the native "
+                           "checkpoint dir; the value is not used")
+
+    def apply_to(self, config):
+        """Fold onto an engine config: nebula.enabled turns on async saves."""
+        if self.enabled:
+            ck = dict(config.get("checkpoint", {}) or {})
+            ck.setdefault("async_save", True)
+            config["checkpoint"] = ck
+        return config

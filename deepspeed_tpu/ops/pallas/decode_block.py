@@ -1,0 +1,325 @@
+"""Fused single-token decode layer (TPU Pallas).
+
+The TPU equivalent of the reference's fused inference pass — ``qkv_gemm ->
+softmax_context -> vector_matmul -> mlp_gemm`` (``csrc/transformer/
+inference/csrc/pt_binding.cpp:1745-1805`` + ``inference_context.h``'s
+workspace): a decode layer runs in THREE resident kernels, with int8
+weights streamed block-by-block through the MXU and the layer's
+norms/biases/activations folded in (no XLA glue between projections).
+
+Why: at decode the step is HBM-bound and the op count is the enemy — the
+per-projection path costs ~190 kernel launches + ~340 XLA glue fusions per
+token step, whose fixed costs roughly double the ideal weight-streaming
+time. This brings a layer to 3 launches + 2 cache-commit
+dynamic-update-slices:
+
+    kernel A  ln1(x) folded into the fused [q;k;v] int8 matmul (+bias)
+    kernel B  ``decode_attention`` over the committed KV cache
+    kernel C  o-projection (+bias) -> residual -> ln2 -> up (+bias, act)
+              -> down (+bias) -> residual -> x_out
+
+Everything inside the kernels stays 2-D (lane dim = feature dim): Mosaic
+cannot lane-split ``(B, nh*hd) -> (B, nh, hd)`` in-kernel, so the head
+reshape + cache commit happen in XLA where they are free (the HLO audit
+shows zero copies in the decode loop body).
+
+Supported model shape (the engine gates on this): fused int8 qkv weights,
+layernorm norms, sequential residual, gelu/gelu_exact/quick_gelu/relu MLP
+(no gate), no rope/alibi (learned or no positional embedding), and
+``num_heads == kv_heads``. Quantization groups follow
+``CausalLMModel.quantize_params``. Weight-block scales are applied to the
+(B, n-block) fp32 partial sums after each dot — see ``quant_matmul.py``
+for the design rationale and microbenchmarks.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret():
+    return jax.default_backend() == "cpu"
+
+
+def _ln(x32, scale, bias, eps):
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    return (x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _act(h, kind):
+    if kind == "gelu":
+        return jax.nn.gelu(h, approximate=True)
+    if kind == "gelu_exact":
+        return jax.nn.gelu(h, approximate=False)
+    if kind == "quick_gelu":
+        return h * jax.nn.sigmoid(1.702 * h)
+    return jnp.maximum(h, 0.0)
+
+
+def _qdot(x_bf16, w_ref, s_ref, k_idx, bk, gsize, col_off=None):
+    """One k-block of an int8 matmul: widen to bf16, dot, scale partials.
+    ``k_idx``: which k-block this grid step computes (python int or traced).
+    ``col_off``: column offset into the (full-width) scales block when the
+    weight block covers only a slice of N. Returns fp32 (B, bn)."""
+    w = w_ref[...]
+    bn = w.shape[1]
+    ng = max(1, bk // gsize)
+    span = min(gsize, bk)
+    acc = None
+    for t in range(ng):
+        part = jax.lax.dot_general(
+            x_bf16[:, t * span:(t + 1) * span],
+            w[t * span:(t + 1) * span, :].astype(x_bf16.dtype),
+            (((1, ), (0, )), ((), ())), preferred_element_type=jnp.float32)
+        row = (k_idx * bk) // gsize + t
+        if col_off is None:
+            sl = s_ref[row, :]
+        else:
+            sl = s_ref[row, pl.ds(col_off, bn)]
+        part = part * sl[None, :]
+        acc = part if acc is None else acc + part
+    return acc
+
+
+def _pick_bk(K, gsize, cap=1024):
+    """Largest multiple of gsize dividing K under cap (>=1 group/block)."""
+    bk = gsize
+    for cand in range(min(K, cap) // gsize * gsize, gsize - 1, -gsize):
+        if K % cand == 0:
+            return cand
+    return bk
+
+
+def _prep_scales(sc):
+    sc = jnp.asarray(sc, jnp.float32)
+    G = sc.shape[0]
+    Gp = -(-G // 8) * 8
+    return (jnp.pad(sc, ((0, Gp - G), (0, 0))) if Gp != G else sc), G
+
+
+# --------------------------------------------------------------- kernel A
+def _qkv_ln_kernel(x_ref, norms_ref, w_ref, s_ref, b_ref, o_ref,
+                   xln_s, acc_s, *, nk1, bk1, g1, eps):
+    s = pl.program_id(0)
+
+    @pl.when(s == 0)
+    def _ln1():
+        x32 = x_ref[...].astype(jnp.float32)
+        xln_s[...] = _ln(x32, norms_ref[0, :][None, :], norms_ref[1, :][None, :],
+                         eps).astype(x_ref.dtype)
+
+    part = _qdot(xln_s[:, pl.ds(s * bk1, bk1)], w_ref, s_ref, s, bk1, g1)
+
+    @pl.when(s == 0)
+    def _init():
+        acc_s[...] = part
+
+    @pl.when(s > 0)
+    def _acc():
+        acc_s[...] += part
+
+    @pl.when(s == nk1 - 1)
+    def _done():
+        o_ref[...] = (acc_s[...] + b_ref[0, :][None, :]).astype(o_ref.dtype)
+
+
+def fused_qkv_ln(x, norms, qkv, *, eps=1e-5):
+    """ln1(x) @ dequant(Wqkv) + bias in one kernel. x: (B, H) bf16;
+    norms: (4, H) f32 (rows 0/1 used); qkv: (W int8 (H, Nqkv), scales,
+    bias). Returns (B, Nqkv) bf16."""
+    B, H = x.shape
+    w, sc, b = qkv
+    Nq = w.shape[1]
+    sc, G = _prep_scales(sc)
+    g1 = H // G
+    bk1 = _pick_bk(H, g1)
+    nk1 = H // bk1
+    kernel = functools.partial(_qkv_ln_kernel, nk1=nk1, bk1=bk1, g1=g1, eps=eps)
+    return pl.pallas_call(
+        kernel,
+        grid=(nk1, ),
+        in_specs=[
+            pl.BlockSpec((B, H), lambda s: (0, 0)),
+            pl.BlockSpec(norms.shape, lambda s: (0, 0)),
+            pl.BlockSpec((bk1, Nq), lambda s: (s, 0)),
+            pl.BlockSpec(sc.shape, lambda s: (0, 0)),
+            pl.BlockSpec((1, Nq), lambda s: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, Nq), lambda s: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Nq), x.dtype),
+        scratch_shapes=[pltpu.VMEM((B, H), x.dtype), pltpu.VMEM((B, Nq), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary", )),
+        interpret=_interpret(),
+    )(x, norms, w, sc, b.reshape(1, -1))
+
+
+# --------------------------------------------------------------- kernel C
+def _out_mlp_kernel(attn_ref, x_ref, norms_ref,
+                    o_w, o_s, o_b, up_w, up_s, up_b, dn_w, dn_s, dn_b,
+                    xo_ref, res2, ln2_s, up_h, acc_s,
+                    *, nko, nju, nku, nkd, bko, bk1, bnu, bkd, go, gu, gd,
+                    eps, act):
+    s = pl.program_id(0)
+    A1 = nko
+    A2 = A1 + nju * nku
+
+    # ---- o projection + residual ----
+    @pl.when(s < A1)
+    def _o():
+        part = _qdot(attn_ref[:, pl.ds(s * bko, bko)], o_w, o_s, s, bko, go)
+
+        @pl.when(s == 0)
+        def _():
+            acc_s[...] = part
+
+        @pl.when(s > 0)
+        def _():
+            acc_s[...] += part
+
+    @pl.when(s == A1 - 1)
+    def _o_done():
+        r = acc_s[...] + o_b[0, :][None, :] + x_ref[...].astype(jnp.float32)
+        res2[...] = r
+        ln2_s[...] = _ln(r, norms_ref[2, :][None, :], norms_ref[3, :][None, :],
+                         eps).astype(ln2_s.dtype)
+
+    # ---- up projection + activation ----
+    @pl.when((s >= A1) & (s < A2))
+    def _up():
+        p_ = s - A1
+        j, k = p_ // nku, p_ % nku
+        part = _qdot(ln2_s[:, pl.ds(k * bk1, bk1)], up_w, up_s, k, bk1, gu,
+                     col_off=j * bnu)
+
+        @pl.when(k == 0)
+        def _():
+            upd = part
+            if nku == 1:  # single k-block: this step completes the column
+                upd = _act(upd + up_b[0, pl.ds(j * bnu, bnu)][None, :], act)
+            up_h[:, pl.ds(j * bnu, bnu)] = upd.astype(up_h.dtype)
+
+        @pl.when(k > 0)
+        def _():
+            upd = up_h[:, pl.ds(j * bnu, bnu)].astype(jnp.float32) + part
+            if nku > 1:  # tracing reaches here only when nku > 1
+                upd2 = _act(upd + up_b[0, pl.ds(j * bnu, bnu)][None, :], act)
+                upd = jnp.where(k == nku - 1, upd2, upd)
+            up_h[:, pl.ds(j * bnu, bnu)] = upd.astype(up_h.dtype)
+
+    # ---- down projection + residual ----
+    @pl.when(s >= A2)
+    def _down():
+        k = s - A2
+        part = _qdot(up_h[:, pl.ds(k * bkd, bkd)], dn_w, dn_s, k, bkd, gd)
+
+        @pl.when(k == 0)
+        def _():
+            acc_s[...] = part
+
+        @pl.when(k > 0)
+        def _():
+            acc_s[...] += part
+
+    @pl.when(s == pl.num_programs(0) - 1)
+    def _finish():
+        xo_ref[...] = (res2[...] + acc_s[...] + dn_b[0, :][None, :]).astype(xo_ref.dtype)
+
+
+def fused_out_mlp(attn2d, x, norms, o, up, down, *, activation="gelu", eps=1e-5):
+    """x + o_proj(attn) -> ln2 -> up -> act -> down -> + residual, one
+    kernel. attn2d: (B, nh*hd) bf16 flattened attention output; x: (B, H)
+    residual stream; norms (4, H) f32 rows 2/3 used; o/up/down:
+    (W int8, scales, bias). Returns x_out (B, H) bf16."""
+    B, H = x.shape
+    o_w, o_s, o_b = o
+    up_w, up_s, up_b = up
+    dn_w, dn_s, dn_b = down
+    Ko = o_w.shape[0]
+    F = up_w.shape[1]
+    o_s, Go = _prep_scales(o_s)
+    up_s, Gu = _prep_scales(up_s)
+    dn_s, Gd = _prep_scales(dn_s)
+    go, gu, gd = Ko // Go, H // Gu, F // Gd
+    bko = _pick_bk(Ko, go)
+    bk1 = _pick_bk(H, gu)
+    bkd = _pick_bk(F, gd)
+    from .quant_matmul import pick_block
+    bnu = pick_block(F, 2560, 128)
+    nko, nkd = Ko // bko, F // bkd
+    nju, nku = F // bnu, H // bk1
+    nsteps = nko + nju * nku + nkd
+    A1 = nko
+
+    kernel = functools.partial(
+        _out_mlp_kernel, nko=nko, nju=nju, nku=nku, nkd=nkd,
+        bko=bko, bk1=bk1, bnu=bnu, bkd=bkd, go=go, gu=gu, gd=gd,
+        eps=eps, act=activation)
+    f32 = jnp.float32
+    return pl.pallas_call(
+        kernel,
+        grid=(nsteps, ),
+        in_specs=[
+            pl.BlockSpec((B, Ko), lambda s: (0, 0)),
+            pl.BlockSpec((B, H), lambda s: (0, 0)),
+            pl.BlockSpec(norms.shape, lambda s: (0, 0)),
+            pl.BlockSpec((bko, H), lambda s: (jnp.clip(s, 0, nko - 1), 0)),
+            pl.BlockSpec(o_s.shape, lambda s: (0, 0)),
+            pl.BlockSpec((1, H), lambda s: (0, 0)),
+            pl.BlockSpec((bk1, bnu), lambda s: (
+                jnp.clip(s - A1, 0, nju * nku - 1) % nku,
+                jnp.clip(s - A1, 0, nju * nku - 1) // nku)),
+            pl.BlockSpec(up_s.shape, lambda s: (0, 0)),
+            pl.BlockSpec((1, F), lambda s: (0, 0)),
+            pl.BlockSpec((bkd, H), lambda s: (jnp.clip(s - A1 - nju * nku, 0, nkd - 1), 0)),
+            pl.BlockSpec(dn_s.shape, lambda s: (0, 0)),
+            pl.BlockSpec((1, H), lambda s: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((B, H), lambda s: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((B, H), f32),       # res2
+            pltpu.VMEM((B, H), x.dtype),   # ln2 out
+            pltpu.VMEM((B, F), x.dtype),   # up_h
+            pltpu.VMEM((B, H), f32),       # shared o/down accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary", )),
+        interpret=_interpret(),
+    )(attn2d, x, norms, o_w, o_s, o_b.reshape(1, -1),
+      up_w, up_s, up_b.reshape(1, -1), dn_w, dn_s, dn_b.reshape(1, -1))
+
+
+def fused_decode_block(x, norms, k_cache, v_cache, qkv, o, up, down,
+                       start, pos, *, activation="gelu", eps=1e-5, block_kv=256):
+    """One fused transformer decode layer for a single token per row.
+
+    x: (B, H) bf16 residual stream. norms: (4, H) f32 rows
+    [ln1_scale, ln1_bias, ln2_scale, ln2_bias]. k_cache/v_cache:
+    (B, nh, S, hd). qkv/o/up/down: (weight_q int8, scales f32 (G, N),
+    bias f32 (N,)) tuples in matmul layout (qkv fused [q;k;v]).
+    start: (B,) int32 first attendable slot; pos: scalar int32 cache write
+    position.
+
+    Returns (x_out (B, H) bf16, new_k_cache, new_v_cache) — the caches are
+    committed (dynamic_update_slice at ``pos``) before attention, exactly
+    like the unfused model path.
+    """
+    from .decode_attention import decode_attention
+    B, H = x.shape
+    _, nh, S, hd = k_cache.shape
+    qkv2d = fused_qkv_ln(x, norms, qkv, eps=eps)  # (B, 3*nh*hd)
+    qf, kf, vf = jnp.split(qkv2d, [nh * hd, 2 * nh * hd], axis=-1)
+    k3 = kf.reshape(B, nh, 1, hd)
+    v3 = vf.reshape(B, nh, 1, hd)
+    k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k3.astype(k_cache.dtype),
+                                                  pos, axis=2)
+    v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v3.astype(v_cache.dtype),
+                                                  pos, axis=2)
+    attn = decode_attention(qf.reshape(B, nh, hd), k_cache, v_cache,
+                            start, pos + 1, block_kv=min(block_kv, S))
+    x_out = fused_out_mlp(attn.reshape(B, nh * hd), x, norms, o, up, down,
+                          activation=activation, eps=eps)
+    return x_out, k_cache, v_cache

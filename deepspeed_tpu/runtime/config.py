@@ -204,6 +204,14 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
         if self.gradient_checkpointing is not None:
             if self.gradient_checkpointing and self.activation_checkpointing.policy is None:
                 self.activation_checkpointing.policy = "nothing_saveable"
+        if dict(config_dict.get("nebula", {}) or {}).get("enabled"):
+            # nebula shim (reference nebula/config.py): the service's async
+            # tiered persistence maps onto the native Orbax async engine
+            from ..nebula import DeepSpeedNebulaConfig
+            self.nebula = DeepSpeedNebulaConfig(config_dict)
+            self.checkpoint.async_save = True
+        else:
+            self.nebula = None
         if dict(config_dict.get("elasticity", {})).get("enabled"):
             # elastic batch resolution (reference engine.py:462 guard +
             # elasticity.py:233): the pre-computed elastic batch overrides any
@@ -229,7 +237,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     INERT_SECTIONS = frozenset({
         "amp", "sparse_attention", "sparse_gradients", "communication_data_type",
         "fp32_allreduce", "disable_allgather", "memory_breakdown", "dump_state",
-        "data_types", "zero_force_ds_cpu_optimizer", "nebula",
+        "data_types", "zero_force_ds_cpu_optimizer",
     })
 
     def _warn_inert_sections(self, config_dict):

@@ -682,15 +682,32 @@ class DeepSpeedEngine:
         gas = self._config.gradient_accumulation_steps
 
         pipe_cfg = dict(self._config.pipeline or {})
-        schedule = str(pipe_cfg.pop("schedule", "fill_drain"))
+        schedule = str(pipe_cfg.pop("schedule", "auto"))
         if pipe_cfg:
             # the reference PipelineModule section has more keys; only
             # 'schedule' is consumed here — silence would be a porting trap
             logger.warning(f"pipeline section keys {sorted(pipe_cfg)} are not consumed "
                            f"(only 'schedule' is); they have NO effect in this build")
-        if schedule not in ("fill_drain", "1f1b"):
-            raise ValueError(f"pipeline.schedule must be 'fill_drain' or '1f1b', "
+        if schedule not in ("auto", "fill_drain", "1f1b"):
+            raise ValueError(f"pipeline.schedule must be 'auto', 'fill_drain' or '1f1b', "
                              f"got {schedule!r}")
+        if schedule == "auto":
+            # 1F1B is the default where it composes (O(stages) activation
+            # liveness, reference TrainSchedule); fall back where it can't:
+            # fp16 loss scaling, tensor/seq under the auto partitioner
+            # inside the pipe-manual region, MoE aux, unscanned layers.
+            mc = getattr(self.module, "cfg", None)
+            eligible = (hasattr(self.module, "pipeline_value_and_grad")
+                        and not self._config.fp16.enabled
+                        and self.mesh.shape[dist.TENSOR_AXIS] == 1
+                        and self.mesh.shape[dist.SEQ_AXIS] == 1
+                        and getattr(mc, "num_experts", 0) == 0
+                        and getattr(mc, "scan_layers", False))
+            schedule = "1f1b" if eligible else "fill_drain"
+            auto_picked = True
+            log_dist(f"pipeline.schedule=auto -> {schedule}", [0])
+        else:
+            auto_picked = False
         if schedule == "1f1b" and self._config.fp16.enabled:
             # the interleaved backward seeds per-microbatch cotangents BEFORE
             # the engine's loss scale is applied; fp16's dynamic scaling
@@ -712,7 +729,13 @@ class DeepSpeedEngine:
         def train_step(state, batch):
             rng = jax.random.fold_in(self._base_rng, state.step)
 
-            if schedule == "1f1b":
+            # auto-picked 1F1B degrades to fill-drain for masked batches
+            # (the interleaved schedule doesn't thread attention_mask);
+            # batch STRUCTURE is static under jit, so this is a trace-time
+            # branch, not data-dependent control flow
+            use_1f1b = schedule == "1f1b" and not (
+                auto_picked and batch.get("attention_mask") is not None)
+            if use_1f1b:
                 # interleaved one-pass schedule: fwd+bwd per tick, per-stage
                 # activation liveness O(stages) (reference TrainSchedule 1F1B)
                 p_c = jax.tree_util.tree_map(lambda x: jnp.asarray(x, self.compute_dtype),

@@ -112,7 +112,7 @@ def spmd_pipeline(stage_fn, stage_params, x_stream, mesh=None, remat=False, with
 
 
 def spmd_pipeline_1f1b(stage_fn, loss_head, stage_params, head_params, x_stream,
-                       mesh=None):
+                       mesh=None, loss_denom=None):
     """One-pass interleaved 1F1B (reference ``TrainSchedule``,
     ``pipe/schedule.py:189``): every tick runs one (masked) forward micro-step
     AND one (masked) backward micro-step, so a stage holds at most
@@ -122,11 +122,14 @@ def spmd_pipeline_1f1b(stage_fn, loss_head, stage_params, head_params, x_stream,
     recompute jax.grad-through-scan performs for the fill-drain schedule).
 
     ``stage_fn(local_params, x, t) -> y`` — fill-drain contract;
-    ``loss_head(head_params, y, m) -> scalar`` — microbatch ``m``'s loss
-    contribution (already normalized by the GLOBAL token count so summing
-    over the stream equals the fill-drain loss), evaluated at the last stage
-    the moment its forward finishes — that is what lets backward start
-    immediately (the 1F1B property).
+    ``loss_head(head_params, y, m) -> scalar`` — microbatch ``m``'s RAW loss
+    contribution (e.g. summed token CE), evaluated at the last stage the
+    moment its forward finishes — that is what lets backward start
+    immediately (the 1F1B property). ``loss_denom``: global normalizer (e.g.
+    total valid-token count) the SCHEDULE divides by, so summing microbatch
+    contributions reproduces the fill-drain mean — callers cannot
+    mis-normalize (pass None only if loss_head already returns its share of
+    the final mean).
 
     Returns ``(loss, stage_grads, head_grads, dx_stream)``: total loss;
     gradients of the pipe-sharded stage params (same layout as
@@ -134,6 +137,9 @@ def spmd_pipeline_1f1b(stage_fn, loss_head, stage_params, head_params, x_stream,
     stage's contribution, psum'd); and the gradient w.r.t. ``x_stream`` for
     the caller's embedding backward.
     """
+    if loss_denom is not None:
+        raw_head = loss_head
+        loss_head = lambda hp, y, m: raw_head(hp, y, m) / loss_denom
     mesh = mesh or dist.get_mesh()
     n = mesh.shape[dist.PIPE_AXIS]
     M = jax.tree_util.tree_leaves(x_stream)[0].shape[0]

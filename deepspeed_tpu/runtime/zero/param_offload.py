@@ -340,8 +340,11 @@ class ParamStreamRunner:
         self._seed_int = int(rng_seed)
         self._rng = jax.random.key(rng_seed)
 
-        if getattr(getattr(model, "cfg", None), "num_experts", 0) > 0:
-            raise NotImplementedError("offload_param does not yet compose with MoE models")
+        # MoE composes: expert kernels ride each layer block (the stacked
+        # (E, ...) leaves stream like any other); the gating aux loss flows
+        # through the per-layer vjp (see _build_fns)
+        self._moe = getattr(getattr(model, "cfg", None), "num_experts", 0) > 0
+        self._aux_coef = float(getattr(getattr(model, "cfg", None), "moe_aux_loss_coef", 0.0))
         if jnp.dtype(compute_dtype) == jnp.float16:
             raise NotImplementedError("offload_param streams bf16 blocks; fp16 loss-scaled "
                                       "streaming is not supported (use bf16)")
@@ -464,17 +467,32 @@ class ParamStreamRunner:
     def _build_fns(self, T, shift, has_mask):
         model = self.model
         cd = self.compute_dtype
+        moe, aux_coef = self._moe, self._aux_coef
 
         def embed_fwd(ep, ids):
             return model.stream_embed(ep, ids).astype(cd)
 
-        def layer_fwd(lp, h, mask):
-            return model.stream_layer(lp, h, mask).astype(cd)
+        if moe:
+            # forward carries this layer's gating aux loss; backward seeds
+            # its cotangent with the aux coefficient so the gate/expert
+            # grads include load balancing (the fused path adds
+            # coef*sum(aux) to the scalar loss — same math, per layer)
+            def layer_fwd(lp, h, mask):
+                y, aux = model.stream_layer(lp, h, mask, return_aux=True)
+                return y.astype(cd), aux
 
-        def layer_bwd(lp, h, mask, g):
-            _, vjp = jax.vjp(lambda lp_, h_: layer_fwd(lp_, h_, mask), lp, h)
-            dlp, dh = vjp(g)
-            return dlp, dh
+            def layer_bwd(lp, h, mask, g):
+                _, vjp = jax.vjp(lambda lp_, h_: layer_fwd(lp_, h_, mask), lp, h)
+                dlp, dh = vjp((g, jnp.asarray(aux_coef, jnp.float32)))
+                return dlp, dh
+        else:
+            def layer_fwd(lp, h, mask):
+                return model.stream_layer(lp, h, mask).astype(cd)
+
+            def layer_bwd(lp, h, mask, g):
+                _, vjp = jax.vjp(lambda lp_, h_: layer_fwd(lp_, h_, mask), lp, h)
+                dlp, dh = vjp(g)
+                return dlp, dh
 
         def tail_grad(tp, h, labels, valid):
             def f(tp_, h_):
@@ -507,16 +525,23 @@ class ParamStreamRunner:
             ep = self._put(self.store.bf16("embed"), self._shard_embed)
             h = fns["embed_fwd"](ep, ids)
             acts = []
+            aux_total = 0.0
             lp_next = self._put_layer(0)
             for l in range(self.L):
                 lp = lp_next
                 if l + 1 < self.L:
                     lp_next = self._put_layer(l + 1)  # prefetch overlaps compute
                 acts.append(h)
-                h = fns["layer_fwd"](lp, h, mask)
+                if self._moe:
+                    h, aux = fns["layer_fwd"](lp, h, mask)
+                    aux_total = aux_total + aux
+                else:
+                    h = fns["layer_fwd"](lp, h, mask)
                 del lp
             tp = self._put(self._tail_store_tree(), self._shard_tail)
             loss, dtp, dh = fns["tail_grad"](tp, h, labels, valid)
+            if self._moe:  # report CE + coef*aux like the fused engine
+                loss = loss + self._aux_coef * aux_total
             del tp, h
             grad_sink("tail", dtp)
             for l in reversed(range(self.L)):

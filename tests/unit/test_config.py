@@ -88,3 +88,18 @@ def test_mesh_section():
     cfg = DeepSpeedConfig({"train_batch_size": 8, "mesh": {"tensor_parallel_size": 2}}, world_size=8)
     assert cfg.mesh.tensor_parallel_size == 2
     assert cfg.mesh.data_parallel_size == 4
+
+
+def test_nebula_config_maps_to_async_checkpoint():
+    """Nebula shim (reference nebula/config.py): the config block parses
+    with the reference keys and maps onto the native async Orbax engine."""
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "nebula": {"enabled": True, "persistent_time_interval": 50,
+                                      "num_of_version_in_retention": 3}})
+    assert cfg.nebula is not None
+    assert cfg.nebula.persistent_time_interval == 50
+    assert cfg.nebula.num_of_version_in_retention == 3
+    assert cfg.checkpoint.async_save is True
+    # disabled block stays inert
+    cfg2 = DeepSpeedConfig({"train_batch_size": 8, "nebula": {"enabled": False}})
+    assert cfg2.nebula is None and cfg2.checkpoint.async_save is False

@@ -78,6 +78,43 @@ def test_opt_injection_matches_hf():
     _compare(hf, ids)
 
 
+def test_gptneo_injection_matches_hf():
+    """GPT-Neo (reference containers/gptneo.py): unscaled attention scores +
+    alternating global/local sliding-window layers. T > window so the local
+    mask actually bites."""
+    cfg = transformers.GPTNeoConfig(vocab_size=128, max_position_embeddings=64,
+                                    hidden_size=32, num_layers=2, num_heads=4,
+                                    intermediate_size=64, window_size=8,
+                                    attention_types=[[["global", "local"], 1]])
+    torch.manual_seed(7)
+    hf = transformers.GPTNeoForCausalLM(cfg)
+    ids = np.random.default_rng(7).integers(0, 128, (2, 24)).astype(np.int32)
+    model, params = _compare(hf, ids)
+    assert model.cfg.attn_scale == 1.0
+    assert model.cfg.local_attention_layers == (1, )
+    assert model.cfg.local_attention_window == 8
+
+
+def test_gptneo_generate_matches_hf():
+    cfg = transformers.GPTNeoConfig(vocab_size=128, max_position_embeddings=128,
+                                    hidden_size=32, num_layers=2, num_heads=4,
+                                    intermediate_size=64, window_size=8,
+                                    attention_types=[[["global", "local"], 1]])
+    torch.manual_seed(8)
+    hf = transformers.GPTNeoForCausalLM(cfg).eval()
+    prompt = np.random.default_rng(8).integers(0, 128, (1, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = hf.generate(torch.from_numpy(prompt), max_new_tokens=6, do_sample=False,
+                          pad_token_id=0)[0, 12:].numpy()
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm
+    comm._state["mesh"] = None
+    model, params = inject_hf_model(hf, dtype=jnp.float32)
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "float32"}, params=params)
+    got = eng.generate([prompt[0].tolist()], max_new_tokens=6)[0]
+    np.testing.assert_array_equal(got[:6], ref)
+
+
 def test_injection_from_checkpoint_dir(tmp_path):
     cfg = transformers.LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
                                    num_hidden_layers=2, num_attention_heads=4,
