@@ -277,6 +277,10 @@ class InferenceEngine:
                 and getattr(mc, "num_experts", 0) == 0
                 and not getattr(mc, "parallel_residual", False)
                 and getattr(mc, "norm", "") == "layernorm"
+                # the fused kernels add projection biases unconditionally;
+                # a bias-less layernorm model (attn_bias=False) must take
+                # the per-projection path
+                and (getattr(mc, "attn_bias", None) is None or mc.attn_bias)
                 and not getattr(mc, "embed_norm", False)
                 and mc.pos_embedding in ("learned", "none")
                 and mc.activation in ("gelu", "gelu_exact", "quick_gelu", "relu")

@@ -56,11 +56,3 @@ class DeepSpeedNebulaConfig:
             logger.warning("nebula.persistent_storage_path is accepted for config "
                            "parity but tiered persistence is handled by the native "
                            "checkpoint dir; the value is not used")
-
-    def apply_to(self, config):
-        """Fold onto an engine config: nebula.enabled turns on async saves."""
-        if self.enabled:
-            ck = dict(config.get("checkpoint", {}) or {})
-            ck.setdefault("async_save", True)
-            config["checkpoint"] = ck
-        return config

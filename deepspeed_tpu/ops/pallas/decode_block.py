@@ -85,13 +85,7 @@ def _qdot(x_bf16, w_ref, s_ref, k_idx, bk, gsize, col_off=None):
     return acc
 
 
-def _pick_bk(K, gsize, cap=1024):
-    """Largest multiple of gsize dividing K under cap (>=1 group/block)."""
-    bk = gsize
-    for cand in range(min(K, cap) // gsize * gsize, gsize - 1, -gsize):
-        if K % cand == 0:
-            return cand
-    return bk
+from .quant_matmul import pick_block_k as _pick_bk
 
 
 def _prep_scales(sc):

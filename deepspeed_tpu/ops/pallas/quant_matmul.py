@@ -77,6 +77,16 @@ def pick_block(n, cap, mult=128):
     return n
 
 
+def pick_block_k(K, gsize, cap=1024):
+    """Largest multiple of ``gsize`` dividing K under ``cap`` (>=1 group per
+    block) — the k-block rule shared by this kernel's default and the fused
+    decode blocks."""
+    for cand in range(min(K, cap) // gsize * gsize, gsize - 1, -gsize):
+        if K % cand == 0:
+            return cand
+    return gsize
+
+
 def _pick_bn(n, cap=4096):
     return pick_block(n, cap, 128)
 
@@ -105,11 +115,7 @@ def quant_matmul(x, qw, scales, block_m=256, block_n=None, block_k=None, out_dty
     if block_k is None:
         if gsize <= 1024:
             # largest multiple of the group size dividing K under ~1MB blocks
-            bk = gsize
-            for cand in range(min(K, 1024) // gsize * gsize, gsize - 1, -gsize):
-                if K % cand == 0:
-                    bk = cand
-                    break
+            bk = pick_block_k(K, gsize)
         else:
             # huge groups (e.g. G==1): sub-group k-blocks — any divisor of
             # gsize works since consecutive blocks just reuse one scale row

@@ -206,10 +206,12 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
                 self.activation_checkpointing.policy = "nothing_saveable"
         if dict(config_dict.get("nebula", {}) or {}).get("enabled"):
             # nebula shim (reference nebula/config.py): the service's async
-            # tiered persistence maps onto the native Orbax async engine
+            # tiered persistence maps onto the native Orbax async engine —
+            # but an EXPLICIT checkpoint.async_save in the config wins
             from ..nebula import DeepSpeedNebulaConfig
             self.nebula = DeepSpeedNebulaConfig(config_dict)
-            self.checkpoint.async_save = True
+            if "async_save" not in dict(config_dict.get("checkpoint", {}) or {}):
+                self.checkpoint.async_save = True
         else:
             self.nebula = None
         if dict(config_dict.get("elasticity", {})).get("enabled"):

@@ -69,6 +69,26 @@ def _tree_bf16(tree, out=None):
     return out
 
 
+def _tree_cast(tree, dtype, out=None):
+    """fp32 master -> compute-dtype copies. bf16 takes the native fast path
+    (cpu_adam's f32_to_bf16); fp16 (reference fp16 param swap,
+    ``partitioned_param_swapper.py:36``) goes through numpy."""
+    if np.dtype(dtype) == np.dtype(ml_dtypes.bfloat16):
+        return _tree_bf16(tree, out)
+    if out is None:
+        return jax.tree_util.tree_map(lambda x: np.ascontiguousarray(x).astype(dtype), tree)
+    jax.tree_util.tree_map(lambda x, o: np.copyto(o, x.astype(dtype)), tree, out)
+    return out
+
+
+def _leaf_cast(src_f32, out):
+    """Refresh one compute-copy leaf from flat fp32 (dtype-dispatching)."""
+    if out.dtype == np.dtype(ml_dtypes.bfloat16):
+        f32_to_bf16(np.ascontiguousarray(src_f32), out)
+    else:
+        np.copyto(out, src_f32.astype(out.dtype))
+
+
 def _nbytes(tree):
     return sum(x.nbytes for x in jax.tree_util.tree_leaves(tree))
 
@@ -83,7 +103,7 @@ class HostParamStore:
     in host DRAM. A block is a param pytree (one layer's slice of the stacked
     stack, or the embed/tail subtrees)."""
 
-    def __init__(self, optimizer_config, grad_dtype=np.float32):
+    def __init__(self, optimizer_config, grad_dtype=np.float32, compute_dtype=None):
         p = dict(optimizer_config.params)
         self.opt = DeepSpeedCPUAdam(lr=p.get("lr", 1e-3),
                                     betas=tuple(p.get("betas", (0.9, 0.999))),
@@ -91,6 +111,10 @@ class HostParamStore:
                                     weight_decay=p.get("weight_decay", 0.0),
                                     adamw_mode=p.get("adam_w_mode", True))
         self.grad_dtype = grad_dtype
+        # "bf16" names the COMPUTE COPY slot for continuity; fp16 serving of
+        # the reference's fp16 param swap stores fp16 copies in it
+        self.compute_dtype = np.dtype(compute_dtype) if compute_dtype is not None \
+            else np.dtype(ml_dtypes.bfloat16)
         self.blocks = {}  # name -> dict(master/m/v/bf16 pytrees)
         self.t = 0
 
@@ -100,7 +124,7 @@ class HostParamStore:
             "master": master,
             "m": _tree_zeros(master),
             "v": _tree_zeros(master),
-            "bf16": _tree_bf16(master),
+            "bf16": _tree_cast(master, self.compute_dtype),
         }
 
     def block_names(self):
@@ -137,7 +161,7 @@ class HostParamStore:
             self.opt.step(p.ravel(), m.ravel(), v.ravel(),
                           np.ascontiguousarray(g).ravel(), self.t,
                           lr=lr, grad_coef=grad_coef)
-        _tree_bf16(b["master"], b["bf16"])
+        _tree_cast(b["master"], self.compute_dtype, b["bf16"])
 
     # -- checkpoint --------------------------------------------------------
     def save_to(self, tag_dir):
@@ -176,7 +200,7 @@ class HostParamStore:
                 for kind in ("m", "v"):    # load_optimizer_states=False)
                     for leaf in jax.tree_util.tree_leaves(b[kind]):
                         leaf[...] = 0
-            _tree_bf16(b["master"], b["bf16"])
+            _tree_cast(b["master"], self.compute_dtype, b["bf16"])
             nz.close()
         self.t = int(meta["step"]) if load_optimizer_states else 0
         return True
@@ -187,8 +211,9 @@ class NVMeParamStore(HostParamStore):
     bf16 compute copies plus a rotating (read | adam | write) window —
     the pipelined swapper scheme of ``swap_tensor/optimizer_swapper.py``."""
 
-    def __init__(self, optimizer_config, nvme_path, aio_config=None, grad_dtype=np.float32):
-        super().__init__(optimizer_config, grad_dtype)
+    def __init__(self, optimizer_config, nvme_path, aio_config=None, grad_dtype=np.float32,
+                 compute_dtype=None):
+        super().__init__(optimizer_config, grad_dtype, compute_dtype)
         from ...ops.aio import AsyncIOHandle
         from ..swap_tensor.aio_config import get_aio_config
         aio = aio_config if aio_config is not None else get_aio_config({})
@@ -220,7 +245,7 @@ class NVMeParamStore(HostParamStore):
         self._write_h.async_pwrite(zeros, self._file(name, "m"))
         self._write_h.async_pwrite(zeros, self._file(name, "v"))
         self._write_h.wait()
-        self.blocks[name] = {"bf16": _tree_bf16(master)}
+        self.blocks[name] = {"bf16": _tree_cast(master, self.compute_dtype)}
 
     def num_params(self):
         return sum(sum(int(np.prod(s, dtype=np.int64)) for _, s in leaves)
@@ -261,7 +286,7 @@ class NVMeParamStore(HostParamStore):
             for (path, shape), leaf in zip(self._meta[name],
                                            jax.tree_util.tree_leaves(self.blocks[name]["bf16"])):
                 n = int(np.prod(shape, dtype=np.int64))
-                f32_to_bf16(master[off:off + n].reshape(shape), leaf)
+                _leaf_cast(master[off:off + n].reshape(shape), leaf)
                 off += n
 
     def flush(self):
@@ -314,7 +339,7 @@ class NVMeParamStore(HostParamStore):
                             self._meta[name],
                             jax.tree_util.tree_leaves(self.blocks[name]["bf16"])):
                         k = int(np.prod(shape, dtype=np.int64))
-                        f32_to_bf16(cat[off:off + k].reshape(shape), leaf)
+                        _leaf_cast(cat[off:off + k].reshape(shape), leaf)
                         off += k
             nz.close()
         self.t = int(meta["step"]) if load_optimizer_states else 0
@@ -345,9 +370,25 @@ class ParamStreamRunner:
         # through the per-layer vjp (see _build_fns)
         self._moe = getattr(getattr(model, "cfg", None), "num_experts", 0) > 0
         self._aux_coef = float(getattr(getattr(model, "cfg", None), "moe_aux_loss_coef", 0.0))
-        if jnp.dtype(compute_dtype) == jnp.float16:
-            raise NotImplementedError("offload_param streams bf16 blocks; fp16 loss-scaled "
-                                      "streaming is not supported (use bf16)")
+        # fp16 loss-scaled streaming (reference fp16 param swap,
+        # partitioned_param_swapper.py:36): fp16 compute copies + a host-side
+        # dynamic loss scaler — the tail vjp is seeded with the scale, every
+        # streamed grad is scale-scaled, and applies divide it back out
+        self._fp16 = jnp.dtype(compute_dtype) == jnp.float16
+        fp16_cfg = cfg.fp16
+        if self._fp16:
+            if fp16_cfg.loss_scale:  # static scale
+                self._scale = float(fp16_cfg.loss_scale)
+                self._scale_dynamic = False
+            else:
+                self._scale = float(2.0 ** fp16_cfg.initial_scale_power)
+                self._scale_dynamic = True
+            self._scale_window = int(fp16_cfg.loss_scale_window)
+            self._min_scale = float(fp16_cfg.min_loss_scale)
+            self._good_steps = 0
+        else:
+            self._scale = 1.0
+            self._scale_dynamic = False
 
         abstract = jax.eval_shape(model.init_params, self._rng)
         self.plan = model.stream_plan(abstract)
@@ -376,22 +417,26 @@ class ParamStreamRunner:
 
         off = cfg.zero_optimization.offload_param
         opt_cfg = cfg.optimizer
-        grad_dtype = ml_dtypes.bfloat16 if self.gas == 1 else np.float32
+        store_dtype = np.dtype(jnp.dtype(compute_dtype).name)  # bf16 or fp16 copies
+        grad_dtype = store_dtype if self.gas == 1 else np.float32
         if off.device == "nvme":
             if not off.nvme_path:
                 raise ValueError("offload_param.device='nvme' requires nvme_path")
             from ..swap_tensor.aio_config import get_aio_config
             self.store = NVMeParamStore(opt_cfg, nvme_path=off.nvme_path,
                                         aio_config=get_aio_config(cfg.raw_config),
-                                        grad_dtype=grad_dtype)
+                                        grad_dtype=grad_dtype, compute_dtype=store_dtype)
         else:
-            self.store = HostParamStore(opt_cfg, grad_dtype=grad_dtype)
+            self.store = HostParamStore(opt_cfg, grad_dtype=grad_dtype,
+                                        compute_dtype=store_dtype)
         self._grad_dtype = grad_dtype
 
         self._init_store()
         self._fns = {}
         self.global_steps = 0
         self._last_gnorm = 0.0
+        self._put_time = 0.0
+        self.last_phase_times = None
         tier = "NVMe" if off.device == "nvme" else "host DRAM"
         log_dist(f"ZeRO-Infinity param offload: {self.store.num_params():,} params resident "
                  f"on {tier} ({_nbytes_blocks(self.store):,} DRAM bytes), streamed per layer "
@@ -451,10 +496,18 @@ class ParamStreamRunner:
         return t
 
     def _put(self, host_tree, shardings):
-        return jax.device_put(host_tree, shardings)
+        import time as _time
+        t0 = _time.perf_counter()
+        out = jax.device_put(host_tree, shardings)
+        self._put_time += _time.perf_counter() - t0
+        return out
 
     def _put_layer(self, l):
-        return jax.device_put(self.store.bf16(f"layer{l:05d}"), self._shard_layer)
+        import time as _time
+        t0 = _time.perf_counter()
+        out = jax.device_put(self.store.bf16(f"layer{l:05d}"), self._shard_layer)
+        self._put_time += _time.perf_counter() - t0
+        return out
 
     # -- compiled pieces ----------------------------------------------------
     def _get(self, name, builder):
@@ -481,9 +534,10 @@ class ParamStreamRunner:
                 y, aux = model.stream_layer(lp, h, mask, return_aux=True)
                 return y.astype(cd), aux
 
-            def layer_bwd(lp, h, mask, g):
+            def layer_bwd(lp, h, mask, g, scale):
                 _, vjp = jax.vjp(lambda lp_, h_: layer_fwd(lp_, h_, mask), lp, h)
-                dlp, dh = vjp((g, jnp.asarray(aux_coef, jnp.float32)))
+                # the aux cotangent carries the same loss scale as g
+                dlp, dh = vjp((g, jnp.asarray(aux_coef, jnp.float32) * scale))
                 return dlp, dh
         else:
             def layer_fwd(lp, h, mask):
@@ -494,11 +548,13 @@ class ParamStreamRunner:
                 dlp, dh = vjp(g)
                 return dlp, dh
 
-        def tail_grad(tp, h, labels, valid):
+        def tail_grad(tp, h, labels, valid, scale):
             def f(tp_, h_):
                 return model.stream_tail_loss(tp_, h_, labels, valid, shift=shift)
             loss, vjp = jax.vjp(f, tp, h)
-            dtp, dh = vjp(jnp.ones((), loss.dtype))
+            # fp16: seed the backward with the loss scale so small grads
+            # survive the fp16 stream; applies divide it back out
+            dtp, dh = vjp(jnp.asarray(scale, loss.dtype))
             return loss, dtp, dh
 
         def embed_bwd(ep, ids, g):
@@ -517,10 +573,11 @@ class ParamStreamRunner:
         }
 
     # -- hot loop -----------------------------------------------------------
-    def _micro_grads(self, fns, ids, mask, labels, valid, grad_sink):
+    def _micro_grads(self, fns, ids, mask, labels, valid, grad_sink, scale=1.0):
         """One microbatch: streamed forward + backward; per-block grads are
         handed to ``grad_sink(name, grad_tree)`` as device arrays the moment
-        they exist (their host fetch overlaps the next block's compute)."""
+        they exist (their host fetch overlaps the next block's compute).
+        ``scale``: fp16 loss scale seeded into the tail vjp (1.0 for bf16)."""
         with self.mesh:
             ep = self._put(self.store.bf16("embed"), self._shard_embed)
             h = fns["embed_fwd"](ep, ids)
@@ -539,14 +596,19 @@ class ParamStreamRunner:
                     h = fns["layer_fwd"](lp, h, mask)
                 del lp
             tp = self._put(self._tail_store_tree(), self._shard_tail)
-            loss, dtp, dh = fns["tail_grad"](tp, h, labels, valid)
+            loss, dtp, dh = fns["tail_grad"](tp, h, labels, valid,
+                                             jnp.asarray(scale, jnp.float32))
             if self._moe:  # report CE + coef*aux like the fused engine
                 loss = loss + self._aux_coef * aux_total
             del tp, h
             grad_sink("tail", dtp)
             for l in reversed(range(self.L)):
                 lp = self._put_layer(l)
-                dlp, dh = fns["layer_bwd"](lp, acts.pop(), mask, dh)
+                if self._moe:
+                    dlp, dh = fns["layer_bwd"](lp, acts.pop(), mask, dh,
+                                               jnp.asarray(scale, jnp.float32))
+                else:
+                    dlp, dh = fns["layer_bwd"](lp, acts.pop(), mask, dh)
                 del lp
                 grad_sink(f"layer{l:05d}", dlp)
             dep = fns["embed_bwd"](ep, ids, dh)
@@ -604,11 +666,12 @@ class ParamStreamRunner:
         # keeps the reference's atomic whole-step skip.
         stream_apply = self.gas == 1 and isinstance(self.store, HostParamStore)
         lr = float(self.lr_schedule_fn(jnp.asarray(self.global_steps, jnp.float32)))
-        stream_coef = 1.0
+        scale = self._scale  # fp16 loss scale (1.0 for bf16)
+        stream_coef = 1.0 / scale
         if stream_apply and self.clip and self.clip > 0:
             prev = getattr(self, "_last_gnorm", None)
             if prev is not None and np.isfinite(prev) and prev > 0:
-                stream_coef = min(1.0, float(self.clip) / (prev + 1e-6))
+                stream_coef = min(1.0, float(self.clip) / (prev + 1e-6)) / scale
         sq_parts = {"v": 0.0}
         skipped_blocks = []
         if stream_apply:
@@ -651,18 +714,33 @@ class ParamStreamRunner:
                         accumulate(name, path, host)
             fetches.append(_TRANSFER_POOL.submit(fetch))
 
+        import time as _time
+        t_step0 = _time.perf_counter()
+        self._put_time = 0.0  # step-scoped: eval/generate puts must not leak in
         loss_sum = 0.0
+        t_drain = 0.0
         for i in range(self.gas):
             m = None if mask is None else self._shard_batch_arr(mask[i])
             loss = self._micro_grads(fns, self._shard_batch_arr(ids[i]), m,
                                      self._shard_batch_arr(labels_c[i]),
-                                     self._shard_batch_arr(valid[i]), sink)
+                                     self._shard_batch_arr(valid[i]), sink, scale=scale)
             loss_sum += float(loss)
             # drain before the next microbatch: fetches for the SAME slot
             # accumulate in place and must not race
+            t0 = _time.perf_counter()
             for f in fetches:
                 f.result()
+            t_drain += _time.perf_counter() - t0
             fetches.clear()
+        # per-phase breakdown (capacity-run evidence: how much of the step
+        # hides behind compute vs blocks on the host link): 'drain_s' is
+        # wall time BLOCKED waiting on grad fetches/applies that did not
+        # overlap; 'put_s' is host->device param-stream dispatch time
+        self.last_phase_times = {
+            "step_s": _time.perf_counter() - t_step0,
+            "drain_s": t_drain,
+            "put_s": self._put_time,
+        }
 
         sq_sum = sq_parts["v"]
         for slot in grads.values():
@@ -670,7 +748,7 @@ class ParamStreamRunner:
                 sq_sum += float(np.sum(np.square(np.asarray(g, np.float32))))
         gnorm_raw = float(np.sqrt(sq_sum)) if np.isfinite(sq_sum) else float("inf")
         overflow = not np.isfinite(gnorm_raw)
-        gnorm = gnorm_raw / self.gas
+        gnorm = gnorm_raw / self.gas / scale  # true-norm units
 
         if stream_apply:
             # layer blocks already applied in the sink; finish embed/tail
@@ -693,11 +771,12 @@ class ParamStreamRunner:
                                f"{skipped_blocks[:4]}{'...' if len(skipped_blocks) > 4 else ''}")
             self.global_steps += 1
             self._last_gnorm = gnorm
+            self._update_scaler(bool(skipped_blocks))
             return {"loss": loss_sum / self.gas, "grad_norm": gnorm, "lr": lr,
-                    "overflow": bool(skipped_blocks), "loss_scale": 1.0}
+                    "overflow": bool(skipped_blocks), "loss_scale": scale}
 
         if not overflow:
-            coef = 1.0 / self.gas
+            coef = 1.0 / self.gas / scale
             if self.clip and self.clip > 0:
                 coef *= min(1.0, self.clip / (gnorm + 1e-6))
             self.store.begin_step()
@@ -717,8 +796,24 @@ class ParamStreamRunner:
                 self.store.flush()
             self.global_steps += 1
         self._last_gnorm = gnorm
+        self._update_scaler(overflow)
         return {"loss": loss_sum / self.gas, "grad_norm": gnorm, "lr": lr,
-                "overflow": overflow, "loss_scale": 1.0}
+                "overflow": overflow, "loss_scale": scale}
+
+    def _update_scaler(self, overflow):
+        """Host-side dynamic loss scaler (reference DynamicLossScaler
+        semantics: halve on overflow, double after a clean window)."""
+        if not self._scale_dynamic:
+            return
+        if overflow:
+            self._scale = max(self._scale / 2.0, self._min_scale)
+            self._good_steps = 0
+            logger.warning(f"param offload fp16: overflow, loss scale -> {self._scale:g}")
+        else:
+            self._good_steps += 1
+            if self._good_steps >= self._scale_window:
+                self._scale *= 2.0
+                self._good_steps = 0
 
     def eval_batch(self, batch):
         ids = np.asarray(batch["input_ids"])
