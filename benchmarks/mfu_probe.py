@@ -1,0 +1,154 @@
+"""Training-step decomposition + knob sweep for the MFU plateau (VERDICT
+r5 item #2). Attributes the gpt2-large/-125m step into forward / backward /
+optimizer and sweeps the knobs most likely to move the needle (flash
+block sizes, CE chunking, microbatch).
+
+Run on the real chip:
+  python benchmarks/mfu_probe.py decompose [model] [micro_bs]
+  python benchmarks/mfu_probe.py blocks [model] [micro_bs]
+"""
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+
+def _fence(x):
+    import jax.numpy as jnp
+    return float(jnp.sum(jax.tree_util.tree_leaves(x)[0]) if not hasattr(x, "sum") else x.sum())
+
+
+def build(model_name, micro_bs, seq=1024, **model_over):
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.models import get_model
+    comm._state["mesh"] = None
+    model = get_model(model_name, remat_policy=None, scan_layers=False,
+                      attention_impl="flash", **model_over)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={"train_micro_batch_size_per_gpu": micro_bs,
+                "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.01}},
+                "bf16": {"enabled": True}, "gradient_clipping": 1.0,
+                "steps_per_print": 10**9})
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": rng.integers(0, model.cfg.vocab_size,
+                                       (1, engine.train_batch_size(), seq)).astype(np.int32)}
+    placed = engine._shard_batch(batch, leading_scan_dim=True)
+    return engine, model, placed, seq
+
+
+def marginal(fn, *args, reps=20):
+    import jax
+    y = fn(*args)
+    jax.block_until_ready(y)
+    _fence(y)
+
+    def t(n):
+        best = 1e9
+        for _ in range(2):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(n):
+                out = fn(*args)
+            _fence(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+    lo, hi = 2, 2 + reps
+    return (t(hi) - t(lo)) / (hi - lo)
+
+
+def decompose(model_name="gpt2-large", micro_bs=4):
+    import jax
+    import jax.numpy as jnp
+    engine, model, placed, seq = build(model_name, micro_bs)
+    state = engine.state
+    step_fn = engine._get("train_batch", engine._build_train_batch_fn)
+
+    ids = placed["input_ids"][0]
+
+    p_c = jax.tree_util.tree_map(lambda x: jnp.asarray(x, jnp.bfloat16), state.params)
+
+    fwd = jax.jit(lambda p, i: model.loss(p, {"input_ids": i}, None))
+    vg = jax.jit(lambda p, i: jax.value_and_grad(
+        lambda pp: model.loss(pp, {"input_ids": i}, None))(p)[0])
+
+    t_fwd = marginal(fwd, p_c, ids)
+    t_vg = marginal(vg, p_c, ids)
+
+    def full(state):
+        s2, m = step_fn(state, placed)
+        return m["loss"]
+    # full step mutates state; time without donation reuse issues by
+    # re-calling on the same state (state not donated here? it is — use the
+    # engine path instead)
+    t0 = time.perf_counter()
+    n = 20
+    with engine.mesh:
+        for _ in range(3):
+            state, m = step_fn(state, placed)
+        _fence(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = step_fn(state, placed)
+        _fence(m["loss"])
+    t_full = (time.perf_counter() - t0) / n
+
+    tok = micro_bs * seq
+    print(f"{model_name} bs{micro_bs}: fwd {t_fwd*1e3:.1f} ms | fwd+bwd {t_vg*1e3:.1f} ms "
+          f"| full step {t_full*1e3:.1f} ms", flush=True)
+    print(f"  bwd-only ~{(t_vg-t_fwd)*1e3:.1f} ms; opt+clip+glue ~{(t_full-t_vg)*1e3:.1f} ms; "
+          f"fwd:bwd ratio {(t_vg-t_fwd)/max(t_fwd,1e-9):.2f}", flush=True)
+
+
+def blocks(model_name="gpt2-large", micro_bs=4):
+    """Sweep flash-attention block shapes + CE chunk size on the full step."""
+    import jax
+    for bq, bkv in ((512, 512), (256, 512), (512, 1024), (1024, 512), (256, 256)):
+        try:
+            engine, model, placed, seq = build(model_name, micro_bs,
+                                               attention_block_q=bq, attention_block_kv=bkv)
+            step_fn = engine._get("train_batch", engine._build_train_batch_fn)
+            state = engine.state
+            with engine.mesh:
+                for _ in range(3):
+                    state, m = step_fn(state, placed)
+                _fence(m["loss"])
+                t0 = time.perf_counter()
+                for _ in range(15):
+                    state, m = step_fn(state, placed)
+                _fence(m["loss"])
+                dt = (time.perf_counter() - t0) / 15
+            print(f"block_q={bq} block_kv={bkv}: {dt*1e3:.1f} ms/step", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"block_q={bq} block_kv={bkv}: FAILED {type(e).__name__}: {e}", flush=True)
+    for chunk in (0, 2048, 4096, 8192):
+        try:
+            engine, model, placed, seq = build(model_name, micro_bs, ce_chunk_size=chunk)
+            step_fn = engine._get("train_batch", engine._build_train_batch_fn)
+            state = engine.state
+            with engine.mesh:
+                for _ in range(3):
+                    state, m = step_fn(state, placed)
+                _fence(m["loss"])
+                t0 = time.perf_counter()
+                for _ in range(15):
+                    state, m = step_fn(state, placed)
+                _fence(m["loss"])
+                dt = (time.perf_counter() - t0) / 15
+            print(f"ce_chunk={chunk}: {dt*1e3:.1f} ms/step", flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"ce_chunk={chunk}: FAILED {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    import jax  # noqa: F401
+    which = sys.argv[1] if len(sys.argv) > 1 else "decompose"
+    model = sys.argv[2] if len(sys.argv) > 2 else "gpt2-large"
+    mbs = int(sys.argv[3]) if len(sys.argv) > 3 else (4 if "large" in model else 16)
+    if which == "decompose":
+        decompose(model, mbs)
+    else:
+        blocks(model, mbs)

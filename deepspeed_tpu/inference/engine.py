@@ -424,11 +424,39 @@ class InferenceEngine:
 
         return jax.jit(generate, donate_argnums=(2, ))
 
+    def submit(self, input_ids, **kwargs):
+        """Pipelined generation: dispatch the compiled generate program and
+        return a handle WITHOUT fetching results — the next ``submit`` (or
+        any host work) overlaps this request's device execution and result
+        transfer. ``handle.result()`` returns what ``generate`` would.
+
+        Serving loops that fetch each request before dispatching the next
+        serialize on the host<->device round trip; this is the standard
+        continuous-serving fix (the reference's inference engine keeps the
+        stream busy the same way via CUDA streams)."""
+        buf, trim = self._generate_raw(input_ids, **kwargs)
+
+        class _Handle:
+            def result(self_h):
+                return trim(np.asarray(jax.device_get(buf)))
+        return _Handle()
+
     def generate(self, input_ids, max_new_tokens=64, do_sample=False, temperature=1.0, top_k=0,
                  top_p=1.0, eos_token_id=None, pad_token_id=0, seed=0):
         """Batched generation. ``input_ids``: list of token lists or (B, P)
         array. Returns a list of 1-D np arrays of *new* tokens per row
         (trimmed at ``eos_token_id``)."""
+        buf, trim = self._generate_raw(input_ids, max_new_tokens=max_new_tokens,
+                                       do_sample=do_sample, temperature=temperature,
+                                       top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
+                                       pad_token_id=pad_token_id, seed=seed)
+        return trim(np.asarray(jax.device_get(buf)))
+
+    def _generate_raw(self, input_ids, max_new_tokens=64, do_sample=False, temperature=1.0,
+                      top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=0, seed=0):
+        """Dispatch one generate; returns (device buf, trim(host_buf) ->
+        per-row new-token arrays). The KV cache returns to the pool
+        immediately (device-side refs; execution order serializes reuse)."""
         rows = [np.asarray(r, np.int32).reshape(-1) for r in input_ids]
         B = len(rows)
         lens = np.array([len(r) for r in rows], np.int32)
@@ -481,16 +509,19 @@ class InferenceEngine:
         self._cache_pool[(B, S)] = cache
         while len(self._cache_pool) > 2:  # bound HBM held by idle cache buckets
             self._cache_pool.pop(next(iter(self._cache_pool)))
-        buf = np.asarray(jax.device_get(buf))[:, :max_new_tokens]
-        out = []
-        for i in range(B):
-            row = buf[i]
-            if eos_token_id is not None:
-                hits = np.nonzero(row == eos_token_id)[0]
-                if hits.size:
-                    row = row[:hits[0] + 1]
-            out.append(row)
-        return out
+
+        def trim(host_buf):
+            host_buf = host_buf[:, :max_new_tokens]
+            out = []
+            for i in range(B):
+                row = host_buf[i]
+                if eos_token_id is not None:
+                    hits = np.nonzero(row == eos_token_id)[0]
+                    if hits.size:
+                        row = row[:hits[0] + 1]
+                out.append(row)
+            return out
+        return buf, trim
 
     def _init_cache(self, B, S):
         key = ("init_cache", B, S)

@@ -192,6 +192,17 @@ def test_long_uniform_prompt_flash_prefill(baseline):
     assert all((a == b).all() for a, b in zip(out_x, out_k))
 
 
+def test_submit_pipelined_matches_generate(baseline):
+    """submit() dispatches without fetching; results drained later equal
+    generate()'s, including cache-pool reuse across in-flight requests."""
+    params, out = baseline
+    eng = make_engine(params=params)
+    handles = [eng.submit(PROMPTS, max_new_tokens=8) for _ in range(3)]
+    for h in handles:
+        got = h.result()
+        assert all((a == b).all() for a, b in zip(out, got))
+
+
 def test_int8_weight_serving_matches_fp32(baseline):
     """dtype='int8' serving (host quantize + Pallas w8a16 matmuls + padded
     logits_q head) generates the same greedy tokens as the fp32 engine
