@@ -958,7 +958,7 @@ class MegatronPolicy(InjectionPolicy):
             qkv_b = g("attention.query_key_value.bias")
             wq, wk, wv = np.split(qkv_w, 3, axis=0)
             bq, bk, bv = np.split(qkv_b, 3)
-            return {
+            out = {
                 "attn_norm": {"scale": g("input_layernorm.weight"),
                               "bias": g("input_layernorm.bias")},
                 "mlp_norm": {"scale": g("post_attention_layernorm.weight"),
@@ -970,13 +970,39 @@ class MegatronPolicy(InjectionPolicy):
                     "o_proj": {"kernel": _heads_out(_t(g("attention.dense.weight")), nh, hd),
                                "bias": g("attention.dense.bias")},
                 },
-                "mlp": {
+            }
+            if cfg.num_experts > 0:
+                # Megatron-DeepSpeed MoE layer (reference
+                # containers/megatron_gpt_moe.py + moe/experts.py's
+                # ``deepspeed_experts`` module list): per-expert biased
+                # gelu FFNs + the TopKGate's ``wg`` projection
+                E = cfg.num_experts
+                pre = "mlp.deepspeed_moe.experts.deepspeed_experts"
+                out["moe"] = {
+                    "gate": _t(g("mlp.deepspeed_moe.gate.wg.weight")),
+                    "experts": {
+                        "up_proj": np.stack(
+                            [_t(g(f"{pre}.{e}.dense_h_to_4h.weight")) for e in range(E)]),
+                        "up_bias": np.stack(
+                            [g(f"{pre}.{e}.dense_h_to_4h.bias") for e in range(E)]),
+                        "down_proj": np.stack(
+                            [_t(g(f"{pre}.{e}.dense_4h_to_h.weight")) for e in range(E)]),
+                        "down_bias": np.stack(
+                            [g(f"{pre}.{e}.dense_4h_to_h.bias") for e in range(E)]),
+                        # declared by the batched Experts module; unused
+                        # by the gelu branch
+                        "gate_proj": np.zeros(
+                            (E, H, cfg.ffn_size), np.float32),
+                    },
+                }
+            else:
+                out["mlp"] = {
                     "up_proj": {"kernel": _t(g("mlp.dense_h_to_4h.weight")),
                                 "bias": g("mlp.dense_h_to_4h.bias")},
                     "down_proj": {"kernel": _t(g("mlp.dense_4h_to_h.weight")),
                                   "bias": g("mlp.dense_4h_to_h.bias")},
-                },
-            }
+                }
+            return out
 
         top = {
             "embed": {"embedding": self._resolve(get, "word_embeddings.weight")[:cfg.vocab_size]},

@@ -38,6 +38,7 @@ class Experts(nn.Module):
     dtype: any
     int8: bool = False
     int8_groups: int = 0  # scale-group SIZE (0 = default rule, 128)
+    use_bias: bool = False  # Megatron-style biased expert FFNs
 
     def _qparam(self, name, k, n):
         E = self.num_experts
@@ -67,6 +68,9 @@ class Experts(nn.Module):
             up_k = self.param("up_proj", init, (E, H, F), jnp.float32)
             down_k = self.param("down_proj", init, (E, F, H), jnp.float32)
             gk, uk, dk = (k.astype(self.dtype) for k in (gate_k, up_k, down_k))
+        if self.use_bias:  # Megatron-style biased expert FFNs
+            up_b = self.param("up_bias", nn.initializers.zeros, (E, F), jnp.float32)
+            down_b = self.param("down_bias", nn.initializers.zeros, (E, H), jnp.float32)
         if self.activation in ("swiglu", "geglu"):
             g = jnp.einsum("ech,ehf->ecf", x, gk)
             u = jnp.einsum("ech,ehf->ecf", x, uk)
@@ -74,8 +78,13 @@ class Experts(nn.Module):
             h = act * u
         else:
             h = jnp.einsum("ech,ehf->ecf", x, uk)
+            if self.use_bias:
+                h = h + up_b[:, None, :].astype(h.dtype)
             h = nn.gelu(h) if self.activation == "gelu" else nn.relu(h)
-        return jnp.einsum("ecf,efh->ech", h, dk)
+        out = jnp.einsum("ecf,efh->ech", h, dk)
+        if self.use_bias:
+            out = out + down_b[:, None, :].astype(out.dtype)
+        return out
 
 
 class MoE(nn.Module):
@@ -122,6 +131,7 @@ class MoE(nn.Module):
         expert_out = Experts(E, H, cfg.ffn_size, cfg.activation, cfg.dtype,
                              int8=getattr(cfg, "int8_weights", False),
                              int8_groups=getattr(cfg, "int8_group_size", 0),
+                             use_bias=getattr(cfg, "norm", "") == "layernorm",
                              name="experts")(expert_in)
         expert_out = _expert_constraint(expert_out, P(dist.EXPERT_AXIS, None, None))
         out = jnp.einsum("nec,ech->nh", combine.astype(cfg.dtype), expert_out)

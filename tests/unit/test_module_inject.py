@@ -115,6 +115,60 @@ def test_gptneo_generate_matches_hf():
     np.testing.assert_array_equal(got[:6], ref)
 
 
+def test_megatron_moe_conversion():
+    """Megatron-DeepSpeed MoE checkpoint names (reference
+    containers/megatron_gpt_moe.py; experts under
+    mlp.deepspeed_moe.experts.deepspeed_experts.N) convert into the batched
+    expert tree and the model serves."""
+    import jax
+    from deepspeed_tpu.models.transformer import CausalLMModel, TransformerConfig
+    from deepspeed_tpu.module_inject.policy import MegatronPolicy
+
+    H, V, E, F = 16, 64, 4, 32
+    cfg = TransformerConfig(vocab_size=V, hidden_size=H, num_layers=1, num_heads=4,
+                            max_seq_len=32, pos_embedding="learned", norm="layernorm",
+                            activation="gelu", tie_embeddings=True, num_experts=E,
+                            moe_top_k=2, intermediate_size=F, dtype=jnp.float32)
+    r = np.random.default_rng(5)
+    sd = {
+        "word_embeddings.weight": r.standard_normal((V, H)).astype(np.float32),
+        "position_embeddings.weight": r.standard_normal((32, H)).astype(np.float32),
+        "final_layernorm.weight": np.ones(H, np.float32),
+        "final_layernorm.bias": np.zeros(H, np.float32),
+        "layers.0.input_layernorm.weight": np.ones(H, np.float32),
+        "layers.0.input_layernorm.bias": np.zeros(H, np.float32),
+        "layers.0.post_attention_layernorm.weight": np.ones(H, np.float32),
+        "layers.0.post_attention_layernorm.bias": np.zeros(H, np.float32),
+        "layers.0.attention.query_key_value.weight":
+            r.standard_normal((3 * H, H)).astype(np.float32),
+        "layers.0.attention.query_key_value.bias":
+            r.standard_normal(3 * H).astype(np.float32),
+        "layers.0.attention.dense.weight": r.standard_normal((H, H)).astype(np.float32),
+        "layers.0.attention.dense.bias": r.standard_normal(H).astype(np.float32),
+        "layers.0.mlp.deepspeed_moe.gate.wg.weight":
+            r.standard_normal((E, H)).astype(np.float32),
+    }
+    for e in range(E):
+        p = f"layers.0.mlp.deepspeed_moe.experts.deepspeed_experts.{e}."
+        sd[p + "dense_h_to_4h.weight"] = r.standard_normal((F, H)).astype(np.float32)
+        sd[p + "dense_h_to_4h.bias"] = r.standard_normal(F).astype(np.float32)
+        sd[p + "dense_4h_to_h.weight"] = r.standard_normal((H, F)).astype(np.float32)
+        sd[p + "dense_4h_to_h.bias"] = r.standard_normal(H).astype(np.float32)
+
+    params = MegatronPolicy().convert(sd.__getitem__, cfg)
+    layer = params["layers"] if cfg.scan_layers else params["layer_0"]
+    experts = jax.tree_util.tree_map(lambda x: x[0], layer)["moe"]["experts"] \
+        if cfg.scan_layers else layer["moe"]["experts"]
+    assert experts["up_proj"].shape[-3:] == (E, H, F)
+    np.testing.assert_array_equal(
+        np.asarray(experts["up_proj"])[..., 1, :, :].reshape(H, F),
+        sd["layers.0.mlp.deepspeed_moe.experts.deepspeed_experts.1.dense_h_to_4h.weight"].T)
+    model = CausalLMModel(cfg)
+    ids = np.random.default_rng(6).integers(0, V, (2, 8)).astype(np.int32)
+    logits = model.apply(jax.tree_util.tree_map(jnp.asarray, params), jnp.asarray(ids))
+    assert np.isfinite(np.asarray(logits)).all()
+
+
 def test_injection_from_checkpoint_dir(tmp_path):
     cfg = transformers.LlamaConfig(vocab_size=128, hidden_size=32, intermediate_size=64,
                                    num_hidden_layers=2, num_attention_heads=4,
