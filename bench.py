@@ -18,9 +18,69 @@ it below 1% of the measurement.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+_HEADLINE = "gpt2-large(774M) train MFU (bf16, seq1024, bs4, fp32 Adam on-chip)"
+
+
+def _emit_skipped(reason, **extra):
+    """One JSON line marking the bench as skipped (never a raw traceback)."""
+    print(json.dumps({
+        "metric": _HEADLINE,
+        "value": 0.0,
+        "unit": "% MFU",
+        "vs_baseline": 0.0,
+        "skipped": True,
+        "reason": reason,
+        "extra": extra,
+    }))
+
+
+def _ensure_backend():
+    """Probe the accelerator backend with a real computation. On failure,
+    re-exec once with JAX_PLATFORMS=cpu (the failed backend init is cached
+    inside this process's jax) so the bench can record a structured skip
+    instead of dying with a raw JaxRuntimeError (BENCH_r05). Returns the
+    device list, or None when the bench should emit a skip and exit."""
+    import jax
+    cpu_retry = os.environ.get("_BENCH_CPU_RETRY") == "1"
+    try:
+        devices = jax.devices()
+        jax.block_until_ready(jax.numpy.zeros(()) + 1)
+    except Exception as e:  # noqa: BLE001 — any backend failure ends the same way
+        reason = f"backend init failed: {type(e).__name__}: {e}".splitlines()[0][:500]
+        if not cpu_retry:
+            env = dict(os.environ, JAX_PLATFORMS="cpu", _BENCH_CPU_RETRY="1",
+                       _BENCH_SKIP_REASON=reason)
+            os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+        _emit_skipped(os.environ.get("_BENCH_SKIP_REASON", reason)
+                      + f"; cpu fallback also failed: {reason}")
+        return None
+    if cpu_retry:
+        # TPU unavailable; the CPU fallback only proves the stack still runs
+        # (a 2-step tiny-model smoke) — its perf numbers would be meaningless
+        smoke_ok, smoke_err = True, None
+        try:
+            _run("tiny", micro_bs=1, steps=2, seq=64, attention_impl="xla")
+        except Exception as e:  # noqa: BLE001
+            smoke_ok, smoke_err = False, f"{type(e).__name__}: {e}"
+        _emit_skipped(os.environ.get("_BENCH_SKIP_REASON", "TPU backend unavailable")
+                      + "; retried on JAX_PLATFORMS=cpu",
+                      cpu_smoke_ok=smoke_ok,
+                      **({"cpu_smoke_error": smoke_err} if smoke_err else {}))
+        return None
+    return devices
+
+
+def _telemetry_cfg():
+    """Structured telemetry for bench runs: set BENCH_TELEMETRY=<dir> to get
+    telemetry.jsonl + trace.json alongside the printed JSON line (summarize
+    with tools/trace_summary.py)."""
+    path = os.environ.get("BENCH_TELEMETRY")
+    return {"enabled": True, "output_path": path} if path else {}
 
 
 def _mfu(cfg, tok_per_sec, seq, peak):
@@ -32,7 +92,7 @@ def _mfu(cfg, tok_per_sec, seq, peak):
     return flops_per_token * tok_per_sec / peak
 
 
-def _run(model_name, micro_bs, steps, seq=1024, **model_kwargs):
+def _run(model_name, micro_bs, steps, seq=1024, attention_impl="flash", **model_kwargs):
     import jax
     import deepspeed_tpu
     from deepspeed_tpu.comm import comm
@@ -42,7 +102,7 @@ def _run(model_name, micro_bs, steps, seq=1024, **model_kwargs):
     # fastest measured config for these sizes (sweep on v5e): unrolled
     # layers, no remat, Pallas flash attention in bhtd
     model = get_model(model_name, remat_policy=None, scan_layers=False,
-                      attention_impl="flash", **model_kwargs)
+                      attention_impl=attention_impl, **model_kwargs)
     cfg = model.cfg
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
@@ -52,6 +112,7 @@ def _run(model_name, micro_bs, steps, seq=1024, **model_kwargs):
             "bf16": {"enabled": True},
             "gradient_clipping": 1.0,
             "steps_per_print": 10**9,
+            "telemetry": _telemetry_cfg(),
         })
 
     rng = np.random.default_rng(0)
@@ -135,10 +196,12 @@ def _decode_bench(model_name="gpt2-large", bs=8, prompt=32, dtype="int8"):
 
 
 def main():
-    import jax
     from deepspeed_tpu.accelerator import get_accelerator
 
-    n_chips = len(jax.devices())
+    devices = _ensure_backend()
+    if devices is None:
+        return
+    n_chips = len(devices)
     peak = get_accelerator().peak_flops()
     seq = 1024
 

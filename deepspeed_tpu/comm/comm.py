@@ -331,12 +331,23 @@ def log_summary():
 
 def _record(op_name, tensor, group):
     cl = _state["comms_logger"]
+    from ..telemetry import get_sink
+    sink = get_sink()
+    if not ((cl is not None and cl.enabled) or (sink is not None and sink.enabled)):
+        return
+    try:
+        size = tensor.size * tensor.dtype.itemsize
+    except Exception:
+        size = 0
     if cl is not None and cl.enabled:
-        try:
-            size = tensor.size * tensor.dtype.itemsize
-        except Exception:
-            size = 0
         cl.append(op_name, str(group), size)
+    if sink is not None and sink.enabled:
+        # trace-time accounting (same contract as CommsLogger.append: per
+        # traced op, not per execution — see utils/comms_logging.py); the
+        # group is part of the counter name so TP vs DP traffic of the same
+        # op accumulates separately
+        gname = "_".join(group) if isinstance(group, (tuple, list)) else str(group)
+        sink.counter(f"comm/{op_name}/{gname}/bytes", size)
 
 
 def _axes(group):

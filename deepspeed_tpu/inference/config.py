@@ -69,6 +69,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     fused_decode_block = ConfigField(
         default=True, help="use the fused per-layer decode kernel (one pallas call per "
         "layer: qkv->attention->o->mlp) when the int8 serving config allows it")
+    telemetry = ConfigField(
+        default=dict, help="unified telemetry sink section (same keys as the training "
+        "config's 'telemetry': enabled/output_path/flush_interval/trace_format); an "
+        "already-installed global sink (e.g. the training engine's) takes precedence")
 
     def __init__(self, param_dict=None):
         super().__init__(param_dict)

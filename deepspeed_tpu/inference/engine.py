@@ -31,6 +31,7 @@ import numpy as np
 
 from ..comm import comm as dist
 from ..runtime.zero.sharding import ShardingPlanner
+from ..telemetry import TelemetrySink, get_sink, set_sink
 from ..utils.logging import logger, log_dist
 from .config import DeepSpeedInferenceConfig
 
@@ -119,6 +120,17 @@ class InferenceEngine:
         self.params = self._materialize_params(params)
         self._compiled = {}
         self._cache_pool = {}  # (B, S) -> reusable KV cache buffers
+        # telemetry: reuse an already-installed global sink (e.g. the
+        # training engine's, so train + serve share one event stream), else
+        # build one from this config's 'telemetry' section
+        self.telemetry = get_sink()
+        if self.telemetry is None or not self.telemetry.enabled:
+            if dict(cfg.telemetry or {}).get("enabled"):
+                self.telemetry = TelemetrySink(cfg.telemetry)
+                set_sink(self.telemetry)
+            elif self.telemetry is None:
+                self.telemetry = TelemetrySink(None)
+        self._inflight = 0  # submitted-not-yet-fetched requests
         log_dist(
             f"InferenceEngine ready: model dtype={jnp.dtype(self.model_config.dtype).name} "
             f"tp={self.mesh.shape[dist.TENSOR_AXIS]} kernel_inject={cfg.kernel_inject} "
@@ -434,23 +446,69 @@ class InferenceEngine:
         serialize on the host<->device round trip; this is the standard
         continuous-serving fix (the reference's inference engine keeps the
         stream busy the same way via CUDA streams)."""
+        tel = self.telemetry
+        t0 = tel.now() if tel.enabled else None
+        max_new = kwargs.get("max_new_tokens", 64)
         buf, trim = self._generate_raw(input_ids, **kwargs)
+        if t0 is not None:
+            self._inflight += 1
+            tel.gauge("inference/queue_depth", self._inflight)
+        eng = self
 
         class _Handle:
+            _accounted = False
+
+            def _settle(self_h):
+                if t0 is not None and not self_h._accounted:
+                    self_h._accounted = True
+                    eng._inflight -= 1
+                    tel.gauge("inference/queue_depth", eng._inflight)
+                    return True
+                return False
+
             def result(self_h):
-                return trim(np.asarray(jax.device_get(buf)))
+                out = trim(np.asarray(jax.device_get(buf)))
+                if self_h._settle():
+                    eng._record_decode(t0, out, max_new)
+                return out
+
+            def __del__(self_h):
+                # an abandoned handle (timeout/cancel without result()) must
+                # not inflate the queue-depth gauge forever
+                self_h._settle()
         return _Handle()
+
+    def _record_decode(self, t0, out, max_new_tokens):
+        """Decode telemetry for one finished request: a `generate` span, a
+        per-token-step latency histogram, and TTFT. The fused decode loop
+        makes every token of a request visible at once, so TTFT here equals
+        request completion latency (see benchmarks/OBSERVABILITY.md)."""
+        tel = self.telemetry
+        dur = tel.now() - t0
+        n_steps = max(1, max((len(r) for r in out), default=1))
+        tokens = int(sum(len(r) for r in out))
+        tel.record_span("generate", t0, dur,
+                        attrs={"batch": len(out), "tokens": tokens,
+                               "max_new_tokens": int(max_new_tokens)})
+        tel.histogram("decode/latency_ms_per_token", dur * 1e3 / n_steps)
+        tel.histogram("decode/ttft_ms", dur * 1e3)
+        tel.counter("decode/tokens", tokens)
 
     def generate(self, input_ids, max_new_tokens=64, do_sample=False, temperature=1.0, top_k=0,
                  top_p=1.0, eos_token_id=None, pad_token_id=0, seed=0):
         """Batched generation. ``input_ids``: list of token lists or (B, P)
         array. Returns a list of 1-D np arrays of *new* tokens per row
         (trimmed at ``eos_token_id``)."""
+        tel = self.telemetry
+        t0 = tel.now() if tel.enabled else None
         buf, trim = self._generate_raw(input_ids, max_new_tokens=max_new_tokens,
                                        do_sample=do_sample, temperature=temperature,
                                        top_k=top_k, top_p=top_p, eos_token_id=eos_token_id,
                                        pad_token_id=pad_token_id, seed=seed)
-        return trim(np.asarray(jax.device_get(buf)))
+        out = trim(np.asarray(jax.device_get(buf)))
+        if t0 is not None:
+            self._record_decode(t0, out, max_new_tokens)
+        return out
 
     def _generate_raw(self, input_ids, max_new_tokens=64, do_sample=False, temperature=1.0,
                       top_k=0, top_p=1.0, eos_token_id=None, pad_token_id=0, seed=0):

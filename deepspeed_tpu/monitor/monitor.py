@@ -43,10 +43,15 @@ class csvMonitor(Monitor):
     def write_events(self, event_list):
         if not self.enabled or jax.process_index() != 0:
             return
+        # group by metric so each csv file is opened once per call, not once
+        # per event
+        by_file = {}
         for event in event_list:
             name, value, step = event[0], event[1], event[2]
-            with open(self._file(name), "a") as f:
-                f.write(f"{step},{value}\n")
+            by_file.setdefault(self._file(name), []).append(f"{step},{value}\n")
+        for fname, lines in by_file.items():
+            with open(fname, "a") as f:
+                f.writelines(lines)
 
 
 class TensorBoardMonitor(Monitor):

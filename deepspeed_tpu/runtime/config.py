@@ -83,6 +83,22 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     output_file = ConfigField(default=None)
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """TPU extension: the unified telemetry sink (``deepspeed_tpu/telemetry``).
+
+    Default-off; when enabled the engine writes a structured event stream
+    (``telemetry.jsonl``) and a Perfetto-loadable ``trace.json`` under
+    ``output_path``. See ``benchmarks/OBSERVABILITY.md``.
+    """
+    enabled = ConfigField(default=False)
+    output_path = ConfigField(default="telemetry")
+    # events buffered before an automatic flush (spans + gauges; counters
+    # and histograms snapshot at each flush)
+    flush_interval = ConfigField(default=100)
+    # "chrome" writes trace.json in Chrome-trace format; "none" disables it
+    trace_format = ConfigField(default="chrome")
+
+
 class CheckpointConfig(DeepSpeedConfigModel):
     tag_validation = ConfigField(default="Warn")
     load_universal = ConfigField(default=False)
@@ -143,6 +159,7 @@ class DeepSpeedConfig(DeepSpeedConfigModel):
     csv_monitor = ConfigField(default=MonitorBackendConfig)
     wandb = ConfigField(default=MonitorBackendConfig)
     comms_logger = ConfigField(default=CommsLoggerConfig)
+    telemetry = ConfigField(default=TelemetryConfig)
     flops_profiler = ConfigField(default=FlopsProfilerConfig)
 
     wall_clock_breakdown = ConfigField(default=False)

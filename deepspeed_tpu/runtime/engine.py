@@ -18,6 +18,7 @@ is a pure loss function ``loss_fn(params, batch, rng) -> loss`` (or
 
 import json
 import os
+import time
 from typing import Any, NamedTuple
 
 import jax
@@ -30,7 +31,7 @@ from ..accelerator import get_accelerator
 from ..comm import comm as dist
 from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, ThroughputTimer, NoopTimer, FORWARD_GLOBAL_TIMER,
-                           BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER)
+                           BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER, _device_sync)
 from .config import DeepSpeedConfig
 from .constants import (ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER, CPU_ADAM_OPTIMIZER,
                         ADAGRAD_OPTIMIZER, LAMB_OPTIMIZER, SGD_OPTIMIZER, LION_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
@@ -280,13 +281,26 @@ class DeepSpeedEngine:
         self._data_sampler = None
         self._pending_sampler_state = None  # checkpoint state loaded pre-sampler
 
-        # ---- timers / monitor / io ---------------------------------------
+        # ---- timers / monitor / telemetry / io ---------------------------
         self.wall_clock_breakdown = self._config.wall_clock_breakdown
-        self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
-        self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size(),
-                                          steps_per_output=self._config.steps_per_print)
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(self._config)
+        from ..telemetry import TelemetrySink, set_sink
+        # the sink is the single reporting call site: gauges fan out to the
+        # monitor backends; file output (JSONL + trace.json) only when the
+        # 'telemetry' config section is enabled (default-off)
+        self.telemetry = TelemetrySink(self._config.telemetry, monitor=self.monitor)
+        if self.telemetry.enabled:
+            set_sink(self.telemetry)
+        self._trace_spans = self.wall_clock_breakdown or self.telemetry.enabled
+        self.timers = SynchronizedWallClockTimer() if self._trace_spans else NoopTimer()
+        self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size(),
+                                          steps_per_output=self._config.steps_per_print)
+        self._step_flops = None  # XLA cost-analysis FLOPs of one optimizer step
+        self._last_step_dur = None  # seconds, measured around the last step
+        self._grad_sync_bytes_cached = None
+        self._fwd_since_step = 0  # facade micro-steps since the last step()
+        self._facade_t0 = None
 
         self.training_dataloader = self.deepspeed_io(training_data) if training_data is not None else None
 
@@ -950,6 +964,8 @@ class DeepSpeedEngine:
         cfg = self._config
         gas = cfg.gradient_accumulation_steps
         fn = self._get("offload_grads", self._build_offload_grad_fn)
+        if self.telemetry.enabled and self._step_flops is None:
+            self._step_flops = self._cost_analysis_flops(fn, self.state, stacked)
         with self.mesh:
             grads, dev_metrics = fn(self.state, stacked)
 
@@ -970,9 +986,11 @@ class DeepSpeedEngine:
             clip = cfg.gradient_clipping
             if clip and clip > 0:
                 coef *= min(1.0, clip / (gnorm + 1e-6))
-            host_grads = self.host_opt.fetch_grads(grads)
-            self.host_opt.step(host_grads, coef, lr)
-            new_params = self.host_opt.compute_params(self.compute_dtype, self.state_shardings.params)
+            with self.telemetry.span("offload"):
+                host_grads = self.host_opt.fetch_grads(grads)
+                self.host_opt.step(host_grads, coef, lr)
+                new_params = self.host_opt.compute_params(self.compute_dtype,
+                                                          self.state_shardings.params)
         else:
             new_params = self.state.params
 
@@ -1038,6 +1056,10 @@ class DeepSpeedEngine:
         dp = [a for a in (dist.EXPERT_AXIS, dist.DATA_AXIS) if self.mesh.shape[a] > 1]
         seq_on = self.mesh.shape[dist.SEQ_AXIS] > 1
         batch_dim = 1 if leading_scan_dim else 0
+        if self.telemetry.enabled:
+            self.telemetry.counter(
+                "comm/host_to_device/bytes",
+                int(sum(np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(batch))))
 
         def place(x):
             x = np.asarray(x)
@@ -1090,6 +1112,7 @@ class DeepSpeedEngine:
                 batch = jax.tree_util.tree_map(lambda *xs: np.concatenate([np.asarray(x) for x in xs]),
                                                *micro)
             self.tput_timer.start()
+            t0 = time.perf_counter() if self.telemetry.enabled else None
             metrics = self.param_stream.train_batch(batch)
             # overflow steps don't advance the runner's (or Adam's) counter;
             # mirror it so checkpoints and the lr schedule stay in sync
@@ -1098,6 +1121,11 @@ class DeepSpeedEngine:
             self.micro_steps += gas
             self._last_metrics = metrics
             self.tput_timer.stop(global_step=True)
+            if t0 is not None:
+                dur = time.perf_counter() - t0
+                self._last_step_dur = dur
+                self.telemetry.record_span("step", self.telemetry.now() - dur, dur,
+                                           attrs={"path": "param_stream"})
             self._report(metrics)
             if self.lr_scheduler is not None:
                 self.lr_scheduler.last_batch_iteration = self.global_steps
@@ -1170,13 +1198,25 @@ class DeepSpeedEngine:
             after = sig() if sig else len(self.module._active())
             if after != before:
                 self._compiled.clear()
+        t0 = time.perf_counter() if self.telemetry.enabled else None
         if self.offload_optimizer:
             metrics = self._offload_train_batch(stacked)
         else:
             fn = self._get("train_batch", self._build_onebit_train_fn if self._onebit
                            else self._build_train_batch_fn)
+            if self.telemetry.enabled and self._step_flops is None:
+                self._step_flops = self._cost_analysis_flops(fn, self.state, stacked)
             with self.mesh:
                 self.state, metrics = fn(self.state, stacked)
+        if t0 is not None:
+            _device_sync()
+            dur = time.perf_counter() - t0
+            self._last_step_dur = dur
+            self.telemetry.record_span(
+                "step", self.telemetry.now() - dur, dur,
+                attrs={"path": "offload" if self.offload_optimizer else "fused",
+                       "micro_batches": gas})
+            self._emit_step_counters()
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
         self.micro_steps += gas
@@ -1205,15 +1245,33 @@ class DeepSpeedEngine:
             raise RuntimeError("the forward/backward/step facade is not supported with 1-bit "
                                "optimizers (the compressed exchange lives inside the fused "
                                "shard_map step); use train_batch()")
-        if self.wall_clock_breakdown:
+        tel = self.telemetry
+        if self._trace_spans:
             self.timers(FORWARD_GLOBAL_TIMER).start()
         self._ensure_grad_acc()
         batch = self._shard_batch(batch)
         fn = self._get("micro", self._build_micro_fn)
+        if tel.enabled:
+            if self._fwd_since_step == 0:
+                self._facade_t0 = time.perf_counter()
+            self._fwd_since_step += 1
+            if self._step_flops is None:
+                # one micro-step's cost × gas ≈ the full step (the apply
+                # half is negligible next to fwd+bwd)
+                self._step_flops = (self._cost_analysis_flops(fn, self.state, batch)
+                                    * self.gradient_accumulation_steps())
         with self.mesh:
             self.state, loss = fn(self.state, batch)
-        if self.wall_clock_breakdown:
-            self.timers(FORWARD_GLOBAL_TIMER).stop()
+        if self._trace_spans:
+            t = self.timers(FORWARD_GLOBAL_TIMER)
+            # NOT synchronized: a fence here would serialize host and device
+            # every micro-step (the facade's whole point is async dispatch);
+            # on async backends this span measures dispatch + compile, and
+            # the fenced step() span carries the true device time
+            t.stop()
+            if tel.enabled:
+                dur = t.last()
+                tel.record_span("fwd", tel.now() - dur, dur)
         # keep the device array: no host sync per micro-step
         self._pending_batches.append(loss)
         return loss
@@ -1221,9 +1279,16 @@ class DeepSpeedEngine:
     def backward(self, loss=None, allreduce_gradients=True, retain_graph=False):
         """Facade: gradients were produced in forward(); this marks the
         micro-step boundary (reference engine.py:1765)."""
-        if self.wall_clock_breakdown:
-            self.timers(BACKWARD_GLOBAL_TIMER).start()
-            self.timers(BACKWARD_GLOBAL_TIMER).stop()
+        if self._trace_spans:
+            t = self.timers(BACKWARD_GLOBAL_TIMER)
+            t.start()
+            t.stop()
+            if self.telemetry.enabled:
+                # gradients were already produced inside forward() (fwd+bwd
+                # fuse under XLA); the span marks the micro-step boundary
+                dur = t.last()
+                self.telemetry.record_span("bwd", self.telemetry.now() - dur, dur,
+                                           attrs={"fused_into": "fwd"})
         self.micro_steps += 1
         return loss
 
@@ -1235,7 +1300,7 @@ class DeepSpeedEngine:
         engine.py:1961)."""
         if int(self.state.micro_step) < self.gradient_accumulation_steps():
             return  # not at boundary yet
-        if self.wall_clock_breakdown:
+        if self._trace_spans:
             self.timers(STEP_GLOBAL_TIMER).start()
         pending = self._pending_batches[-self.gradient_accumulation_steps():]
         loss_mean = (jnp.mean(jnp.stack([jnp.asarray(p, jnp.float32) for p in pending]))
@@ -1247,8 +1312,21 @@ class DeepSpeedEngine:
         self.global_samples += self.train_batch_size()
         self._pending_batches = []
         self._last_metrics = metrics
-        if self.wall_clock_breakdown:
-            self.timers(STEP_GLOBAL_TIMER).stop()
+        if self._trace_spans:
+            t = self.timers(STEP_GLOBAL_TIMER)
+            t.stop(synchronize=self.telemetry.enabled)
+            if self.telemetry.enabled:
+                dur = t.last()
+                self.telemetry.record_span("step", self.telemetry.now() - dur, dur,
+                                           attrs={"path": "facade"})
+        if self.telemetry.enabled:
+            if self._facade_t0 is not None:
+                # fwd..step wall time of the whole accumulation window — the
+                # denominator the MFU gauge uses on the facade path
+                self._last_step_dur = time.perf_counter() - self._facade_t0
+            self._facade_t0 = None
+            self._fwd_since_step = 0
+            self._emit_step_counters()
         self._report(metrics)
         if self.lr_scheduler is not None:
             self.lr_scheduler.last_batch_iteration = self.global_steps
@@ -1343,6 +1421,64 @@ class DeepSpeedEngine:
             with open(fp.output_file, "w") as f:
                 _json.dump(stats, f, indent=2)
 
+    def _cost_analysis_flops(self, fn, *args):
+        """XLA cost-analysis FLOPs of one compiled step, read from the
+        lowering (trace-only; see ``profiling/flops_profiler``). 0.0 when
+        unavailable — the MFU gauge is then simply not emitted."""
+        try:
+            from ..profiling.flops_profiler.profiler import profile_compiled
+            with self.mesh:
+                return float(profile_compiled(fn, *args).get("flops", 0.0))
+        except Exception as e:
+            logger.warning(f"telemetry: step cost analysis unavailable ({e})")
+            return 0.0
+
+    def _emit_step_counters(self):
+        """Per-step analytic comms accounting. XLA inserts the gradient
+        collectives inside the compiled step (no host-observable per-op
+        hook, by design — see comm/comm.py), so DP gradient-sync traffic is
+        accounted from the sharding plan: ring all-reduce moves
+        2(n-1)/n × fp32 grad bytes per step."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        if self._grad_sync_bytes_cached is None:
+            n = self.dp_world_size()
+            param_bytes = 4 * sum(int(np.prod(x.shape))
+                                  for x in jax.tree_util.tree_leaves(self.state.params))
+            self._grad_sync_bytes_cached = (int(param_bytes * 2 * (n - 1) / n)
+                                            if n > 1 else 0)
+        if self._grad_sync_bytes_cached:
+            tel.counter("comm/grad_sync/bytes", self._grad_sync_bytes_cached,
+                        attrs={"estimate": "ring_all_reduce", "dp": self.dp_world_size()})
+
+    def _interval_gauges(self):
+        """MFU + device/host memory watermark gauges for one logging
+        interval, as (name, value, step) tuples. Step axis is
+        ``global_samples`` — the same axis the Train/Samples scalars use, so
+        monitor backends see one monotonic step stream."""
+        out = []
+        if self._step_flops and self._last_step_dur:
+            peak = get_accelerator().peak_flops()
+            if peak:
+                mfu = self._step_flops / self._last_step_dur / (peak * jax.device_count())
+                out.append(("mfu", mfu, self.global_samples))
+        try:
+            stats = get_accelerator().memory_stats() or {}
+        except Exception:
+            stats = {}
+        if "bytes_in_use" in stats:
+            out.append(("memory/device_bytes_in_use", stats["bytes_in_use"], self.global_samples))
+        if "peak_bytes_in_use" in stats:
+            out.append(("memory/device_peak_bytes", stats["peak_bytes_in_use"], self.global_samples))
+        try:
+            import psutil
+            out.append(("memory/host_rss_bytes", psutil.Process().memory_info().rss,
+                        self.global_samples))
+        except Exception:
+            pass
+        return out
+
     def _report(self, metrics):
         if self.global_steps % self.steps_per_print() == 0:
             # single host sync per print interval
@@ -1354,13 +1490,19 @@ class DeepSpeedEngine:
             if self.fp16_enabled():
                 msg += f" loss_scale={scale:g}"
             log_dist(msg, [0])
-            self.monitor.write_events([("Train/Samples/train_loss", loss, self.global_samples),
-                                       ("Train/Samples/lr", lr, self.global_samples)])
+            # single reporting call site: ONE batched sink call per interval
+            # fans these out to the tb/wandb/csv monitor backends (one
+            # write_events/flush) and, when telemetry is enabled, into the
+            # JSONL/trace as gauges
+            tel = self.telemetry
+            scalars = [("Train/Samples/train_loss", loss, self.global_samples),
+                       ("Train/Samples/lr", lr, self.global_samples)]
             if self.fp16_enabled():
-                self.monitor.write_events([("Train/Samples/loss_scale", scale, self.global_samples)])
-
-    def _write_monitor(self):
-        pass
+                scalars.append(("Train/Samples/loss_scale", scale, self.global_samples))
+            if tel.enabled:
+                scalars.append(("Train/Samples/grad_norm", norm, self.global_samples))
+                scalars.extend(self._interval_gauges())
+            tel.gauges(scalars)
 
     # ------------------------------------------------------------------ data
     def deepspeed_io(self, dataset, batch_size=None, route=None, data_sampler=None, collate_fn=None, num_local_io_workers=None):

@@ -1,0 +1,8 @@
+"""Unified telemetry subsystem (structured spans, gauges, counters,
+histograms with JSONL + Perfetto/Chrome-trace export).
+
+See ``benchmarks/OBSERVABILITY.md`` for the config keys, the event schema,
+and how to open the exported trace in Perfetto.
+"""
+
+from .sink import TelemetrySink, get_sink, set_sink  # noqa: F401

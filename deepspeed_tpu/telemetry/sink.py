@@ -1,0 +1,323 @@
+"""Unified telemetry sink.
+
+One structured event bus for everything the ROADMAP's perf work needs to
+measure: the engine's step spans and MFU/memory gauges, the comm layer's
+byte/count accounting, and the inference engine's decode latency
+distributions all report here instead of as ad-hoc ``log_dist`` strings.
+
+Event model (four typed producers):
+
+- **span**   — a named wall-clock interval (``ts``/``dur`` seconds relative
+  to sink start) with free-form ``attrs``; written one JSONL line per span.
+- **gauge**  — a point-in-time scalar (loss, lr, mfu, HBM watermark); written
+  immediately and *also* fanned out to the configured :class:`MonitorMaster`
+  so tb/wandb/csv backends keep receiving the same scalars with no duplicated
+  call sites.
+- **counter**— a monotonically accumulating (count, total) pair (comm bytes,
+  ops). Snapshots are written at every flush with cumulative semantics.
+- **histogram** — a value distribution (per-token decode latency); summary
+  lines (count/sum/min/max/p50/p95/p99) are written at every flush.
+
+Exports:
+
+- ``<output_path>/telemetry.jsonl`` — machine-consumable event stream
+  (one JSON object per line; see ``benchmarks/OBSERVABILITY.md``).
+- ``<output_path>/trace.json`` — Chrome-trace/Perfetto ``traceEvents``
+  (spans as ``ph:"X"`` complete events in microseconds, gauges and counter
+  snapshots as ``ph:"C"`` counter samples). Rewritten atomically at every
+  flush so a crashed run still leaves a loadable trace.
+
+The sink is rank-0-gated (``jax.process_index() != 0`` disables file output)
+and default-off: with ``telemetry.enabled`` false no files are written and
+producers take the early-return path. Timestamps come from
+``time.perf_counter`` (monotonic) against a base captured at construction.
+"""
+
+import atexit
+import json
+import os
+import threading
+import time
+
+# cap on retained per-histogram observations and chrome-trace events; beyond
+# it new spans still reach the JSONL but the in-memory trace stops growing
+_TRACE_EVENT_CAP = 200_000
+_HIST_SAMPLE_CAP = 100_000
+
+_active_sink = None
+
+
+def set_sink(sink):
+    """Install ``sink`` as the process-global telemetry sink (consulted by
+    producers that have no engine handle, e.g. ``comm._record``)."""
+    global _active_sink
+    _active_sink = sink
+
+
+def get_sink():
+    """The process-global sink, or None when no telemetry-enabled engine has
+    been constructed."""
+    return _active_sink
+
+
+def _cfg_get(config, key, default):
+    if config is None:
+        return default
+    if isinstance(config, dict):
+        return config.get(key, default)
+    return getattr(config, key, default)
+
+
+def _percentile(ordered, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not ordered:
+        return 0.0
+    idx = max(0, min(len(ordered) - 1, int(round(q * (len(ordered) - 1)))))
+    return float(ordered[idx])
+
+
+class _Span:
+    """Context manager recording one span into the sink on exit."""
+
+    __slots__ = ("_sink", "name", "attrs", "_t0")
+
+    def __init__(self, sink, name, attrs):
+        self._sink = sink
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        self._t0 = self._sink.now()
+        return self
+
+    def __exit__(self, *exc):
+        self._sink.record_span(self.name, self._t0, self._sink.now() - self._t0, self.attrs)
+        return False
+
+
+class _NullSpan:
+    """Reusable no-op span for the disabled path (zero allocation per call)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class TelemetrySink:
+    """Buffered, typed event sink with JSONL + Chrome-trace export.
+
+    ``config`` is a ``TelemetryConfig`` (``runtime/config.py``), a plain dict
+    with the same keys, or None (disabled). ``monitor`` is an optional
+    :class:`MonitorMaster`; gauges fan out to it even when file output is
+    disabled, which is what lets the engine keep exactly one reporting call
+    site for scalars.
+    """
+
+    def __init__(self, config=None, monitor=None):
+        enabled = bool(_cfg_get(config, "enabled", False))
+        if enabled:
+            try:
+                import jax
+                enabled = jax.process_index() == 0
+            except Exception:
+                pass  # no jax backend: single-process tooling context, keep on
+        self.enabled = enabled
+        self.output_path = str(_cfg_get(config, "output_path", "telemetry") or "telemetry")
+        self.flush_interval = max(1, int(_cfg_get(config, "flush_interval", 100) or 100))
+        self.trace_format = str(_cfg_get(config, "trace_format", "chrome") or "chrome")
+        self._monitor = monitor
+        self._lock = threading.RLock()
+        self._buffer = []        # pending JSONL event dicts
+        self._trace_events = []  # retained chrome-trace events
+        self._counters = {}      # name -> [count, total, attrs]
+        self._hists = {}         # name -> sorted-on-demand observation list
+        self._dropped_trace_events = 0
+        self._t0 = time.perf_counter()
+        self.started_at = time.time()
+        self._closed = False
+        self._last_trace_write = None  # throttle full-file trace rewrites
+        if self.enabled:
+            os.makedirs(self.output_path, exist_ok=True)
+            self.jsonl_path = os.path.join(self.output_path, "telemetry.jsonl")
+            self.trace_path = os.path.join(self.output_path, "trace.json")
+            with open(self.jsonl_path, "w") as f:
+                f.write(json.dumps({"type": "meta", "ts": 0.0, "started_at": self.started_at,
+                                    "version": 1}) + "\n")
+            atexit.register(self.close)
+        else:
+            self.jsonl_path = None
+            self.trace_path = None
+
+    # ------------------------------------------------------------------ time
+    def now(self):
+        """Seconds since sink construction (monotonic)."""
+        return time.perf_counter() - self._t0
+
+    # ------------------------------------------------------------------ producers
+    def span(self, name, **attrs):
+        """Context manager timing a named span; no-op when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, attrs or None)
+
+    def record_span(self, name, start, dur, attrs=None):
+        """Record an already-measured interval (``start``/``dur`` seconds on
+        the sink clock — see :meth:`now`)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._push({"type": "span", "name": name, "ts": round(start, 6),
+                        "dur": round(dur, 6), **({"attrs": attrs} if attrs else {})})
+            self._push_trace({"name": name, "cat": "span", "ph": "X", "pid": 0, "tid": 0,
+                              "ts": round(start * 1e6, 1), "dur": round(dur * 1e6, 1),
+                              **({"args": attrs} if attrs else {})})
+            self._maybe_flush()
+
+    def gauge(self, name, value, step=None, attrs=None):
+        """Point-in-time scalar; also fans out to the monitor backends when
+        ``step`` is given (step-less gauges like queue depth stay out of the
+        monitor stream — tb/wandb need a monotonic step axis)."""
+        self.gauges([(name, value, step)], attrs=attrs)
+
+    def gauges(self, events, attrs=None):
+        """Batch form of :meth:`gauge`: ``events`` is a list of
+        ``(name, value, step)``. All step-ful events reach the monitor in a
+        single ``write_events`` call (one backend flush per interval, not
+        one per scalar)."""
+        if self._monitor is not None and getattr(self._monitor, "enabled", False):
+            stepped = [(name, float(value), int(step))
+                       for name, value, step in events if step is not None]
+            if stepped:
+                self._monitor.write_events(stepped)
+        if not self.enabled:
+            return
+        with self._lock:
+            ts = self.now()
+            for name, value, step in events:
+                event = {"type": "gauge", "name": name, "value": float(value),
+                         "ts": round(ts, 6)}
+                if step is not None:
+                    event["step"] = int(step)
+                if attrs:
+                    event["attrs"] = attrs
+                self._push(event)
+                self._push_trace({"name": name, "cat": "gauge", "ph": "C", "pid": 0,
+                                  "ts": round(ts * 1e6, 1), "args": {"value": float(value)}})
+            self._maybe_flush()
+
+    def counter(self, name, value=1, attrs=None):
+        """Accumulate into a cumulative (count, total) counter; snapshots are
+        emitted at flush time."""
+        if not self.enabled:
+            return
+        with self._lock:
+            entry = self._counters.setdefault(name, [0, 0, attrs])
+            entry[0] += 1
+            entry[1] += value
+
+    def histogram(self, name, value, attrs=None):
+        """Record one observation into a named distribution; summary lines
+        (p50/p95/p99) are emitted at flush time."""
+        if not self.enabled:
+            return
+        with self._lock:
+            obs = self._hists.setdefault(name, [])
+            if len(obs) < _HIST_SAMPLE_CAP:
+                obs.append(float(value))
+
+    # ------------------------------------------------------------------ output
+    def _push(self, event):
+        self._buffer.append(event)
+
+    def _push_trace(self, event):
+        if len(self._trace_events) < _TRACE_EVENT_CAP:
+            self._trace_events.append(event)
+        else:
+            self._dropped_trace_events += 1
+
+    def _maybe_flush(self):
+        if len(self._buffer) >= self.flush_interval:
+            self.flush()
+
+    def _snapshot_events(self):
+        """Counter + histogram snapshot lines for this flush."""
+        ts = round(self.now(), 6)
+        out = []
+        for name, (count, total, attrs) in self._counters.items():
+            out.append({"type": "counter", "name": name, "count": count, "total": total,
+                        "ts": ts, **({"attrs": attrs} if attrs else {})})
+            self._push_trace({"name": name, "cat": "counter", "ph": "C", "pid": 0,
+                              "ts": round(ts * 1e6, 1), "args": {"value": total}})
+        for name, obs in self._hists.items():
+            ordered = sorted(obs)
+            out.append({"type": "histogram", "name": name, "count": len(ordered),
+                        "sum": round(sum(ordered), 6),
+                        "min": ordered[0] if ordered else 0.0,
+                        "max": ordered[-1] if ordered else 0.0,
+                        "p50": _percentile(ordered, 0.50),
+                        "p95": _percentile(ordered, 0.95),
+                        "p99": _percentile(ordered, 0.99),
+                        "ts": ts})
+        return out
+
+    def flush(self):
+        """Append buffered events + counter/histogram snapshots to the JSONL
+        and rewrite ``trace.json`` (atomic) in Chrome-trace format."""
+        if not self.enabled:
+            return
+        with self._lock:
+            lines = self._buffer
+            self._buffer = []
+            lines = lines + self._snapshot_events()
+            if lines:
+                with open(self.jsonl_path, "a") as f:
+                    for event in lines:
+                        f.write(json.dumps(event) + "\n")
+            self._write_trace()
+
+    # rewriting the whole trace file is O(retained events); auto-flushes
+    # only pay it every _TRACE_WRITE_PERIOD_S, close() always does
+    _TRACE_WRITE_PERIOD_S = 30.0
+
+    def _write_trace(self, force=False):
+        if self.trace_format != "chrome":
+            return
+        now = time.perf_counter()
+        if (not force and self._last_trace_write is not None
+                and now - self._last_trace_write < self._TRACE_WRITE_PERIOD_S):
+            return
+        self._last_trace_write = now
+        meta = [{"ph": "M", "name": "process_name", "pid": 0,
+                 "args": {"name": "deepspeed_tpu"}}]
+        if self._dropped_trace_events:
+            meta.append({"ph": "M", "name": "dropped_events", "pid": 0,
+                         "args": {"count": self._dropped_trace_events}})
+        tmp = self.trace_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"traceEvents": meta + self._trace_events,
+                       "displayTimeUnit": "ms"}, f)
+        os.replace(tmp, self.trace_path)
+
+    def close(self):
+        """Final flush (trace rewrite forced), then disable the sink so
+        later producer calls are no-ops instead of silently-unflushable
+        buffered events. Idempotent (also registered via atexit)."""
+        if self._closed or not self.enabled:
+            return
+        with self._lock:
+            self.flush()
+            self._write_trace(force=True)
+            self._closed = True
+            self.enabled = False
+
+    # ------------------------------------------------------------------ introspection
+    def counter_total(self, name):
+        entry = self._counters.get(name)
+        return entry[1] if entry else 0

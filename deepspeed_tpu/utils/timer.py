@@ -104,6 +104,10 @@ class Interval:
             return 0.0
         return 1000.0 * sum(self._intervals) / len(self._intervals)
 
+    def last(self):
+        """Most recently recorded interval, in seconds (0.0 when empty)."""
+        return self._intervals[-1] if self._intervals else 0.0
+
 
 class SynchronizedWallClockTimer:
     """Registry of named :class:`Interval` stopwatches."""
@@ -231,18 +235,21 @@ class ThroughputTimer:
         self.step_elapsed_time += span
         if global_step:
             if report_speed and self.global_step_count % self.steps_per_output == 0:
+                avg = self.avg_samples_per_sec()
                 self.logging(
                     f"throughput: epoch {self.epoch_count} micro {self.micro_step_count} "
                     f"global {self.global_step_count} | "
                     f"{self.batch_size / self.step_elapsed_time:.1f} samples/s now, "
-                    f"{self.avg_samples_per_sec():.1f} avg")
+                    + (f"{avg:.1f} avg" if avg > 0 else "avg pending warm-up"))
             self.step_elapsed_time = 0.0
 
     def avg_samples_per_sec(self):
+        """Average post-warm-up throughput in samples/sec; 0.0 until the
+        first post-warm-up step completes (previously float("-inf"))."""
         measured_steps = self.global_step_count - self.start_step
         if measured_steps > 0 and self.total_elapsed_time > 0:
             return self.batch_size * measured_steps / self.total_elapsed_time
-        return float("-inf")
+        return 0.0
 
 
 def trim_mean(data, trim_percent):

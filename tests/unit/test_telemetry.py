@@ -1,0 +1,284 @@
+"""Unified telemetry subsystem tests.
+
+Covers the sink itself (typed events, JSONL + Chrome-trace export,
+disabled-by-default behavior), the engine/inference producers (the ISSUE's
+acceptance smoke: a short train loop + one generate() yields fwd/bwd/step
+spans, an mfu gauge, comm counters and a decode-latency histogram), the
+trace_summary CLI, and the satellite fixes (ThroughputTimer warm-up,
+csvMonitor file grouping).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.telemetry import TelemetrySink, get_sink, set_sink
+
+from .simple_model import SimpleModel, random_batch
+
+HIDDEN = 32
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _reset_sink():
+    yield
+    set_sink(None)
+
+
+def read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def tel_config(tmp_path, **over):
+    cfg = {"enabled": True, "output_path": str(tmp_path / "tel"), "flush_interval": 4}
+    cfg.update(over)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# sink unit tests
+# ---------------------------------------------------------------------------
+def test_sink_event_types_and_exports(tmp_path):
+    sink = TelemetrySink(tel_config(tmp_path))
+    with sink.span("phase_a", tag="x"):
+        pass
+    sink.record_span("phase_b", start=1.0, dur=0.5)
+    sink.gauge("g", 3.5, step=7)
+    sink.counter("c/bytes", 100)
+    sink.counter("c/bytes", 50)
+    for v in (1.0, 2.0, 3.0, 4.0):
+        sink.histogram("h", v)
+    sink.close()
+
+    events = read_jsonl(sink.jsonl_path)
+    by_type = {}
+    for ev in events:
+        by_type.setdefault(ev["type"], []).append(ev)
+    names = {ev["name"] for ev in by_type["span"]}
+    assert {"phase_a", "phase_b"} <= names
+    span_b = next(ev for ev in by_type["span"] if ev["name"] == "phase_b")
+    assert span_b["ts"] == 1.0 and span_b["dur"] == 0.5
+    gauge = next(ev for ev in by_type["gauge"] if ev["name"] == "g")
+    assert gauge["value"] == 3.5 and gauge["step"] == 7
+    counter = [ev for ev in by_type["counter"] if ev["name"] == "c/bytes"][-1]
+    assert counter["count"] == 2 and counter["total"] == 150
+    hist = [ev for ev in by_type["histogram"] if ev["name"] == "h"][-1]
+    assert hist["count"] == 4 and hist["min"] == 1.0 and hist["max"] == 4.0
+    assert hist["p50"] in (2.0, 3.0)
+
+    trace = json.load(open(sink.trace_path))
+    assert isinstance(trace["traceEvents"], list)
+    spans = [ev for ev in trace["traceEvents"] if ev.get("ph") == "X"]
+    assert spans, "no complete events in trace"
+    for ev in spans:
+        assert {"name", "ph", "ts", "dur", "pid"} <= set(ev)
+    # counters/gauges show up as counter samples
+    assert any(ev.get("ph") == "C" for ev in trace["traceEvents"])
+
+
+def test_sink_disabled_is_inert(tmp_path):
+    sink = TelemetrySink({"enabled": False, "output_path": str(tmp_path / "tel")})
+    with sink.span("s"):
+        pass
+    sink.gauge("g", 1.0)
+    sink.counter("c", 1)
+    sink.histogram("h", 1.0)
+    sink.flush()
+    sink.close()
+    assert not (tmp_path / "tel").exists()
+
+
+def test_sink_cumulative_counters_across_flushes(tmp_path):
+    sink = TelemetrySink(tel_config(tmp_path, flush_interval=10**6))
+    sink.counter("c", 1)
+    sink.flush()
+    sink.counter("c", 2)
+    sink.flush()
+    snapshots = [ev for ev in read_jsonl(sink.jsonl_path)
+                 if ev["type"] == "counter" and ev["name"] == "c"]
+    assert [s["total"] for s in snapshots] == [1, 3]
+
+
+def test_gauges_fan_out_to_monitor_when_telemetry_disabled(tmp_path):
+    """MonitorMaster stays a consumer of the same scalars with telemetry off."""
+    class FakeMonitor:
+        enabled = True
+
+        def __init__(self):
+            self.events = []
+
+        def write_events(self, event_list):
+            self.events.extend(event_list)
+
+    monitor = FakeMonitor()
+    sink = TelemetrySink({"enabled": False}, monitor=monitor)
+    sink.gauge("Train/Samples/train_loss", 0.25, step=16)
+    assert monitor.events == [("Train/Samples/train_loss", 0.25, 16)]
+
+
+# ---------------------------------------------------------------------------
+# engine + inference producers (the ISSUE acceptance smoke)
+# ---------------------------------------------------------------------------
+def _smoke_train_and_generate(tmp_path):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 1,
+        "telemetry": tel_config(tmp_path),
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=HIDDEN),
+                                               config=cfg, rng_seed=0)
+    gas = engine.gradient_accumulation_steps()
+    micro = engine.train_micro_batch_size_per_gpu() * engine.dp_world_size()
+    for i in range(2):  # facade path: fwd/bwd/step spans
+        batch = random_batch(engine.train_batch_size(), HIDDEN, seed=i)
+        for g in range(gas):
+            mb = {k: v[g * micro:(g + 1) * micro] for k, v in batch.items()}
+            engine.backward(engine.forward(mb))
+        engine.step()
+    engine.train_batch(batch=random_batch(engine.train_batch_size(), HIDDEN, seed=9))
+
+    # one generate() through an inference engine sharing the global sink
+    comm._state["mesh"] = None
+    inf = deepspeed_tpu.init_inference("tiny", config={"dtype": "float32"})
+    assert inf.telemetry is engine.telemetry
+    inf.generate([[5, 6, 7, 8], [9, 10]], max_new_tokens=4)
+    engine.telemetry.close()
+    return engine
+
+
+def test_acceptance_smoke_jsonl_and_trace(tmp_path):
+    engine = _smoke_train_and_generate(tmp_path)
+    events = read_jsonl(engine.telemetry.jsonl_path)
+
+    span_names = [ev["name"] for ev in events if ev["type"] == "span"]
+    for required in ("fwd", "bwd", "step"):
+        assert span_names.count(required) >= 1, f"missing {required} span"
+    assert "generate" in span_names
+
+    gauges = {ev["name"] for ev in events if ev["type"] == "gauge"}
+    assert "mfu" in gauges
+    mfu_values = [ev["value"] for ev in events
+                  if ev["type"] == "gauge" and ev["name"] == "mfu"]
+    assert all(v > 0 for v in mfu_values)
+    assert "memory/device_bytes_in_use" in gauges or "memory/host_rss_bytes" in gauges
+
+    counters = {ev["name"] for ev in events if ev["type"] == "counter"}
+    assert any(name.startswith("comm/") and name.endswith("/bytes")
+               for name in counters), f"no comm counter in {counters}"
+
+    hists = {ev["name"] for ev in events if ev["type"] == "histogram"}
+    assert "decode/latency_ms_per_token" in hists
+
+    trace = json.load(open(engine.telemetry.trace_path))
+    complete = [ev for ev in trace["traceEvents"] if ev.get("ph") == "X"]
+    assert {ev["name"] for ev in complete} >= {"fwd", "bwd", "step", "generate"}
+    for ev in complete:
+        assert isinstance(ev["ts"], (int, float)) and isinstance(ev["dur"], (int, float))
+
+
+def test_telemetry_disabled_writes_no_files(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    cfg = {
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 1,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=HIDDEN),
+                                               config=cfg, rng_seed=0)
+    engine.train_batch(batch=random_batch(engine.train_batch_size(), HIDDEN))
+    assert not engine.telemetry.enabled
+    assert get_sink() is None
+    assert not os.path.exists("telemetry")
+
+
+def test_comm_record_routes_to_sink(tmp_path):
+    sink = TelemetrySink(tel_config(tmp_path))
+    set_sink(sink)
+    tensor = np.zeros((8, 4), np.float32)
+    comm._record("all_reduce", tensor, ("data", ))
+    comm._record("all_reduce", tensor, ("data", ))
+    comm._record("all_reduce", tensor, ("tensor", ))
+    # per-(op, group) accounting: TP and DP traffic accumulate separately
+    assert sink.counter_total("comm/all_reduce/data/bytes") == 2 * tensor.nbytes
+    assert sink.counter_total("comm/all_reduce/tensor/bytes") == tensor.nbytes
+
+
+# ---------------------------------------------------------------------------
+# trace_summary CLI
+# ---------------------------------------------------------------------------
+def test_trace_summary_cli(tmp_path):
+    sink = TelemetrySink(tel_config(tmp_path))
+    for dur in (0.010, 0.020, 0.030):
+        sink.record_span("step", start=0.0, dur=dur)
+    sink.gauge("mfu", 0.42, step=3)
+    sink.counter("comm/grad_sync/bytes", 1 << 20)
+    sink.histogram("decode/latency_ms_per_token", 1.5)
+    sink.close()
+    tool = os.path.join(REPO_ROOT, "tools", "trace_summary.py")
+    proc = subprocess.run([sys.executable, tool, sink.jsonl_path],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    out = proc.stdout
+    assert "step" in out and "mfu (last): 0.42" in out
+    assert "total comm bytes" in out and "decode/latency_ms_per_token" in out
+
+
+def test_trace_summary_cli_empty_input(tmp_path):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    tool = os.path.join(REPO_ROOT, "tools", "trace_summary.py")
+    proc = subprocess.run([sys.executable, tool, str(empty)],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions
+# ---------------------------------------------------------------------------
+def test_avg_samples_per_sec_before_warmup():
+    """Regression: returned float('-inf') before the first post-warm-up step."""
+    from deepspeed_tpu.utils.timer import ThroughputTimer
+    logged = []
+    timer = ThroughputTimer(batch_size=4, start_step=2, steps_per_output=1,
+                            logging_fn=logged.append)
+    assert timer.avg_samples_per_sec() == 0.0
+    for _ in range(4):
+        timer.start()
+        timer.stop(global_step=True)
+    assert timer.avg_samples_per_sec() > 0.0
+    # the logging call site must never have printed -inf
+    assert logged and not any("-inf" in msg for msg in logged)
+
+
+def test_csv_monitor_groups_writes_per_file(tmp_path, monkeypatch):
+    from deepspeed_tpu.monitor.monitor import csvMonitor
+    from deepspeed_tpu.runtime.config import MonitorBackendConfig
+    cfg = MonitorBackendConfig({"enabled": True, "output_path": str(tmp_path),
+                                "job_name": "job"})
+    monitor = csvMonitor(cfg)
+
+    opens = []
+    real_open = open
+
+    def counting_open(file, *args, **kwargs):
+        if str(file).startswith(str(tmp_path)):
+            opens.append(str(file))
+        return real_open(file, *args, **kwargs)
+
+    monkeypatch.setattr("builtins.open", counting_open)
+    monitor.write_events([("Train/loss", 0.5, 1), ("Train/loss", 0.4, 2),
+                          ("Train/lr", 1e-3, 1), ("Train/loss", 0.3, 3)])
+    # one open per distinct metric file, not one per event
+    assert len(opens) == 2
+    loss_file = [p for p in opens if "loss" in p][0]
+    assert real_open(loss_file).read() == "1,0.5\n2,0.4\n3,0.3\n"

@@ -1,0 +1,127 @@
+#!/usr/bin/env python3
+"""Step-time breakdown from a telemetry JSONL.
+
+Reads the ``telemetry.jsonl`` event stream written by
+``deepspeed_tpu.telemetry.TelemetrySink`` and prints a per-span latency
+table (count / p50 / p95 / total), the latest MFU and memory gauges, the
+cumulative comm-byte counters, and any histogram summaries (e.g. decode
+latency). Stdlib-only on purpose: runnable in tier-1 CI and on a laptop
+against a trace scp'd off a pod.
+
+Usage:
+    python tools/trace_summary.py <telemetry.jsonl>
+
+Event schema: see benchmarks/OBSERVABILITY.md.
+"""
+
+import json
+import sys
+from collections import OrderedDict
+
+
+def _percentile(ordered, q):
+    """Nearest-rank percentile of an already-sorted list."""
+    if not ordered:
+        return 0.0
+    idx = max(0, min(len(ordered) - 1, int(round(q * (len(ordered) - 1)))))
+    return float(ordered[idx])
+
+
+def load_events(path):
+    events = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"# skipping unparseable line {lineno}", file=sys.stderr)
+    return events
+
+
+def summarize(events):
+    """Aggregate a telemetry event list into a summary dict."""
+    spans = OrderedDict()   # name -> [durs...]
+    gauges = OrderedDict()  # name -> last value
+    counters = OrderedDict()  # name -> (count, total) — cumulative, keep last
+    hists = OrderedDict()   # name -> last summary line
+    for ev in events:
+        kind = ev.get("type")
+        name = ev.get("name")
+        if kind == "span":
+            spans.setdefault(name, []).append(float(ev.get("dur", 0.0)))
+        elif kind == "gauge":
+            gauges[name] = ev.get("value")
+        elif kind == "counter":
+            counters[name] = (int(ev.get("count", 0)), int(ev.get("total", 0)))
+        elif kind == "histogram":
+            hists[name] = {k: ev.get(k) for k in
+                           ("count", "sum", "min", "max", "p50", "p95", "p99")}
+    span_stats = OrderedDict()
+    for name, durs in spans.items():
+        ordered = sorted(durs)
+        span_stats[name] = {
+            "count": len(durs),
+            "p50_ms": _percentile(ordered, 0.50) * 1e3,
+            "p95_ms": _percentile(ordered, 0.95) * 1e3,
+            "total_s": sum(durs),
+        }
+    comm_bytes = sum(total for name, (_, total) in counters.items()
+                     if name.startswith("comm/") and name.endswith("/bytes"))
+    return {"spans": span_stats, "gauges": gauges, "counters": counters,
+            "histograms": hists, "total_comm_bytes": comm_bytes}
+
+
+def _human_bytes(n):
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if value < 1024 or unit == "TiB":
+            return f"{value:.1f} {unit}" if unit != "B" else f"{int(value)} B"
+        value /= 1024
+
+
+def format_summary(summary):
+    lines = []
+    if summary["spans"]:
+        lines.append(f"{'span':<28s} {'count':>6s} {'p50 ms':>10s} {'p95 ms':>10s} {'total s':>9s}")
+        for name, s in summary["spans"].items():
+            lines.append(f"{name:<28s} {s['count']:>6d} {s['p50_ms']:>10.2f} "
+                         f"{s['p95_ms']:>10.2f} {s['total_s']:>9.3f}")
+    else:
+        lines.append("no spans recorded")
+    if "mfu" in summary["gauges"]:
+        lines.append(f"\nmfu (last): {summary['gauges']['mfu']:.4g}")
+    mem = {k: v for k, v in summary["gauges"].items() if k.startswith("memory/")}
+    for name, value in mem.items():
+        lines.append(f"{name} (last): {_human_bytes(value)}")
+    if summary["counters"]:
+        lines.append("\ncounters (cumulative):")
+        for name, (count, total) in summary["counters"].items():
+            shown = _human_bytes(total) if name.endswith("/bytes") else str(total)
+            lines.append(f"  {name:<34s} total={shown:<12s} events={count}")
+        lines.append(f"total comm bytes: {_human_bytes(summary['total_comm_bytes'])}")
+    if summary["histograms"]:
+        lines.append("\nhistograms:")
+        for name, h in summary["histograms"].items():
+            lines.append(f"  {name:<34s} n={h['count']:<6d} p50={h['p50']:.3f} "
+                         f"p95={h['p95']:.3f} p99={h['p99']:.3f} max={h['max']:.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    events = load_events(argv[0])
+    if not events:
+        print(f"no telemetry events in {argv[0]}", file=sys.stderr)
+        return 1
+    print(format_summary(summarize(events)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
