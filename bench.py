@@ -24,6 +24,7 @@ import time
 import numpy as np
 
 _HEADLINE = "gpt2-large(774M) train MFU (bf16, seq1024, bs4, fp32 Adam on-chip)"
+_UNIT = "% MFU"
 
 
 def _emit_skipped(reason, **extra):
@@ -31,7 +32,7 @@ def _emit_skipped(reason, **extra):
     print(json.dumps({
         "metric": _HEADLINE,
         "value": 0.0,
-        "unit": "% MFU",
+        "unit": _UNIT,
         "vs_baseline": 0.0,
         "skipped": True,
         "reason": reason,
@@ -55,7 +56,8 @@ def _ensure_backend():
         if not cpu_retry:
             env = dict(os.environ, JAX_PLATFORMS="cpu", _BENCH_CPU_RETRY="1",
                        _BENCH_SKIP_REASON=reason)
-            os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)] + sys.argv[1:], env)
         _emit_skipped(os.environ.get("_BENCH_SKIP_REASON", reason)
                       + f"; cpu fallback also failed: {reason}")
         return None
@@ -195,6 +197,143 @@ def _decode_bench(model_name="gpt2-large", bs=8, prompt=32, dtype="int8"):
     }
 
 
+def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_requests=32,
+                   max_new=64, arrival_rate=None, seed=0, max_prompt=192,
+                   kernel_inject=True, steps_per_sync=4):
+    """Serving-mode benchmark: a Poisson-arrival mixed-length request stream
+    through the continuous-batching scheduler vs the same stream served by
+    sequential ``generate()`` calls (the pre-scheduler serving loop).
+
+    ``arrival_rate``: mean requests/sec for the Poisson process; None =
+    open-loop saturation (all requests queued at t=0 — the concurrency
+    sweep's high end). Reports aggregate decode tokens/sec, TTFT p50/p95,
+    and mean slot occupancy, per concurrency level."""
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm as _comm
+    rng = np.random.default_rng(seed)
+    # mixed prompt lengths spanning prefill buckets
+    prompt_lens = rng.integers(8, max_prompt, n_requests)
+    prompts = [rng.integers(0, 50257, n).astype(np.int32) for n in prompt_lens]
+    gaps = (rng.exponential(1.0 / arrival_rate, n_requests) if arrival_rate
+            else np.zeros(n_requests))
+
+    def make(continuous):
+        _comm._state["mesh"] = None
+        cfg = {"dtype": dtype, "max_out_tokens": 512, "kernel_inject": kernel_inject,
+               "continuous_batching": {"enabled": continuous, "num_slots": num_slots,
+                                       "steps_per_sync": steps_per_sync}}
+        return deepspeed_tpu.init_inference(model_name, config=cfg)
+
+    results = {}
+    # --- scheduler path, per concurrency level -------------------------------
+    for slots in sorted({1, max(2, num_slots // 2), num_slots}):
+        eng = make(True)
+        sched = eng.scheduler(num_slots=slots)
+        # warm ALL compiled programs the stream will hit (one prefill per
+        # bucket + the decode step), mirroring the sequential baseline's
+        # warm pass — otherwise bucket compiles land in the timed region
+        from deepspeed_tpu.inference.scheduler import _bucket_len
+        warm_buckets = sorted({_bucket_len(n, sched.prefill_bucket, sched.max_len)
+                               for n in prompt_lens})
+        for wb in warm_buckets:
+            warm_len = min(wb, sched.max_len - 2 * sched.steps_per_sync)
+            # budget 2: token 0 comes from prefill, token 1 forces one
+            # decode multi-step so the decode program compiles here too
+            sched.submit(np.ones(warm_len, np.int32), max_new_tokens=2).result()
+        ttfts = []
+        occ = []  # sampled after EVERY step, arrival phase included
+        t0 = time.perf_counter()
+        handles = []
+        arrival = 0.0
+        for gap, p in zip(gaps, prompts):
+            arrival += gap
+            if gap:
+                # drive the loop while waiting out the absolute arrival time
+                while time.perf_counter() < t0 + arrival:
+                    stepped = sched.step()
+                    occ.append(sched.cache.occupancy())
+                    if not stepped:
+                        time.sleep(max(0.0, t0 + arrival - time.perf_counter()))
+                        break
+            handles.append((time.perf_counter(), sched.submit(p, max_new_tokens=max_new)))
+        while any(not h.done for _, h in handles):
+            sched.step()
+            occ.append(sched.cache.occupancy())
+        dt = time.perf_counter() - t0
+        toks = sum(len(h.result()) for _, h in handles)
+        for ts, h in handles:
+            req = h._req
+            if req.first_token_ts is not None:
+                ttfts.append((req.first_token_ts - req.submit_ts) * 1e3)
+        ttfts.sort()
+        results[f"slots{slots}"] = {
+            "tokens_per_sec": round(toks / dt, 1),
+            "ttft_ms_p50": round(ttfts[len(ttfts) // 2], 1) if ttfts else None,
+            "ttft_ms_p95": round(ttfts[int(0.95 * (len(ttfts) - 1))], 1) if ttfts else None,
+            "mean_slot_occupancy": round(float(np.mean(occ)), 3) if occ else 0.0,
+        }
+    # --- sequential generate() baseline (same stream, one request at a time,
+    # honoring the same arrival schedule so rate-limited runs compare like
+    # for like). Two passes: the cold pass pays one whole-decode-loop
+    # compile per distinct prompt shape (the static-batch pathology the
+    # scheduler removes); the warm pass is the fair steady-state comparison.
+    eng = make(False)
+    seq = {}
+    for label in ("sequential_generate_cold", "sequential_generate"):
+        t0 = time.perf_counter()
+        toks = 0
+        arrival = 0.0
+        for gap, p in zip(gaps, prompts):
+            arrival += gap
+            wait = t0 + arrival - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            out = eng.generate([p], max_new_tokens=max_new)
+            toks += sum(len(r) for r in out)
+        seq[label] = {"tokens_per_sec": round(toks / (time.perf_counter() - t0), 1)}
+    results.update(seq)
+    best = max(v["tokens_per_sec"] for k, v in results.items() if k.startswith("slots"))
+    results["speedup_vs_sequential"] = round(
+        best / results["sequential_generate"]["tokens_per_sec"], 3)
+    return results
+
+
+def serving_main():
+    """`python bench.py serving`: one BENCH_SERVING JSON line (graceful
+    structured skip on backend failure, like the training bench)."""
+    global _HEADLINE, _UNIT
+    model = os.environ.get("BENCH_SERVING_MODEL", "gpt2-large")
+    dtype = os.environ.get("BENCH_SERVING_DTYPE", "int8")
+    _HEADLINE = f"serving: continuous-batching aggregate decode tokens/sec ({model} {dtype})"
+    _UNIT = "tokens/sec"
+    if _ensure_backend() is None:
+        return
+    try:
+        # env knobs so the bench is smoke-testable on a CPU box (tiny model)
+        res = _serving_bench(
+            model_name=model,
+            dtype=dtype,
+            n_requests=int(os.environ.get("BENCH_SERVING_REQUESTS", "32")),
+            max_new=int(os.environ.get("BENCH_SERVING_MAX_NEW", "64")),
+            max_prompt=int(os.environ.get("BENCH_SERVING_MAX_PROMPT", "192")),
+            kernel_inject=os.environ.get("BENCH_SERVING_KERNEL_INJECT", "1") != "0",
+            steps_per_sync=int(os.environ.get("BENCH_SERVING_STEPS", "4")),
+            arrival_rate=float(os.environ["BENCH_SERVING_RATE"])
+            if os.environ.get("BENCH_SERVING_RATE") else None)
+    except Exception as e:  # noqa: BLE001 — a failed leg must yield structured JSON
+        _emit_skipped(f"serving bench failed: {type(e).__name__}: {e}".splitlines()[0][:500])
+        return
+    best_key = max((k for k in res if k.startswith("slots")),
+                   key=lambda k: res[k]["tokens_per_sec"])
+    print(json.dumps({
+        "metric": _HEADLINE,
+        "value": res[best_key]["tokens_per_sec"],
+        "unit": _UNIT,
+        "vs_baseline": res["speedup_vs_sequential"],
+        "extra": res,
+    }))
+
+
 def main():
     from deepspeed_tpu.accelerator import get_accelerator
 
@@ -284,4 +423,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "serving":
+        serving_main()
+    else:
+        main()

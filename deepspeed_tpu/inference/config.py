@@ -44,6 +44,29 @@ class MoEInferenceConfig(DeepSpeedConfigModel):
     type = ConfigField(default="standard")
 
 
+class ContinuousBatchingConfig(DeepSpeedConfigModel):
+    """Continuous-batching serving path (``inference/scheduler.py``):
+    iteration-level admission into a fixed slot-pool KV cache. When enabled,
+    ``submit()`` routes through the shared :class:`DecodeScheduler` instead
+    of dispatching a per-shape static-batch program."""
+
+    enabled = ConfigField(default=False)
+    num_slots = ConfigField(default=8, help="decode batch = KV pool slots; the one "
+                            "shape XLA compiles the decode step against")
+    max_len = ConfigField(default=None, help="per-slot KV rows; default "
+                          "min(model max_seq_len, max_out_tokens)")
+    prefill_bucket = ConfigField(default=64, help="prompt lengths round up to "
+                                 "powers of two from this floor (bounds prefill "
+                                 "compile count at ~log2(max_len/bucket))")
+    collect_logits = ConfigField(default=False, help="also return per-step logits "
+                                 "(debug/parity testing; fetches (slots, V) per token)")
+    steps_per_sync = ConfigField(default=4, help="decode steps per host round trip "
+                                 "(multi-step scheduling, vLLM --num-scheduler-steps): "
+                                 "amortizes dispatch/fetch K-fold; admission/eviction "
+                                 "granularity becomes K tokens; results identical for "
+                                 "any K (sampling keys use absolute step indices)")
+
+
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     """Reference ``inference/config.py`` key parity."""
 
@@ -73,6 +96,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
         default=dict, help="unified telemetry sink section (same keys as the training "
         "config's 'telemetry': enabled/output_path/flush_interval/trace_format); an "
         "already-installed global sink (e.g. the training engine's) takes precedence")
+    continuous_batching = ConfigField(
+        default=ContinuousBatchingConfig, aliases=("serving", ),
+        help="continuous-batching scheduler section (slot-pool paged KV cache; "
+        "see benchmarks/SERVING.md)")
 
     def __init__(self, param_dict=None):
         super().__init__(param_dict)

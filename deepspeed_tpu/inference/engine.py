@@ -131,6 +131,7 @@ class InferenceEngine:
             elif self.telemetry is None:
                 self.telemetry = TelemetrySink(None)
         self._inflight = 0  # submitted-not-yet-fetched requests
+        self._scheduler = None  # lazily-built continuous-batching scheduler
         log_dist(
             f"InferenceEngine ready: model dtype={jnp.dtype(self.model_config.dtype).name} "
             f"tp={self.mesh.shape[dist.TENSOR_AXIS]} kernel_inject={cfg.kernel_inject} "
@@ -436,16 +437,41 @@ class InferenceEngine:
 
         return jax.jit(generate, donate_argnums=(2, ))
 
-    def submit(self, input_ids, **kwargs):
-        """Pipelined generation: dispatch the compiled generate program and
-        return a handle WITHOUT fetching results — the next ``submit`` (or
-        any host work) overlaps this request's device execution and result
-        transfer. ``handle.result()`` returns what ``generate`` would.
+    def scheduler(self, **overrides):
+        """The engine's continuous-batching :class:`DecodeScheduler`
+        (``inference/scheduler.py``), built lazily from the
+        ``continuous_batching`` config section. ``overrides`` replace config
+        fields (num_slots/max_len/prefill_bucket/collect_logits) on first
+        construction."""
+        if self._scheduler is None:
+            from .scheduler import DecodeScheduler
+            cb = self._config.continuous_batching
+            kw = {"num_slots": cb.num_slots, "max_len": cb.max_len,
+                  "prefill_bucket": cb.prefill_bucket,
+                  "collect_logits": cb.collect_logits,
+                  "steps_per_sync": cb.steps_per_sync}
+            kw.update(overrides)
+            self._scheduler = DecodeScheduler(self, **kw)
+        elif overrides:
+            raise ValueError("scheduler already built; overrides must be passed on "
+                             "the first scheduler() call")
+        return self._scheduler
 
-        Serving loops that fetch each request before dispatching the next
-        serialize on the host<->device round trip; this is the standard
-        continuous-serving fix (the reference's inference engine keeps the
-        stream busy the same way via CUDA streams)."""
+    def submit(self, input_ids, **kwargs):
+        """Pipelined generation: dispatch and return a handle WITHOUT
+        fetching results — the next ``submit`` (or any host work) overlaps
+        this request's device execution. ``handle.result()`` returns what
+        ``generate`` would.
+
+        With ``continuous_batching.enabled`` the rows join the shared
+        iteration-level decode scheduler: requests from DIFFERENT submit()
+        calls batch into one decode step, finished rows evict mid-loop, and
+        queued rows take their slots without recompiling (Orca/vLLM
+        continuous batching; see benchmarks/SERVING.md). Otherwise the
+        static-batch program is dispatched per call and only the fetch
+        overlaps (the pre-scheduler behavior)."""
+        if self._config.continuous_batching.enabled:
+            return self._submit_continuous(input_ids, **kwargs)
         tel = self.telemetry
         t0 = tel.now() if tel.enabled else None
         max_new = kwargs.get("max_new_tokens", 64)
@@ -474,9 +500,55 @@ class InferenceEngine:
 
             def __del__(self_h):
                 # an abandoned handle (timeout/cancel without result()) must
-                # not inflate the queue-depth gauge forever
-                self_h._settle()
+                # settle the queue-depth gauge — and NEVER raise: at
+                # interpreter teardown the gauge/engine globals may already
+                # be torn down, and an exception from __del__ prints an
+                # "Exception ignored" traceback over the user's exit
+                try:
+                    self_h._settle()
+                except Exception:
+                    pass
         return _Handle()
+
+    def _submit_continuous(self, input_ids, max_new_tokens=64, do_sample=False,
+                           temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                           pad_token_id=0, seed=0):
+        """submit() on the continuous-batching path: each row becomes one
+        scheduler request; the returned handle reassembles ``generate()``'s
+        per-row output lists (eos-inclusive, like the static path)."""
+        sched = self.scheduler()
+        handles = []
+        try:
+            for i, row in enumerate(input_ids):
+                handles.append(sched.submit(row, max_new_tokens=max_new_tokens,
+                                            eos_token_id=eos_token_id,
+                                            do_sample=do_sample,
+                                            temperature=temperature, top_k=top_k,
+                                            top_p=top_p, seed=seed + i))
+        except Exception:
+            for h in handles:  # don't orphan already-queued rows
+                h.cancel()
+            raise
+
+        class _BatchHandle:
+            def result(self_h):
+                return [h.result() for h in handles]
+
+            @property
+            def done(self_h):
+                return all(h.done for h in handles)
+
+            def __del__(self_h):
+                try:
+                    # flag abandoned requests for eviction so their slots
+                    # free at the scheduler's next iteration — NEVER pump
+                    # the decode loop from GC (__del__ can fire mid-step)
+                    for h in handles:
+                        if not h.done:
+                            h.cancel()
+                except Exception:
+                    pass
+        return _BatchHandle()
 
     def _record_decode(self, t0, out, max_new_tokens):
         """Decode telemetry for one finished request: a `generate` span, a

@@ -1,0 +1,125 @@
+"""Slot-based paged KV cache for continuous-batching decode.
+
+The Orca/vLLM lesson translated to XLA: instead of allocating a fresh
+(B, S) cache per request shape (the static-batch engine path), serving keeps
+ONE fixed-shape pool of ``num_slots`` cache slots,
+
+    stacked layers:  (L, num_slots, kv_heads, max_len, head_dim) x2
+    unrolled layers: per-layer tuples of (num_slots, kv_heads, max_len, head_dim)
+
+plus a host-side row of per-slot positions. A request is admitted by
+claiming a free slot, prefilling its prompt KV into rows ``[0, len)`` of
+that slot, and then riding the shared one-token decode program; on finish
+the slot returns to the free list and the next queued request overwrites it.
+Because the pool shape never changes, XLA sees exactly one decode program
+regardless of which requests are live — admission and eviction are pure
+host-side bookkeeping plus a per-row write index.
+
+"Paged" here is slot/block-granular rather than vLLM's 16-token pages: the
+unit of allocation is a slot, but *attention work and DMA* scale with live
+tokens, not pool capacity — the paged Pallas kernel
+(``ops/pallas/decode_attention.paged_decode_attention``) walks KV blocks
+only up to the longest live row, and per-slot ends mask the tail. Pages of
+``page_size`` tokens are the accounting unit the occupancy gauges report.
+
+Host-side state lives here; the compiled prefill/decode programs that read
+and write the pool live in :mod:`deepspeed_tpu.inference.scheduler`.
+"""
+
+import numpy as np
+
+import jax
+
+
+class SlotKVCache:
+    """Fixed pool of KV cache slots + free-list allocation.
+
+    ``pool`` is the device-side cache tree (``model.init_cache(num_slots,
+    max_len)``); it is REPLACED by the scheduler after every compiled step
+    (functional update with donation, so the buffers alias in place).
+    """
+
+    def __init__(self, pool, num_slots, max_len, page_size=256):
+        self.pool = pool
+        self.num_slots = int(num_slots)
+        self.max_len = int(max_len)
+        self.page_size = int(page_size)
+        self.lengths = np.zeros(self.num_slots, np.int32)  # live tokens per slot
+        self._free = list(range(self.num_slots - 1, -1, -1))  # pop() -> slot 0 first
+        self._owner = [None] * self.num_slots  # request id per slot (debugging)
+        self.total_allocs = 0
+        self.total_frees = 0
+
+    # ------------------------------------------------------------------ alloc
+    def alloc(self, owner=None):
+        """Claim a free slot (lowest index first) or return None when the
+        pool is saturated. The slot's length row resets to 0; stale cache
+        contents need no scrub — the prefill overwrites ``[0, len)`` and
+        per-slot ends mask everything past the write head."""
+        if not self._free:
+            return None
+        slot = self._free.pop()
+        self.lengths[slot] = 0
+        self._owner[slot] = owner
+        self.total_allocs += 1
+        return slot
+
+    def free(self, slot):
+        """Return ``slot`` to the pool (eviction at token-iteration
+        granularity: the scheduler calls this the moment a sequence
+        finishes, mid-decode-loop)."""
+        if slot in self._free:
+            raise ValueError(f"double free of slot {slot}")
+        self.lengths[slot] = 0
+        self._owner[slot] = None
+        self._free.append(slot)
+        self.total_frees += 1
+
+    def fits(self, prompt_len, max_new_tokens):
+        """Would a request of this shape ever fit a slot?"""
+        return prompt_len + max_new_tokens <= self.max_len
+
+    # ------------------------------------------------------------------ stats
+    @property
+    def active_slots(self):
+        return self.num_slots - len(self._free)
+
+    def occupancy(self):
+        """Fraction of slots holding live sequences."""
+        return self.active_slots / self.num_slots
+
+    def live_tokens(self):
+        """Total live KV rows across the pool."""
+        return int(self.lengths.sum())
+
+    def live_pages(self):
+        """Allocated pages (``page_size``-token blocks) backing live rows —
+        the unit the paged decode kernel walks."""
+        return int(np.sum((self.lengths + self.page_size - 1) // self.page_size))
+
+    def token_utilization(self):
+        """live tokens / pool capacity: how much of the fixed-shape pool is
+        doing useful work (the memory-efficiency gauge; the static-batch
+        path's equivalent is live/(B*S) and decays with padding)."""
+        return self.live_tokens() / float(self.num_slots * self.max_len)
+
+    def max_live_len(self):
+        return int(self.lengths.max()) if self.num_slots else 0
+
+
+def slot_slice(pool, slot):
+    """Pure function: one slot's cache as a (B=1)-batch cache tree, for the
+    single-request prefill program. Works on both layouts — stacked leaves
+    are (L, N, kv, S, hd) (slot axis 1), per-layer leaves (N, kv, S, hd)
+    (slot axis 0)."""
+    return jax.tree_util.tree_map(
+        lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=c.ndim - 4), pool)
+
+
+def slot_update(pool, slot, slot_cache):
+    """Pure function: write a (B=1) slot cache back into the pool at
+    ``slot`` (inverse of :func:`slot_slice`)."""
+    return jax.tree_util.tree_map(
+        lambda p, c: jax.lax.dynamic_update_slice_in_dim(p, c.astype(p.dtype), slot,
+                                                         axis=p.ndim - 4),
+        pool, slot_cache)

@@ -1,0 +1,465 @@
+"""Continuous-batching decode scheduler (iteration-level scheduling).
+
+The Orca/vLLM serving loop on JAX/XLA: queued requests are admitted into
+free KV-cache slots at TOKEN-ITERATION granularity — a finished sequence
+evicts mid-loop and the next queued request joins the very next decode step,
+without recompiling anything. The static-batch engine path compiles one
+whole-decode-loop program per (batch, prompt-bucket, sampling) shape and
+serializes concurrent requests; this scheduler compiles
+
+- ONE decode-step program over the fixed slot pool (two with sampling:
+  a greedy and a sampling variant), and
+- one single-request prefill program per prompt-length BUCKET (powers of
+  two from 64), bounding total compile count at ``log2(S/64) + 2``-ish
+  regardless of the request mix.
+
+Per-slot sampling parameters (do_sample / temperature / top_k / top_p) are
+runtime TENSORS, so requests with different sampling configs share one
+program. Sampling keys derive from ``fold_in(key(seed), step)`` per slot —
+a request's tokens are reproducible no matter which slot it lands in or
+what else is in flight.
+
+Each host round trip runs ``steps_per_sync`` decode steps in one on-device
+loop and fetches a (K, num_slots) token block (multi-step scheduling, the
+vLLM ``--num-scheduler-steps`` trick): dispatch + fetch amortize K-fold, at
+the cost of K-token admission/eviction granularity (K=1 recovers pure
+iteration-level scheduling; results are identical for any K). EOS
+detection, admission, and eviction are host-side bookkeeping on the
+fetched block.
+
+Telemetry (PR-1 sink): gauges ``serving/slot_occupancy``,
+``serving/batch_efficiency``, ``serving/kv_token_utilization``; counters
+``serving/admitted``, ``serving/evicted``, ``serving/decode_steps``,
+``serving/decode_tokens``; histograms ``serving/ttft_ms``,
+``serving/step_ms``, ``serving/tokens_per_step``.
+"""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import _round_up
+from .kv_cache import SlotKVCache, slot_slice, slot_update
+
+
+def _bucket_len(n, base, cap):
+    """Prefill bucket: next power of two >= n (floor ``base``), capped at
+    ``cap``. Geometric buckets bound the compiled-prefill count at
+    ~log2(cap/base) while wasting at most 2x prefill compute."""
+    b = base
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+def _sample_slot(seed, step, logits, do_sample, temperature, top_k, top_p):
+    """Per-slot token choice with fully-dynamic sampling params (one compiled
+    program serves any mix of greedy/sampled requests). ``logits``: (V,)
+    f32. top-k uses a dynamic kth-largest threshold (sort is static-shape);
+    top-p then keeps the smallest prefix with cumulative prob >= top_p of
+    the top-k-FILTERED distribution (same sequential-filter semantics as
+    the static path's ``_sample_tokens``)."""
+    V = logits.shape[0]
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    x = logits / jnp.maximum(temperature, 1e-6)
+    kth = jnp.sort(x)[::-1][jnp.clip(top_k - 1, 0, V - 1)]
+    x = jnp.where((top_k > 0) & (x < kth), -jnp.inf, x)
+    desc = jnp.sort(x)[::-1]  # re-sort AFTER top-k: nucleus over the filtered dist
+    probs = jax.nn.softmax(desc)
+    cum = jnp.cumsum(probs)
+    keep = jnp.concatenate([jnp.ones((1, ), bool), cum[:-1] < top_p])
+    threshold = jnp.min(jnp.where(keep, desc, jnp.inf))
+    x = jnp.where((top_p < 1.0) & (x < threshold), -jnp.inf, x)
+    key = jax.random.fold_in(jax.random.key(seed), step)
+    sampled = jax.random.categorical(key, x).astype(jnp.int32)
+    return jnp.where(do_sample, sampled, greedy)
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id", "do_sample",
+                 "temperature", "top_k", "top_p", "seed", "slot", "out", "logits",
+                 "done", "cancelled", "submit_ts", "first_token_ts", "collect_logits")
+
+    def __init__(self, rid, prompt, max_new_tokens, eos_token_id, do_sample,
+                 temperature, top_k, top_p, seed, collect_logits, submit_ts):
+        self.rid = rid
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("scheduler requires at least one prompt token")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        self.do_sample = bool(do_sample)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = int(seed) & 0xFFFFFFFF  # device-side key seed is uint32
+        self.collect_logits = bool(collect_logits)
+        self.slot = None
+        self.out = []      # generated token ids (host ints)
+        self.logits = []   # per-step (V,) logits when collect_logits
+        self.done = False
+        self.cancelled = False
+        self.submit_ts = submit_ts
+        self.first_token_ts = None
+
+
+class SchedulerHandle:
+    """Future-like handle for one scheduled request. ``result()`` pumps the
+    shared scheduler loop (serving every in-flight request, not just this
+    one) until this request finishes."""
+
+    __slots__ = ("_sched", "_req")
+
+    def __init__(self, sched, req):
+        self._sched = sched
+        self._req = req
+
+    @property
+    def done(self):
+        return self._req.done
+
+    def cancel(self):
+        """Flag the request for eviction. Pure host bookkeeping — safe to
+        call from GC/__del__: the single-threaded scheduler loop frees the
+        slot (or drops the queued request) at its next iteration, so
+        nothing mutates mid-decode-step."""
+        self._req.cancelled = True
+
+    def result(self):
+        while not self._req.done:
+            self._sched.step()
+        return np.asarray(self._req.out, np.int32)
+
+    def result_logits(self):
+        """(T, V) per-generated-token logits (requires ``collect_logits``)."""
+        self.result()
+        if not self._req.collect_logits:
+            raise ValueError("request was not submitted with collect_logits=True")
+        if self._req.logits:
+            return np.stack(self._req.logits)
+        V = self._sched.engine.model_config.vocab_size
+        return np.zeros((0, V), np.float32)
+
+
+class DecodeScheduler:
+    """Continuous-batching serving loop over an :class:`InferenceEngine`.
+
+    ``num_slots`` fixes the decode batch (the pool shape XLA compiles
+    against); ``max_len`` is the per-slot KV capacity. Requests whose
+    ``prompt + max_new_tokens`` exceed ``max_len`` are rejected at submit.
+    """
+
+    def __init__(self, engine, num_slots=8, max_len=None, prefill_bucket=64,
+                 collect_logits=False, steps_per_sync=4):
+        self.engine = engine
+        model = engine.module
+        cfg = engine._config
+        if max_len is None:
+            max_len = min(model.cfg.max_seq_len, cfg.max_out_tokens)
+        # pool length: multiple of the decode KV block (same rule as the
+        # static path) so the paged kernel's block walk tiles evenly; when
+        # the model's max_seq_len caps it, round DOWN so the tiling holds
+        # (the kernel needs S % block only when S exceeds one block)
+        block = cfg.decode_block_kv
+        S = int(_round_up(max_len, 64))
+        if S > block:
+            S = int(_round_up(S, block))
+        if S > model.cfg.max_seq_len:
+            S = model.cfg.max_seq_len
+            if S > block:
+                S = (S // block) * block
+        if S < 1:
+            raise ValueError(f"model max_seq_len {model.cfg.max_seq_len} leaves no "
+                             f"room for a KV slot")
+        self.max_len = S
+        self.prefill_bucket = int(prefill_bucket)
+        self.collect_logits = bool(collect_logits)
+        # multi-step scheduling (vLLM --num-scheduler-steps): K decode steps
+        # per host round trip. The K-step program is ONE compiled XLA loop,
+        # so dispatch + device_get amortize K-fold; admission/eviction
+        # granularity becomes K tokens (K=1 recovers pure iteration-level
+        # scheduling). Token/logits results are IDENTICAL for any K:
+        # sampling keys fold in the absolute step index.
+        self.steps_per_sync = max(1, int(steps_per_sync))
+        self.cache = SlotKVCache(engine._init_cache(int(num_slots), S),
+                                 int(num_slots), S, page_size=min(block, S))
+        self.queue = collections.deque()
+        self.active = {}  # slot -> _Request
+        self._compiled = {}
+        self._rid = 0
+        self._steps = 0
+        self.telemetry = engine.telemetry
+
+    # ------------------------------------------------------------------ API
+    def submit(self, prompt, max_new_tokens=64, eos_token_id=None, do_sample=False,
+               temperature=1.0, top_k=0, top_p=1.0, seed=0, collect_logits=None):
+        """Enqueue one request; returns a :class:`SchedulerHandle`. The
+        request joins the decode batch as soon as a slot frees up."""
+        tel = self.telemetry
+        req = _Request(self._rid, prompt, max_new_tokens, eos_token_id, do_sample,
+                       temperature, top_k, top_p, seed,
+                       self.collect_logits if collect_logits is None else collect_logits,
+                       tel.now())
+        self._rid += 1
+        if req.max_new_tokens <= 0:  # static-path parity: zero-budget -> no tokens
+            req.done = True
+            return SchedulerHandle(self, req)
+        # reserve for multi-step overshoot: the K-step program writes K rows
+        # per sync even when the budget ends mid-block
+        budget = _round_up(req.max_new_tokens, self.steps_per_sync)
+        if not self.cache.fits(req.prompt.size, budget):
+            raise ValueError(
+                f"request needs {req.prompt.size + budget} cache rows > "
+                f"slot capacity {self.max_len}; raise max_out_tokens/num_slots' max_len "
+                f"or shorten the request")
+        self.queue.append(req)
+        if tel.enabled:
+            tel.gauge("serving/queue_depth", len(self.queue))
+        return SchedulerHandle(self, req)
+
+    def drain(self):
+        """Run until every queued/active request finishes."""
+        while self.queue or self.active:
+            self.step()
+
+    @property
+    def num_slots(self):
+        return self.cache.num_slots
+
+    # ------------------------------------------------------------------ loop
+    def step(self):
+        """One scheduler iteration: settle cancellations, admit while slots
+        are free, then advance every live sequence one token."""
+        tel = self.telemetry
+        t0 = tel.now()
+        self._reap_cancelled()
+        admitted = 0
+        while self.queue and self.cache.active_slots < self.cache.num_slots:
+            req = self.queue.popleft()
+            if req.cancelled:
+                req.done = True
+                continue
+            self._admit(req)
+            admitted += 1
+        if admitted and tel.enabled:
+            tel.counter("serving/admitted", admitted)
+        if not self.active:
+            return 0
+        delivered = self._decode_step()
+        if tel.enabled:
+            K = self.steps_per_sync
+            dur_ms = (tel.now() - t0) * 1e3
+            tel.counter("serving/decode_steps", K)
+            tel.counter("serving/decode_tokens", delivered)
+            tel.histogram("serving/step_ms", dur_ms / K)
+            tel.histogram("serving/tokens_per_step", delivered / K)
+            tel.gauges([("serving/slot_occupancy", self.cache.occupancy(), None),
+                        ("serving/batch_efficiency",
+                         delivered / (K * self.cache.num_slots), None),
+                        ("serving/kv_token_utilization", self.cache.token_utilization(),
+                         None)])
+        return delivered
+
+    def _reap_cancelled(self):
+        """Evict slots whose requests were cancelled (handle dropped). Runs
+        only from step() — the single-threaded loop — so eviction never
+        races an in-flight decode dispatch."""
+        for slot, req in list(self.active.items()):
+            if req.cancelled and not req.done:
+                req.done = True
+                del self.active[slot]
+                self.cache.free(slot)
+                if self.telemetry.enabled:
+                    self.telemetry.counter("serving/cancelled")
+
+    # ------------------------------------------------------------------ admit
+    def _admit(self, req):
+        eng = self.engine
+        slot = self.cache.alloc(owner=req.rid)
+        assert slot is not None
+        req.slot = slot
+        L = req.prompt.size
+        Pb = _bucket_len(L, self.prefill_bucket, self.max_len)
+        ids = np.zeros((1, Pb), np.int32)
+        ids[0, :L] = req.prompt
+        fn = self._prefill_fn(Pb, req.collect_logits)
+        try:
+            with eng.mesh:
+                out = fn(eng.params, self.cache.pool, jnp.asarray(ids),
+                         jnp.asarray(L, jnp.int32), jnp.asarray(slot, jnp.int32),
+                         jnp.asarray(req.seed, jnp.uint32),
+                         jnp.asarray(req.do_sample),
+                         jnp.asarray(req.temperature, jnp.float32),
+                         jnp.asarray(req.top_k, jnp.int32),
+                         jnp.asarray(req.top_p, jnp.float32))
+        except Exception:
+            # a failed prefill must not strand the slot (the pool would
+            # permanently lose capacity)
+            self.cache.free(slot)
+            raise
+        if req.collect_logits:
+            self.cache.pool, tok, logits = out
+            req.logits.append(np.asarray(jax.device_get(logits), np.float32))
+        else:
+            self.cache.pool, tok = out
+        tok = int(jax.device_get(tok))
+        self.cache.lengths[slot] = L
+        self.active[slot] = req
+        tel = self.telemetry
+        req.first_token_ts = tel.now()
+        if tel.enabled:
+            tel.histogram("serving/ttft_ms", (req.first_token_ts - req.submit_ts) * 1e3)
+            tel.gauge("serving/queue_depth", len(self.queue))
+        self._deliver(req, tok)
+
+    def _deliver(self, req, tok):
+        """Append one generated token; finish on EOS or length budget and
+        evict the slot the same iteration (continuous batching's whole
+        point: the freed slot admits the next queued request BEFORE the
+        next decode step)."""
+        if req.done:  # cancelled/settled elsewhere: never double-free the slot
+            return
+        req.out.append(tok)
+        if ((req.eos_token_id is not None and tok == req.eos_token_id)
+                or len(req.out) >= req.max_new_tokens):
+            req.done = True
+            if req.slot in self.active:
+                del self.active[req.slot]
+            self.cache.free(req.slot)
+            if self.telemetry.enabled:
+                self.telemetry.counter("serving/evicted")
+
+    # ------------------------------------------------------------------ decode
+    def _decode_step(self):
+        eng = self.engine
+        N = self.cache.num_slots
+        toks = np.zeros(N, np.int32)
+        seeds = np.zeros(N, np.uint32)
+        steps = np.zeros(N, np.int32)
+        flags = np.zeros(N, bool)
+        temps = np.ones(N, np.float32)
+        topks = np.zeros(N, np.int32)
+        topps = np.ones(N, np.float32)
+        live = sorted(self.active.items())
+        sampling = False
+        collect = False
+        for slot, req in live:
+            toks[slot] = req.out[-1]
+            seeds[slot] = req.seed
+            steps[slot] = len(req.out)  # prefill consumed step 0
+            flags[slot] = req.do_sample
+            temps[slot] = req.temperature
+            topks[slot] = req.top_k
+            topps[slot] = req.top_p
+            sampling = sampling or req.do_sample
+            collect = collect or req.collect_logits
+        K = self.steps_per_sync
+        fn = self._decode_fn(sampling, collect)
+        lengths = jnp.asarray(self.cache.lengths)
+        with eng.mesh:
+            out = fn(eng.params, self.cache.pool, jnp.asarray(toks), lengths,
+                     jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+        if collect:
+            self.cache.pool, toks_k, logits_k = out
+            logits_k = np.asarray(jax.device_get(logits_k), np.float32)  # (K, N, V)
+        else:
+            self.cache.pool, toks_k = out
+            logits_k = None
+        toks_k = np.asarray(jax.device_get(toks_k)).reshape(K, N)
+        self._steps += K
+        n_delivered = 0
+        for slot, req in live:
+            # the K-step program wrote this row's KV at rows [len, len+K)
+            self.cache.lengths[slot] += K
+            for k in range(K):
+                if req.done:
+                    break  # tokens past EOS/budget are computed but discarded
+                if req.collect_logits and logits_k is not None:
+                    req.logits.append(logits_k[k, slot])
+                self._deliver(req, int(toks_k[k, slot]))
+                n_delivered += 1
+        return n_delivered
+
+    # ------------------------------------------------------------------ compiled programs
+    def _prefill_fn(self, Pb, collect):
+        """Single-request prefill into one pool slot, compiled per prompt
+        bucket ``Pb``: right-pad the prompt to ``Pb`` (padding rows are
+        causally invisible to the real tokens and get overwritten by later
+        decode writes), take the last real token's logits, sample token 0."""
+        key = ("prefill", Pb, collect)
+        if key not in self._compiled:
+            model = self.engine.module
+
+            def prefill(params, pool, ids, length, slot, seed, do_sample,
+                        temperature, top_k, top_p):
+                cache = slot_slice(pool, slot)
+                logits, cache = model.apply_with_cache(params, ids, cache, 0)
+                pool = slot_update(pool, slot, cache)
+                last = jnp.take_along_axis(
+                    logits, (length - 1)[None, None, None], axis=1)[0, 0].astype(jnp.float32)
+                tok = _sample_slot(seed, jnp.zeros((), jnp.int32), last, do_sample,
+                                   temperature, top_k, top_p)
+                if collect:
+                    return pool, tok, last
+                return pool, tok
+
+            self._compiled[key] = jax.jit(prefill, donate_argnums=(1, ))
+        return self._compiled[key]
+
+    def _decode_fn(self, sampling, collect):
+        """The one shared decode program: every slot advances
+        ``steps_per_sync`` tokens in a single on-device loop (dead slots
+        compute too — their writes land at rows [0, K) and are overwritten
+        by the next prefill into that slot; rows past a request's EOS are
+        discarded by the host). Compiled at most twice (greedy / sampling)
+        x logits collection.
+
+        NOTE: the fused per-layer decode kernel (decode_block.py) needs a
+        shared position scalar, so the slot-pool step always uses the
+        per-projection path (paged Pallas decode kernel or XLA)."""
+        K = self.steps_per_sync
+        key = ("decode", sampling, collect, K)
+        if key not in self._compiled:
+            model = self.engine.module
+            V = model.cfg.vocab_size
+
+            def decode(params, pool, toks, lengths, seeds, steps, flags,
+                       temps, topks, topps):
+                N = toks.shape[0]
+
+                def body(k, carry):
+                    pool, tok, out_toks, out_logits = carry
+                    logits, pool = model.apply_with_cache(
+                        params, tok[:, None], pool, 0,
+                        position_ids=(lengths + k)[:, None], write_index=lengths + k)
+                    l2 = logits[:, 0].astype(jnp.float32)
+                    if sampling:
+                        nxt = jax.vmap(_sample_slot)(seeds, steps + k, l2, flags,
+                                                     temps, topks, topps)
+                    else:
+                        nxt = jnp.argmax(l2, axis=-1).astype(jnp.int32)
+                    out_toks = jax.lax.dynamic_update_index_in_dim(out_toks, nxt, k, 0)
+                    if collect:
+                        out_logits = jax.lax.dynamic_update_index_in_dim(
+                            out_logits, l2, k, 0)
+                    return pool, nxt, out_toks, out_logits
+
+                out_logits = jnp.zeros((K, N, V) if collect else (), jnp.float32)
+                pool, _, out_toks, out_logits = jax.lax.fori_loop(
+                    0, K, body, (pool, toks, jnp.zeros((K, N), jnp.int32), out_logits))
+                if collect:
+                    return pool, out_toks, out_logits
+                return pool, out_toks
+
+            self._compiled[key] = jax.jit(decode, donate_argnums=(1, ))
+        return self._compiled[key]
+
+    # ------------------------------------------------------------------ introspection
+    def compiled_program_count(self):
+        """Number of distinct XLA programs this scheduler has built — the
+        compile-count regression guard reads this (and the jax.monitoring
+        compile events agree)."""
+        return len(self._compiled)

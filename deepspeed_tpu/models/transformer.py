@@ -403,34 +403,36 @@ def _cached_attention_xla(q, ck, cv, cache_index, cache_mask, dtype, alibi=None,
 
     q: (B, nh, T, hd); ck/cv: (B, nkv, S, hd); cache_mask: optional (B, S)
     bool marking valid cache slots (left-pad masking). Query position ``i`` of
-    this call sits at absolute cache position ``cache_index + i``. ``alibi``:
-    optional (nh,) slopes adding ``-slope * (qpos - kpos)`` to the scores.
-    ``window``: >0 restricts each query to the last ``window`` keys (GPT-Neo
-    local attention).
+    this call sits at absolute cache position ``cache_index + i``;
+    ``cache_index`` is a shared scalar or a per-row (B,) array (slot-pool
+    decode: every cache slot sits at its own position). ``alibi``: optional
+    (nh,) slopes adding ``-slope * (qpos - kpos)`` to the scores. ``window``:
+    >0 restricts each query to the last ``window`` keys (GPT-Neo local
+    attention).
     """
     B, nh, T, hd = q.shape
     nkv, S = ck.shape[1], ck.shape[2]
     g = nh // nkv
     qg = q.reshape(B, nkv, g, T, hd)
     scores = jnp.einsum("bkgtd,bksd->bkgts", qg, ck).astype(jnp.float32) / jnp.sqrt(hd)
-    kpos = jnp.arange(S)[None, :]
-    qpos = cache_index + jnp.arange(T)[:, None]
-    keep = kpos <= qpos
+    per_row = getattr(cache_index, "ndim", 0) == 1
+    base = cache_index[:, None] if per_row else jnp.full((1, 1), cache_index)
+    qpos = base + jnp.arange(T)[None, :]  # (B or 1, T)
+    kpos = jnp.arange(S)[None, None, :]
+    keep = kpos <= qpos[..., None]  # (B or 1, T, S)
     if window:
-        keep = keep & (qpos - kpos < window)
-    bias = jnp.where(keep, 0.0, -1e30)  # (T, S)
+        keep = keep & (qpos[..., None] - kpos < window)
+    bias = jnp.where(keep, 0.0, -1e30)  # (B or 1, T, S)
     if alibi is not None:
-        rel = (qpos - kpos).astype(jnp.float32)  # (T, S)
-        bias = bias[None, None] - alibi.reshape(nkv, g)[:, :, None, None] * rel  # (nkv, g, T, S)
+        rel = (qpos[..., None] - kpos).astype(jnp.float32)  # (B or 1, T, S)
+        # (B or 1, nkv, g, T, S)
+        bias = bias[:, None, None] - alibi.reshape(nkv, g)[None, :, :, None, None] * rel[:, None, None]
         if cache_mask is not None:
-            bias = bias[None] + jnp.where(cache_mask, 0.0, -1e30)[:, None, None, None, :]
-        else:
-            bias = bias[None]
-    elif cache_mask is not None:
-        bias = bias[None] + jnp.where(cache_mask, 0.0, -1e30)[:, None, :]  # (B, T, S)
-        bias = bias[:, None, None]
+            bias = bias + jnp.where(cache_mask, 0.0, -1e30)[:, None, None, None, :]
     else:
-        bias = bias[None, None, None]
+        bias = bias[:, None, None]  # (B or 1, 1, 1, T, S)
+        if cache_mask is not None:
+            bias = bias + jnp.where(cache_mask, 0.0, -1e30)[:, None, None, None, :]
     probs = jax.nn.softmax(scores + bias, axis=-1).astype(dtype)
     out = jnp.einsum("bkgts,bksd->bkgtd", probs, cv)
     return out.reshape(B, nh, T, hd)
@@ -552,10 +554,16 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, kv_cache=None, cache_index=None,
-                 position_ids=None):
+                 position_ids=None, write_index=None):
         """``attn_mask`` semantics: without a cache it is (B, T) over the
         current tokens; with a cache it is (B, S) over cache slots (True =
         attendable, used for left-pad masking during generation).
+
+        ``write_index``: optional (B,) int32 per-row cache write positions
+        (continuous-batching slot pool — every sequence sits at its own
+        length). Decode-only (T == 1); overrides ``cache_index`` for both the
+        cache write and the causal window, and positions must then come from
+        ``position_ids``.
         """
         cfg = self.cfg
         B, T, H = x.shape
@@ -613,10 +621,18 @@ class Attention(nn.Module):
             # arena: csrc/transformer/inference/includes/inference_context.h).
             # k/v are already bhtd, so the cache write needs no transpose.
             ck, cv = kv_cache
-            ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_index, axis=2)
-            cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_index, axis=2)
+            if write_index is not None:
+                # slot-pool decode: each row appends at its own position
+                upd = lambda c, kk, i: jax.lax.dynamic_update_slice_in_dim(c, kk, i, axis=1)
+                ck = jax.vmap(upd)(ck, k.astype(ck.dtype), write_index)
+                cv = jax.vmap(upd)(cv, v.astype(cv.dtype), write_index)
+                cache_index = write_index  # per-row causal window below
+            else:
+                ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_index, axis=2)
+                cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_index, axis=2)
             if cfg.attention_impl == "flash" and T == 1 and alibi is None:
-                from ..ops.pallas.decode_attention import decode_attention
+                from ..ops.pallas.decode_attention import decode_attention, \
+                    paged_decode_attention
                 if attn_mask is not None:
                     starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
                 else:
@@ -624,8 +640,13 @@ class Attention(nn.Module):
                 if window:
                     # a sliding window is just a raised start for one query
                     starts = jnp.maximum(starts, cache_index + 1 - window)
-                out = decode_attention(q[:, :, 0], ck, cv, starts, cache_index + 1,
-                                       block_kv=cfg.decode_block_kv)[:, :, None]
+                if write_index is not None:
+                    out = paged_decode_attention(q[:, :, 0], ck, cv, starts,
+                                                 write_index + 1,
+                                                 block_kv=cfg.decode_block_kv)[:, :, None]
+                else:
+                    out = decode_attention(q[:, :, 0], ck, cv, starts, cache_index + 1,
+                                           block_kv=cfg.decode_block_kv)[:, :, None]
             elif (cfg.attention_impl == "flash" and attn_mask is None and T >= 128
                   and isinstance(cache_index, int) and cache_index == 0 and alibi is None
                   and not window):
@@ -761,7 +782,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, deterministic=True, kv_cache=None,
-                 cache_index=None, position_ids=None):
+                 cache_index=None, position_ids=None, write_index=None):
         cfg = self.cfg
         drop = nn.Dropout(rate=cfg.dropout) if cfg.dropout > 0 else None
         if cfg.act_quant_bits:  # QAT activation fake-quant (compression)
@@ -770,7 +791,7 @@ class Block(nn.Module):
                               symmetric=cfg.act_quant_symmetric)
         h = make_norm(cfg, name="attn_norm")(x)
         h, new_cache = Attention(cfg, layer_idx=self.layer_idx, name="attn")(
-            h, sin, cos, attn_mask, kv_cache, cache_index, position_ids)
+            h, sin, cos, attn_mask, kv_cache, cache_index, position_ids, write_index)
         if drop is not None:
             h = drop(h, deterministic=deterministic)
         if cfg.parallel_residual:
@@ -800,7 +821,8 @@ class CausalLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
                  cache_index=None, position_ids=None, return_hidden=False,
-                 pld_theta=None, pld_rng=None, ltd_keep=None, ltd_layers=(), ltd_rng=None):
+                 pld_theta=None, pld_rng=None, ltd_keep=None, ltd_layers=(), ltd_rng=None,
+                 write_index=None):
         """``kv_cache``: optional per-layer (k, v) with leading layer dim —
         shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
         stack. Returns logits, or (logits, new_kv_cache) when caching, or the
@@ -873,7 +895,7 @@ class CausalLM(nn.Module):
                         carry, layer_idx)
                 else:
                     y, c = mdl(carry, sin, cos, attn_mask, deterministic,
-                               layer_cache, cache_index, position_ids)
+                               layer_cache, cache_index, position_ids, write_index)
                 return apply_pld(y, carry, layer_idx), c
 
             x, new_cache = nn.scan(
@@ -897,7 +919,7 @@ class CausalLM(nn.Module):
                         x, i)
                 else:
                     y, c = blk(x, sin, cos, attn_mask, deterministic,
-                               layer_cache, cache_index, position_ids)
+                               layer_cache, cache_index, position_ids, write_index)
                 x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
@@ -1100,12 +1122,15 @@ class CausalLMModel:
                 tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_layers)))
 
     def apply_with_cache(self, params, input_ids, kv_cache, cache_index, cache_mask=None,
-                         position_ids=None):
+                         position_ids=None, write_index=None):
         """Forward writing into (and attending over) the KV cache. Returns
-        (logits, new_cache). ``cache_mask``: (B, S) attendable cache slots."""
+        (logits, new_cache). ``cache_mask``: (B, S) attendable cache slots.
+        ``write_index``: optional (B,) per-row cache positions (slot-pool
+        decode, T == 1); pass ``position_ids`` alongside it."""
         mutable = ["intermediates"] if self.cfg.num_experts > 0 else False
         out = self.module.apply({"params": params}, input_ids, cache_mask, True, kv_cache,
-                                cache_index, position_ids, mutable=mutable)
+                                cache_index, position_ids, write_index=write_index,
+                                mutable=mutable)
         if mutable:
             (logits, new_cache), _ = out
         else:

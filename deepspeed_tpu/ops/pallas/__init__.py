@@ -1,0 +1,10 @@
+"""Pallas TPU kernels.
+
+Shared compat: jax renamed ``pltpu.TPUCompilerParams`` to
+``CompilerParams`` around 0.5 — kernels import the alias from here so the
+version shim can't drift between files.
+"""
+
+from jax.experimental.pallas import tpu as _pltpu
+
+CompilerParams = getattr(_pltpu, "CompilerParams", None) or _pltpu.TPUCompilerParams

@@ -18,9 +18,15 @@ costs multiply by depth). Blocks past the write head are skipped: the
 index map clamps to the last live block (no re-DMA) and ``pl.when`` skips
 the compute, so work scales with the live context length.
 
-Per-row window [start_i, end): ``start`` masks left-padding slots of batched
-generation; ``end`` is the shared write head (prompts are left-aligned to a
-common end by the inference engine).
+Per-row window [start_i, end_i): ``start`` masks left-padding slots of batched
+generation; ``end`` is the write head. Two entry points share one kernel:
+
+- :func:`decode_attention` — shared scalar ``end`` (the static-batch engine
+  path: prompts are left-aligned to a common write head).
+- :func:`paged_decode_attention` — per-row ``ends`` (the continuous-batching
+  slot pool: every slot sits at its own sequence position, so each row
+  attends its own live window). Blocks past the LONGEST live row are
+  skipped, so a mostly-short batch still pays only for its max context.
 """
 
 import functools
@@ -30,6 +36,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import CompilerParams as _CompilerParams
+
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -37,11 +45,11 @@ def _interpret():
     return jax.default_backend() == "cpu"
 
 
-def _decode_kernel(start_ref, end_ref, q_ref, k_ref, v_ref, o_ref,
+def _decode_kernel(start_ref, end_ref, max_end_ref, q_ref, k_ref, v_ref, o_ref,
                    m_s, l_s, acc_s, *, scale, block_kv, B, nkv, g, D):
     j = pl.program_id(0)
     nj = pl.num_programs(0)
-    end = end_ref[0]
+    max_end = max_end_ref[0]
     BH = B * nkv
 
     @pl.when(j == 0)
@@ -52,7 +60,7 @@ def _decode_kernel(start_ref, end_ref, q_ref, k_ref, v_ref, o_ref,
 
     kv_start = j * block_kv
 
-    @pl.when(kv_start < end)
+    @pl.when(kv_start < max_end)
     def _block():
         q = q_ref[...].astype(jnp.float32).reshape(BH, g, D) * scale
         k = k_ref[...].astype(jnp.float32).reshape(BH, block_kv, D)
@@ -60,12 +68,14 @@ def _decode_kernel(start_ref, end_ref, q_ref, k_ref, v_ref, o_ref,
         s = jax.lax.dot_general(q, k, (((2, ), (2, )), ((0, ), (0, ))),
                                 preferred_element_type=jnp.float32)  # (BH, g, bkv)
         # masking in 2-D folded form: Mosaic rejects lane-dim-1 vector
-        # reshapes, so per-row starts become full (rows, bkv) fills
+        # reshapes, so per-row starts/ends become full (rows, bkv) fills
         s2 = s.reshape(BH * g, block_kv)
         kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (BH * g, block_kv), 1)
         start2d = jnp.concatenate(
             [jnp.full((nkv * g, block_kv), start_ref[i], jnp.int32) for i in range(B)])
-        mask = (kv_pos >= start2d) & (kv_pos < end)
+        end2d = jnp.concatenate(
+            [jnp.full((nkv * g, block_kv), end_ref[i], jnp.int32) for i in range(B)])
+        mask = (kv_pos >= start2d) & (kv_pos < end2d)
         s2 = jnp.where(mask, s2, DEFAULT_MASK_VALUE)
 
         m_prev = m_s[...].reshape(BH * g, 1)
@@ -89,11 +99,9 @@ def _decode_kernel(start_ref, end_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[...] = out.reshape(B, nkv, g, D).astype(o_ref.dtype)
 
 
-def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=None):
-    """q: (B, H, D) one query token per sequence; k_cache/v_cache:
-    (B, kv_heads, S, D); start: (B,) int32 first attendable cache slot per
-    row; end: scalar int32, one past the last written slot (shared).
-    Returns (B, H, D)."""
+def _decode_call(q, k_cache, v_cache, start, ends, max_end, *, block_kv, scale):
+    """Shared pallas_call builder: per-row windows [start_i, ends_i), with
+    ``max_end`` (scalar) bounding the walked KV blocks."""
     B, H, D = q.shape
     nkv, S = k_cache.shape[1], k_cache.shape[2]
     g = H // nkv
@@ -104,13 +112,14 @@ def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=Non
 
     qg = q.reshape(B, nkv, g, D)
     start = start.astype(jnp.int32)
-    end_arr = jnp.full((1, ), end, jnp.int32)
+    ends = ends.astype(jnp.int32)
+    max_end_arr = jnp.full((1, ), max_end, jnp.int32)
     nj = S // block_kv
 
-    def kv_index(j, start_r, end_r):
-        # clamp to the last block holding live keys: skipped steps keep the
-        # previous index so no extra DMA is issued
-        last = jnp.maximum(end_r[0] - 1, 0) // block_kv
+    def kv_index(j, start_r, end_r, max_end_r):
+        # clamp to the last block holding live keys (of the LONGEST row):
+        # skipped steps keep the previous index so no extra DMA is issued
+        last = jnp.maximum(max_end_r[0] - 1, 0) // block_kv
         return (0, 0, jnp.minimum(j, last), 0)
 
     kernel = functools.partial(_decode_kernel, scale=scale, block_kv=block_kv,
@@ -118,7 +127,7 @@ def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=Non
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=3,
             grid=(nj, ),
             in_specs=[
                 pl.BlockSpec((B, nkv, g, D), lambda j, *_: (0, 0, 0, 0)),
@@ -133,7 +142,31 @@ def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=Non
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, nkv, g, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary", )),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary", )),
         interpret=_interpret(),
-    )(start, end_arr, qg, k_cache, v_cache)
+    )(start, ends, max_end_arr, qg, k_cache, v_cache)
     return out.reshape(B, H, D)
+
+
+def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=None):
+    """q: (B, H, D) one query token per sequence; k_cache/v_cache:
+    (B, kv_heads, S, D); start: (B,) int32 first attendable cache slot per
+    row; end: scalar int32, one past the last written slot (shared).
+    Returns (B, H, D)."""
+    B = q.shape[0]
+    ends = jnp.full((B, ), end, jnp.int32)
+    return _decode_call(q, k_cache, v_cache, start, ends, end,
+                        block_kv=block_kv, scale=scale)
+
+
+def paged_decode_attention(q, k_cache, v_cache, start, ends, *, block_kv=256, scale=None):
+    """Slot-pool variant: per-row ends. q: (B, H, D); k_cache/v_cache:
+    (B, kv_heads, S, D) where B indexes cache SLOTS; ``ends``: (B,) int32 one
+    past each slot's last written position (rows with ``ends == 0`` attend
+    nothing — their output is unspecified; callers mask dead slots).
+    The KV-block walk stops at ``max(ends)``, so compute and DMA
+    scale with the longest LIVE context, not the pool capacity S.
+    Returns (B, H, D)."""
+    ends = ends.astype(jnp.int32)
+    return _decode_call(q, k_cache, v_cache, start, ends, jnp.max(ends),
+                        block_kv=block_kv, scale=scale)

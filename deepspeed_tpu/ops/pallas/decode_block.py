@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import CompilerParams as _CompilerParams
+
 
 def _interpret():
     return jax.default_backend() == "cpu"
@@ -146,7 +148,7 @@ def fused_qkv_ln(x, norms, qkv, *, eps=1e-5):
         out_specs=pl.BlockSpec((B, Nq), lambda s: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Nq), x.dtype),
         scratch_shapes=[pltpu.VMEM((B, H), x.dtype), pltpu.VMEM((B, Nq), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary", )),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary", )),
         interpret=_interpret(),
     )(x, norms, w, sc, b.reshape(1, -1))
 
@@ -280,7 +282,7 @@ def fused_out_mlp(attn2d, x, norms, o, up, down, *, activation="gelu", eps=1e-5)
             pltpu.VMEM((B, F), x.dtype),   # up_h
             pltpu.VMEM((B, H), f32),       # shared o/down accumulator
         ],
-        compiler_params=pltpu.CompilerParams(dimension_semantics=("arbitrary", )),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary", )),
         interpret=_interpret(),
     )(attn2d, x, norms, o_w, o_s, o_b.reshape(1, -1),
       up_w, up_s, up_b.reshape(1, -1), dn_w, dn_s, dn_b.reshape(1, -1))

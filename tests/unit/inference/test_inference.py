@@ -272,3 +272,27 @@ def test_decode_kernel_vs_reference():
     s = jnp.where(mask[:, None, None, :], s, -1e30)
     ref = jnp.einsum("bkgs,bksd->bkgd", jax.nn.softmax(s, -1), vc).reshape(B, H, D)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_paged_decode_kernel_vs_reference():
+    """Paged (per-row ends) decode kernel numerics vs dense XLA reference —
+    the slot-pool variant where every cache slot sits at its own length,
+    including a row whose live window is a single token."""
+    from deepspeed_tpu.ops.pallas.decode_attention import paged_decode_attention
+    B, H, nkv, S, D = 3, 8, 2, 64, 64
+    ks = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
+    kc = jax.random.normal(ks[1], (B, nkv, S, D), jnp.float32)
+    vc = jax.random.normal(ks[2], (B, nkv, S, D), jnp.float32)
+    start = jnp.asarray([0, 2, 0], jnp.int32)
+    ends = jnp.asarray([40, 13, 1], jnp.int32)
+    out = paged_decode_attention(q, kc, vc, start, ends, block_kv=16)
+
+    g = H // nkv
+    qg = q.reshape(B, nkv, g, D)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg, kc) / jnp.sqrt(D)
+    kpos = jnp.arange(S)
+    mask = (kpos[None, :] >= start[:, None]) & (kpos[None, :] < ends[:, None])
+    s = jnp.where(mask[:, None, None, :], s, -1e30)
+    ref = jnp.einsum("bkgs,bksd->bkgd", jax.nn.softmax(s, -1), vc).reshape(B, H, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

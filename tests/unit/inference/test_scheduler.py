@@ -1,0 +1,282 @@
+"""Continuous-batching decode scheduler tests.
+
+Covers the serving invariants the static-batch engine tests can't: admission
+and eviction at token-iteration granularity, queue saturation, slot reuse
+purity (a request's tokens AND logits must not depend on which slot it lands
+in or what else is in flight), and the compile-count bound that makes
+bucketed continuous batching viable on XLA.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+
+PROMPTS = [[5, 6, 7, 8, 9], [10, 11, 12]]
+
+
+def make_engine(model="tiny", params=None, **cfg):
+    comm._state["mesh"] = None
+    config = {"dtype": "float32"}
+    config.update(cfg)
+    return deepspeed_tpu.init_inference(model, config=config, params=params)
+
+
+def make_sched_engine(params=None, num_slots=4, collect_logits=False, **cfg):
+    cfg["continuous_batching"] = {"enabled": True, "num_slots": num_slots,
+                                  "collect_logits": collect_logits}
+    return make_engine(params=params, **cfg)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    eng = make_engine()
+    params = jax.device_get(eng.params)
+    out = eng.generate(PROMPTS, max_new_tokens=8)
+    return params, out
+
+
+def test_scheduler_matches_generate(baseline):
+    """Mixed-length greedy requests through the scheduler == the static
+    generate() path."""
+    params, out = baseline
+    eng = make_sched_engine(params)
+    sched = eng.scheduler()
+    handles = [sched.submit(p, max_new_tokens=8) for p in PROMPTS]
+    got = [h.result() for h in handles]
+    assert all((a == b).all() for a, b in zip(out, got))
+
+
+def test_submit_routes_through_scheduler(baseline):
+    """engine.submit() on the continuous-batching config serves the batch
+    through the shared scheduler and matches generate()."""
+    params, out = baseline
+    eng = make_sched_engine(params)
+    h = eng.submit(PROMPTS, max_new_tokens=8)
+    got = h.result()
+    assert h.done
+    assert all((a == b).all() for a, b in zip(out, got))
+    assert eng._scheduler is not None and eng._scheduler.cache.total_allocs == len(PROMPTS)
+
+
+def test_queue_saturation_and_slot_reuse(baseline):
+    """More requests than slots: the queue drains through slot reuse, every
+    request completes, and the pool ends empty."""
+    params, out = baseline
+    eng = make_sched_engine(params, num_slots=2)
+    sched = eng.scheduler()
+    handles = [sched.submit(PROMPTS[i % 2], max_new_tokens=8) for i in range(7)]
+    # saturated: only num_slots admitted, the rest queued
+    sched.step()
+    assert sched.cache.active_slots <= 2 and len(sched.queue) >= 3
+    results = [h.result() for h in handles]
+    for i, r in enumerate(results):
+        assert (r == out[i % 2]).all(), f"request {i} corrupted by slot reuse"
+    assert sched.cache.active_slots == 0 and not sched.queue
+    assert sched.cache.total_allocs == 7 and sched.cache.total_frees == 7
+
+
+def test_eos_evicts_mid_loop(baseline):
+    """Rows finishing at different steps (EOS hit, length budget, full run)
+    evict at token-iteration granularity; freed slots admit queued requests
+    before the next decode step."""
+    params, out = baseline
+    eng = make_sched_engine(params, num_slots=2)
+    sched = eng.scheduler()
+    eos0 = int(out[0][0])  # greedy row 0 emits this immediately
+    hs = [sched.submit(PROMPTS[0], max_new_tokens=8, eos_token_id=eos0),
+          sched.submit(PROMPTS[1], max_new_tokens=3),  # length budget at step 3
+          sched.submit(PROMPTS[1], max_new_tokens=8),  # queued behind the first two
+          sched.submit(PROMPTS[0], max_new_tokens=8, eos_token_id=int(out[1][0]))]
+    r0 = hs[0].result()
+    assert r0[-1] == eos0 and len(r0) == 1  # evicted after its first token
+    assert (hs[1].result() == out[1][:3]).all()
+    # served on reused slots, bit-identical to the static path
+    assert (hs[2].result() == out[1]).all()
+    assert (hs[3].result() == out[0]).all()  # eos never hit: full 8 tokens
+    assert sched.cache.active_slots == 0 and sched.cache.total_frees == 4
+
+
+def test_slot_reuse_bit_identical_logits(baseline):
+    """The same request run solo vs late in a busy mixed stream must produce
+    BIT-identical per-step logits (slot reuse and batch composition must not
+    leak into any row's math)."""
+    params, _ = baseline
+    eng = make_sched_engine(params, num_slots=2, collect_logits=True)
+    sched = eng.scheduler()
+    solo = sched.submit(PROMPTS[0], max_new_tokens=6)
+    solo_logits = solo.result_logits()
+    # busy stream: different prompts in flight, then the same request again —
+    # admitted onto a reused slot
+    filler = [sched.submit(PROMPTS[1], max_new_tokens=7) for _ in range(3)]
+    again = sched.submit(PROMPTS[0], max_new_tokens=6)
+    again_logits = again.result_logits()
+    for h in filler:
+        h.result()
+    assert (solo.result() == again.result()).all()
+    np.testing.assert_array_equal(solo_logits, again_logits)
+
+
+def test_sampling_reproducible_and_slot_independent(baseline):
+    """Seeded sampling is a function of (seed, step), not slot or batch
+    composition: the same request re-submitted into a busy pool repeats."""
+    params, _ = baseline
+    eng = make_sched_engine(params, num_slots=3)
+    sched = eng.scheduler()
+    kw = dict(max_new_tokens=6, do_sample=True, temperature=0.7, top_k=20, top_p=0.9,
+              seed=11)
+    a = sched.submit(PROMPTS[0], **kw).result()
+    filler = [sched.submit(PROMPTS[1], max_new_tokens=5) for _ in range(2)]
+    b = sched.submit(PROMPTS[0], **kw).result()
+    for h in filler:
+        h.result()
+    assert (a == b).all()
+    # and mixed greedy/sampled rows share one decode program
+    assert ("decode", True, False, sched.steps_per_sync) in sched._compiled
+
+
+def test_scheduler_kernel_inject_matches_xla(baseline):
+    """The paged Pallas decode kernel path == the XLA slot path."""
+    params, _ = baseline
+    eng_x = make_sched_engine(params)
+    got_x = [h.result() for h in
+             [eng_x.scheduler().submit(p, max_new_tokens=8) for p in PROMPTS]]
+    eng_k = make_sched_engine(params, replace_with_kernel_inject=True)
+    assert eng_k.model_config.attention_impl == "flash"
+    got_k = [h.result() for h in
+             [eng_k.scheduler().submit(p, max_new_tokens=8) for p in PROMPTS]]
+    assert all((a == b).all() for a, b in zip(got_x, got_k))
+
+
+def test_steps_per_sync_invariant(baseline):
+    """Multi-step scheduling must not change results: K=1 (pure
+    iteration-level) and K=3 (budget not a multiple of K) produce identical
+    tokens for greedy AND seeded sampling."""
+    params, out = baseline
+    outs = []
+    for k in (1, 3):
+        eng = make_sched_engine(params, num_slots=2)
+        sched = eng.scheduler(steps_per_sync=k)
+        assert sched.steps_per_sync == k
+        hs = [sched.submit(PROMPTS[0], max_new_tokens=8),
+              sched.submit(PROMPTS[1], max_new_tokens=7, do_sample=True,
+                           temperature=0.8, top_k=15, seed=7)]
+        outs.append([h.result() for h in hs])
+    (g1, s1), (g3, s3) = outs
+    assert (g1 == out[0]).all() and (g1 == g3).all()
+    assert (s1 == s3).all() and len(s1) == 7
+
+
+def test_cancelled_handles_free_slots(baseline):
+    """Dropping an unfinished batch handle flags its requests; the next
+    scheduler iteration evicts them (no GC-time decode pumping) and their
+    slots serve the queue."""
+    params, out = baseline
+    eng = make_sched_engine(params, num_slots=2)
+    sched = eng.scheduler()
+    abandoned = eng.submit([PROMPTS[0], PROMPTS[1]], max_new_tokens=64)
+    sched.step()  # both admitted, mid-generation
+    assert sched.cache.active_slots == 2
+    del abandoned  # __del__ cancels, must not run the decode loop
+    import gc
+    gc.collect()
+    assert sched.cache.active_slots == 2  # nothing mutated from GC
+    kept = sched.submit(PROMPTS[0], max_new_tokens=8)
+    got = kept.result()  # pump: reaps the cancelled pair, then serves
+    assert (got == out[0]).all()
+    assert sched.cache.active_slots == 0 and not sched.queue
+
+
+def test_request_too_long_rejected(baseline):
+    params, _ = baseline
+    eng = make_sched_engine(params)
+    sched = eng.scheduler()
+    with pytest.raises(ValueError, match="cache rows"):
+        sched.submit(list(range(1, 100)), max_new_tokens=sched.max_len)
+
+
+def test_edge_budgets_and_seeds(baseline):
+    """Static-path parity at the boundaries: zero budget returns zero
+    tokens (no slot consumed); negative seeds are accepted (masked to
+    uint32) and stay reproducible."""
+    params, _ = baseline
+    eng = make_sched_engine(params)
+    sched = eng.scheduler()
+    h = sched.submit(PROMPTS[0], max_new_tokens=0)
+    assert h.done and len(h.result()) == 0
+    assert sched.cache.total_allocs == 0
+    a = sched.submit(PROMPTS[0], max_new_tokens=5, do_sample=True, seed=-3).result()
+    b = sched.submit(PROMPTS[0], max_new_tokens=5, do_sample=True, seed=-3).result()
+    assert (a == b).all() and len(a) == 5
+    assert sched.cache.active_slots == 0  # nothing stranded
+
+
+def test_compile_count_bounded_on_mixed_stream(baseline):
+    """Compile-count regression guard: a mixed-length request stream must
+    stay within the bucketed bound — one decode program plus one prefill
+    program per power-of-two bucket — measured by actual XLA backend
+    compiles (jax.monitoring), not just the scheduler's own cache."""
+    params, _ = baseline
+    eng = make_sched_engine(params, num_slots=3)
+    sched = eng.scheduler()
+    compiles = []
+    jax.monitoring.register_event_duration_secs_listener(
+        lambda name, *a, **kw: compiles.append(name)
+        if name == "/jax/core/compile/backend_compile_duration" else None)
+    n_before = len(compiles)
+    lens = [2, 3, 5, 9, 17, 33, 40, 50, 63, 64, 65, 70, 90, 100]
+    handles = [sched.submit(list(range(1, n + 1)), max_new_tokens=4) for n in lens]
+    for h in handles:
+        h.result()
+    n_compiles = len(compiles) - n_before
+    # buckets hit: 64 (lens<=64) and 128 (lens>64) -> 2 prefill programs +
+    # 1 greedy decode program; allow slack of 1 for cache-init style helpers
+    assert sched.compiled_program_count() <= 3
+    assert n_compiles <= 4, f"XLA compiled {n_compiles} programs for a mixed stream"
+    # and the stream produced sane output
+    assert all(len(h.result()) == 4 for h in handles)
+
+
+def test_telemetry_gauges_and_counters(tmp_path, baseline):
+    """Scheduler wires occupancy/batch-efficiency gauges, admitted/evicted
+    counters, and TTFT/step histograms into the PR-1 sink."""
+    params, _ = baseline
+    eng = make_sched_engine(params, num_slots=2,
+                            telemetry={"enabled": True, "output_path": str(tmp_path)})
+    sched = eng.scheduler()
+    hs = [sched.submit(PROMPTS[i % 2], max_new_tokens=5) for i in range(4)]
+    for h in hs:
+        h.result()
+    tel = eng.telemetry
+    assert tel.counter_total("serving/admitted") == 4
+    assert tel.counter_total("serving/evicted") == 4
+    assert tel.counter_total("serving/decode_tokens") > 0
+    tel.flush()
+    text = (tmp_path / "telemetry.jsonl").read_text()
+    for name in ("serving/slot_occupancy", "serving/batch_efficiency",
+                 "serving/kv_token_utilization", "serving/ttft_ms", "serving/step_ms"):
+        assert name in text, f"{name} missing from telemetry stream"
+
+
+def test_abandoned_submit_handle_never_raises(baseline):
+    """_Handle.__del__ must settle the queue-depth gauge and never raise —
+    even when the handle is dropped without result() (satellite: teardown
+    safety)."""
+    params, _ = baseline
+    eng = make_engine(params=params, telemetry={"enabled": False})
+    eng.telemetry.enabled = True  # force the gauge-accounting path
+    h = eng.submit(PROMPTS, max_new_tokens=4)
+    assert eng._inflight == 1
+    del h
+    import gc
+    gc.collect()
+    assert eng._inflight == 0
+    # and a half-torn-down handle is silent: break the settle path the way
+    # interpreter teardown does (globals gone) and call __del__ directly —
+    # the exception must be swallowed, not propagated
+    h2 = eng.submit(PROMPTS, max_new_tokens=4)
+    h2._settle = lambda: (_ for _ in ()).throw(RuntimeError("teardown"))
+    h2.__del__()  # must not raise
+    h2._accounted = True  # neutralize the real deletion's accounting
