@@ -65,6 +65,17 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
                                  "amortizes dispatch/fetch K-fold; admission/eviction "
                                  "granularity becomes K tokens; results identical for "
                                  "any K (sampling keys use absolute step indices)")
+    prefill_chunk = ConfigField(default=64, help="chunked prefill (Sarathi-Serve): "
+                                "admission feeds at most this many prompt tokens per "
+                                "fused chunk+decode step, so live decode rows stall one "
+                                "chunk instead of a whole prompt (smaller = better "
+                                "decode p95, worse TTFT); 0 restores the monolithic "
+                                "pow2-bucketed prefill path")
+    prefix_cache = ConfigField(default=True, help="radix prefix cache (SGLang "
+                               "RadixAttention): retain finished slots' prompt KV in a "
+                               "token trie and seed new requests from the longest "
+                               "matched prefix (LRU eviction when admission needs a "
+                               "slot); chunked-prefill mode only")
 
 
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):

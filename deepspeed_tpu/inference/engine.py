@@ -449,7 +449,9 @@ class InferenceEngine:
             kw = {"num_slots": cb.num_slots, "max_len": cb.max_len,
                   "prefill_bucket": cb.prefill_bucket,
                   "collect_logits": cb.collect_logits,
-                  "steps_per_sync": cb.steps_per_sync}
+                  "steps_per_sync": cb.steps_per_sync,
+                  "prefill_chunk": cb.prefill_chunk,
+                  "prefix_cache": cb.prefix_cache}
             kw.update(overrides)
             self._scheduler = DecodeScheduler(self, **kw)
         elif overrides:

@@ -1,4 +1,5 @@
-"""Slot-based paged KV cache for continuous-batching decode.
+"""Slot-based paged KV cache for continuous-batching decode, plus the radix
+prefix cache that reuses it across requests.
 
 The Orca/vLLM lesson translated to XLA: instead of allocating a fresh
 (B, S) cache per request shape (the static-batch engine path), serving keeps
@@ -22,6 +23,16 @@ tokens, not pool capacity — the paged Pallas kernel
 only up to the longest live row, and per-slot ends mask the tail. Pages of
 ``page_size`` tokens are the accounting unit the occupancy gauges report.
 
+Cross-request KV reuse (SGLang RadixAttention translated to the slot pool):
+a finished request's slot is RETAINED instead of scrubbed — its prompt
+prefix stays registered in a token trie (:class:`RadixPrefixCache`) and the
+slot moves to the ``cached`` state. Admission walks the trie, copies the
+longest matched prefix's KV rows from the donor slot into the new slot
+(:func:`copy_slot` — one compiled program for any src/dst pair), and only
+prefills the suffix. Cached slots are reclaimed LRU-first when the free
+list runs dry. Reference counts (`refs`) track trie registrations per slot;
+a slot is only reclaimable once the trie drops its last reference.
+
 Host-side state lives here; the compiled prefill/decode programs that read
 and write the pool live in :mod:`deepspeed_tpu.inference.scheduler`.
 """
@@ -32,7 +43,14 @@ import jax
 
 
 class SlotKVCache:
-    """Fixed pool of KV cache slots + free-list allocation.
+    """Fixed pool of KV cache slots + free-list allocation with three slot
+    states:
+
+    - ``free``   — no meaningful contents; on the free list.
+    - ``active`` — owned by a live request (prefilling or decoding).
+    - ``cached`` — released by its request but holding a retained prefix the
+      radix cache still references (``refs[slot] > 0``); not allocatable
+      until :meth:`reclaim` (radix eviction) returns it to the free list.
 
     ``pool`` is the device-side cache tree (``model.init_cache(num_slots,
     max_len)``); it is REPLACED by the scheduler after every compiled step
@@ -45,6 +63,8 @@ class SlotKVCache:
         self.max_len = int(max_len)
         self.page_size = int(page_size)
         self.lengths = np.zeros(self.num_slots, np.int32)  # live tokens per slot
+        self.state = ["free"] * self.num_slots
+        self.refs = np.zeros(self.num_slots, np.int32)  # trie references
         self._free = list(range(self.num_slots - 1, -1, -1))  # pop() -> slot 0 first
         self._owner = [None] * self.num_slots  # request id per slot (debugging)
         self.total_allocs = 0
@@ -52,28 +72,55 @@ class SlotKVCache:
 
     # ------------------------------------------------------------------ alloc
     def alloc(self, owner=None):
-        """Claim a free slot (lowest index first) or return None when the
-        pool is saturated. The slot's length row resets to 0; stale cache
-        contents need no scrub — the prefill overwrites ``[0, len)`` and
-        per-slot ends mask everything past the write head."""
+        """Claim a free slot (lowest index first) or return None when no
+        slot is on the free list (cached slots need a :meth:`reclaim`
+        first). The slot's length row resets to 0; stale cache contents need
+        no scrub — the prefill overwrites ``[0, len)`` and per-slot ends
+        mask everything past the write head."""
         if not self._free:
             return None
         slot = self._free.pop()
         self.lengths[slot] = 0
+        self.state[slot] = "active"
         self._owner[slot] = owner
         self.total_allocs += 1
         return slot
 
     def free(self, slot):
-        """Return ``slot`` to the pool (eviction at token-iteration
-        granularity: the scheduler calls this the moment a sequence
-        finishes, mid-decode-loop)."""
-        if slot in self._free:
-            raise ValueError(f"double free of slot {slot}")
+        """Return an active ``slot`` to the pool (eviction at
+        token-iteration granularity: the scheduler calls this the moment a
+        sequence finishes, mid-decode-loop)."""
+        if self.state[slot] != "active":
+            raise ValueError(f"double free of slot {slot} (state {self.state[slot]})")
         self.lengths[slot] = 0
+        self.state[slot] = "free"
         self._owner[slot] = None
         self._free.append(slot)
         self.total_frees += 1
+
+    def retain(self, slot):
+        """Release an active slot WITHOUT scrubbing: its prefix KV stays
+        resident for radix reuse (state ``cached``). Counts as a free for
+        the alloc/free ledger — the request released it — but the slot
+        stays off the free list until :meth:`reclaim`."""
+        if self.state[slot] != "active":
+            raise ValueError(f"retain of non-active slot {slot} (state {self.state[slot]})")
+        if self.refs[slot] <= 0:
+            raise ValueError(f"retain of slot {slot} with no trie reference")
+        self.state[slot] = "cached"
+        self._owner[slot] = None
+        self.total_frees += 1
+
+    def reclaim(self, slot):
+        """Cached -> free: the radix cache evicted the slot's last
+        reference; its rows are garbage from here on."""
+        if self.state[slot] != "cached":
+            raise ValueError(f"reclaim of non-cached slot {slot} (state {self.state[slot]})")
+        if self.refs[slot] != 0:
+            raise ValueError(f"reclaim of slot {slot} still holding {self.refs[slot]} refs")
+        self.lengths[slot] = 0
+        self.state[slot] = "free"
+        self._free.append(slot)
 
     def fits(self, prompt_len, max_new_tokens):
         """Would a request of this shape ever fit a slot?"""
@@ -82,29 +129,77 @@ class SlotKVCache:
     # ------------------------------------------------------------------ stats
     @property
     def active_slots(self):
-        return self.num_slots - len(self._free)
+        """Slots owned by LIVE requests (cached prefix slots don't count —
+        they hold no in-flight sequence)."""
+        return sum(1 for s in self.state if s == "active")
+
+    @property
+    def cached_slots(self):
+        return sum(1 for s in self.state if s == "cached")
+
+    @property
+    def free_slots(self):
+        return len(self._free)
 
     def occupancy(self):
         """Fraction of slots holding live sequences."""
         return self.active_slots / self.num_slots
 
+    def _tokens(self, state):
+        return int(sum(int(self.lengths[i]) for i in range(self.num_slots)
+                       if self.state[i] == state))
+
     def live_tokens(self):
-        """Total live KV rows across the pool."""
-        return int(self.lengths.sum())
+        """Total KV rows backing ACTIVE slots."""
+        return self._tokens("active")
+
+    def cached_tokens(self):
+        """Total KV rows retained in cached prefix slots."""
+        return self._tokens("cached")
+
+    def _pages(self, state):
+        p = self.page_size
+        return int(sum((int(self.lengths[i]) + p - 1) // p
+                       for i in range(self.num_slots) if self.state[i] == state))
 
     def live_pages(self):
-        """Allocated pages (``page_size``-token blocks) backing live rows —
+        """Allocated pages (``page_size``-token blocks) backing active rows —
         the unit the paged decode kernel walks."""
-        return int(np.sum((self.lengths + self.page_size - 1) // self.page_size))
+        return self._pages("active")
+
+    def cached_pages(self):
+        """Pages backing retained (shared-prefix) rows."""
+        return self._pages("cached")
 
     def token_utilization(self):
-        """live tokens / pool capacity: how much of the fixed-shape pool is
-        doing useful work (the memory-efficiency gauge; the static-batch
-        path's equivalent is live/(B*S) and decays with padding)."""
-        return self.live_tokens() / float(self.num_slots * self.max_len)
+        """(live + retained) tokens / pool capacity: how much of the
+        fixed-shape pool is doing useful work — decoding or standing by as a
+        reusable prefix (the static-batch path's equivalent is live/(B*S)
+        and decays with padding)."""
+        return ((self.live_tokens() + self.cached_tokens())
+                / float(self.num_slots * self.max_len))
 
     def max_live_len(self):
         return int(self.lengths.max()) if self.num_slots else 0
+
+    def check_invariants(self):
+        """Every slot is in exactly one state; the free list matches the
+        state row; refs only on active/cached slots. Raises on drift (the
+        eviction-storm tests call this after every operation)."""
+        if sorted(self._free) != sorted(i for i, s in enumerate(self.state)
+                                        if s == "free"):
+            raise AssertionError(f"free list {sorted(self._free)} != free states")
+        if len(set(self._free)) != len(self._free):
+            raise AssertionError("duplicate slots on the free list")
+        for i, s in enumerate(self.state):
+            if s == "free" and (self.lengths[i] != 0 or self.refs[i] != 0):
+                raise AssertionError(f"free slot {i} holds rows/refs")
+            if s == "cached" and self.refs[i] <= 0:
+                raise AssertionError(f"cached slot {i} holds no reference")
+            if self.refs[i] < 0:
+                raise AssertionError(f"negative refcount on slot {i}")
+        if self.active_slots + self.cached_slots + self.free_slots != self.num_slots:
+            raise AssertionError("slot states don't partition the pool")
 
 
 def slot_slice(pool, slot):
@@ -123,3 +218,189 @@ def slot_update(pool, slot, slot_cache):
         lambda p, c: jax.lax.dynamic_update_slice_in_dim(p, c.astype(p.dtype), slot,
                                                          axis=p.ndim - 4),
         pool, slot_cache)
+
+
+def copy_slot(pool, src, dst):
+    """Pure function: duplicate slot ``src``'s cache rows into slot ``dst``
+    (radix prefix hit: the donor's retained prefix seeds the new request's
+    slot, so only the suffix needs prefilling). Copies the FULL slot — rows
+    past the matched prefix are garbage either way (per-slot ends mask
+    them until later writes land) and a full copy keeps this ONE compiled
+    program for every (src, dst, match-length) combination."""
+    return slot_update(pool, dst, slot_slice(pool, src))
+
+
+class _RadixNode:
+    __slots__ = ("edge", "children", "slots", "parent")
+
+    def __init__(self, edge=(), parent=None):
+        self.edge = edge        # token tuple on the edge INTO this node
+        self.children = {}      # first token of child edge -> child node
+        self.slots = set()      # slots whose retained prefix ends here
+        self.parent = parent
+
+
+class RadixPrefixCache:
+    """Token trie (path-compressed radix tree) over retained prompt
+    prefixes, SGLang-RadixAttention-style, mapped onto the slot pool:
+
+    - :meth:`insert` registers a slot's full prompt once its prefill
+      completes (live AND finished slots serve as donors — prefill rows are
+      never rewritten during decode, so a mid-decode donor is stable).
+    - :meth:`match` walks the longest shared prefix of a new prompt and
+      returns ``(matched_len, donor_slot)``; the scheduler copies the
+      donor's rows and chunk-prefills only the suffix.
+    - :meth:`evict_lru` drops the least-recently-used CACHED slot's
+      registration (active slots are pinned by their request) so the
+      scheduler can :meth:`SlotKVCache.reclaim` it for admission.
+
+    Each registration holds one reference in ``kv.refs``; eviction releases
+    it. ``hits``/``misses``/``evictions`` feed the
+    ``serving/prefix_cache_*`` telemetry.
+    """
+
+    def __init__(self, kv):
+        self.kv = kv
+        self.root = _RadixNode()
+        self._slot_node = {}   # slot -> registration node
+        self._slot_len = {}    # slot -> retained prefix length
+        self._lru = {}         # slot -> last-use tick (monotonic)
+        self._tick = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # ------------------------------------------------------------------ core
+    def _touch(self, slot):
+        self._tick += 1
+        self._lru[slot] = self._tick
+
+    @staticmethod
+    def _common(edge, tokens, depth):
+        n = min(len(edge), len(tokens) - depth)
+        m = 0
+        while m < n and edge[m] == tokens[depth + m]:
+            m += 1
+        return m
+
+    def insert(self, slot, tokens):
+        """Register ``slot`` as holding KV for the full ``tokens`` prefix.
+        One registration per slot (re-registering raises: a slot must be
+        evicted/freed before it can carry a different prefix)."""
+        if slot in self._slot_node:
+            raise ValueError(f"slot {slot} already registered in the prefix trie")
+        tokens = tuple(int(t) for t in tokens)
+        node, depth = self.root, 0
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                new = _RadixNode(edge=tokens[depth:], parent=node)
+                node.children[tokens[depth]] = new
+                node, depth = new, len(tokens)
+                break
+            m = self._common(child.edge, tokens, depth)
+            if m < len(child.edge):
+                # split the edge at the divergence/exhaustion point
+                mid = _RadixNode(edge=child.edge[:m], parent=node)
+                node.children[tokens[depth]] = mid
+                child.edge = child.edge[m:]
+                child.parent = mid
+                mid.children[child.edge[0]] = child
+                node, depth = mid, depth + m
+            else:
+                node, depth = child, depth + m
+        node.slots.add(slot)
+        self._slot_node[slot] = node
+        self._slot_len[slot] = len(tokens)
+        self.kv.refs[slot] += 1
+        self._touch(slot)
+
+    def match(self, tokens):
+        """Longest registered prefix of ``tokens``: returns
+        ``(matched_len, donor_slot)`` or ``(0, None)``. Any slot in the
+        deepest matched node's subtree shares at least ``matched_len``
+        tokens with the prompt (most recently used wins)."""
+        tokens = tuple(int(t) for t in tokens)
+        node, depth = self.root, 0
+        while depth < len(tokens):
+            child = node.children.get(tokens[depth])
+            if child is None:
+                break
+            m = self._common(child.edge, tokens, depth)
+            depth += m
+            node = child
+            if m < len(child.edge):
+                break  # partial edge: child's subtree still shares `depth`
+        if depth == 0:
+            return 0, None
+        donor = self._best_slot(node)
+        if donor is None:  # pruning keeps subtrees non-empty; belt&braces
+            return 0, None
+        return min(depth, self._slot_len[donor]), donor
+
+    def _best_slot(self, node):
+        """Most-recently-used slot registered in ``node``'s subtree."""
+        best, best_tick = None, -1
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            for s in n.slots:
+                if self._lru.get(s, 0) > best_tick:
+                    best, best_tick = s, self._lru.get(s, 0)
+            stack.extend(n.children.values())
+        return best
+
+    def touch(self, slot):
+        """LRU bump on a prefix hit."""
+        if slot in self._slot_node:
+            self._touch(slot)
+
+    def remove(self, slot):
+        """Drop ``slot``'s registration (and its trie reference), pruning
+        now-empty branches."""
+        node = self._slot_node.pop(slot, None)
+        if node is None:
+            return False
+        node.slots.discard(slot)
+        del self._slot_len[slot]
+        self._lru.pop(slot, None)
+        self.kv.refs[slot] -= 1
+        # prune childless, slotless nodes up the path
+        while node is not self.root and not node.slots and not node.children:
+            parent = node.parent
+            del parent.children[node.edge[0]]
+            node = parent
+        return True
+
+    def evict_lru(self, prefer_not=None):
+        """Evict the least-recently-used CACHED registration and return its
+        slot (caller reclaims it), or None when nothing is evictable
+        (every registered slot still serves a live request).
+
+        ``prefer_not``: a slot to spare when any other candidate exists —
+        the scheduler passes the incoming prompt's matched donor so an
+        eviction-for-admission doesn't destroy the very prefix it is about
+        to copy (the donor falls only when it is the sole cached slot, in
+        which case it becomes the admitted slot and its rows survive)."""
+        candidates = [s for s in self._slot_node
+                      if self.kv.state[s] == "cached"]
+        if not candidates:
+            return None
+        spared = [s for s in candidates if s != prefer_not]
+        victim = min(spared or candidates, key=lambda s: self._lru.get(s, 0))
+        self.remove(victim)
+        self.evictions += 1
+        return victim
+
+    def registered_len(self, slot):
+        """Token length of ``slot``'s registered prefix (0 if unregistered)
+        — the rows still useful for reuse once the slot's request ends."""
+        return self._slot_len.get(slot, 0)
+
+    # ------------------------------------------------------------------ stats
+    def hit_rate(self):
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def registered_slots(self):
+        return sorted(self._slot_node)

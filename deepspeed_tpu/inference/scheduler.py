@@ -1,17 +1,42 @@
-"""Continuous-batching decode scheduler (iteration-level scheduling).
+"""Continuous-batching decode scheduler (iteration-level scheduling) with
+chunked prefill fused into the decode step and a radix prefix cache.
 
 The Orca/vLLM serving loop on JAX/XLA: queued requests are admitted into
 free KV-cache slots at TOKEN-ITERATION granularity — a finished sequence
 evicts mid-loop and the next queued request joins the very next decode step,
-without recompiling anything. The static-batch engine path compiles one
-whole-decode-loop program per (batch, prompt-bucket, sampling) shape and
-serializes concurrent requests; this scheduler compiles
+without recompiling anything.
 
-- ONE decode-step program over the fixed slot pool (two with sampling:
-  a greedy and a sampling variant), and
-- one single-request prefill program per prompt-length BUCKET (powers of
-  two from 64), bounding total compile count at ``log2(S/64) + 2``-ish
-  regardless of the request mix.
+**Chunked prefill (Sarathi-Serve, default)**: admission never runs a
+monolithic whole-prompt prefill. Each scheduler iteration with a prefill in
+flight dispatches ONE fixed-shape fused program over ``(num_slots,
+prefill_chunk)`` query columns: live decode rows carry their single next
+token in column 0, the (at most one) in-flight prefill row carries up to
+``prefill_chunk`` prompt tokens, and per-row query spans mask the rest —
+then finishes the sync with the remaining ``steps_per_sync - 1`` decode
+steps in one on-device loop, so decode keeps its K-step dispatch
+amortization even while prefills chain back-to-back. Decode slots
+therefore stall at most one chunk's compute per K tokens instead of a full
+prompt, TTFT/decode-p95 trade off via ``prefill_chunk``, and the compiled
+program count is O(1) in the prompt-length mix (no per-bucket prefills).
+``prefill_chunk=0`` restores the legacy monolithic pow2-bucketed prefill
+path.
+
+**Radix prefix cache (SGLang RadixAttention)**: finished slots are retained
+(not scrubbed) and their prompts registered in a token trie
+(:class:`~deepspeed_tpu.inference.kv_cache.RadixPrefixCache`). Admission
+walks the trie, copies the longest matched prefix's KV rows from the donor
+slot (one compiled ``copy_slot`` program), and chunk-prefills only the
+suffix; matches round DOWN to a ``prefill_chunk`` multiple so hit and cold
+paths run identical chunk boundaries — cache-hit logits are bit-identical
+to a cold prefill. Cached slots are reclaimed LRU-first when admission
+needs a slot.
+
+Compiled programs: ONE step program (:meth:`DecodeScheduler._fused_fn`) in
+a few variants — width ``prefill_chunk`` for chunk syncs and width 1 for
+pure decode syncs, two step counts (K, and 1 for chunks with nothing to
+decode), each x greedy/sampling x logits collection — plus the slot-copy
+program. O(1) total regardless of the request mix, and fused-vs-decode
+results can never diverge because they share one step body.
 
 Per-slot sampling parameters (do_sample / temperature / top_k / top_p) are
 runtime TENSORS, so requests with different sampling configs share one
@@ -19,19 +44,21 @@ program. Sampling keys derive from ``fold_in(key(seed), step)`` per slot —
 a request's tokens are reproducible no matter which slot it lands in or
 what else is in flight.
 
-Each host round trip runs ``steps_per_sync`` decode steps in one on-device
-loop and fetches a (K, num_slots) token block (multi-step scheduling, the
-vLLM ``--num-scheduler-steps`` trick): dispatch + fetch amortize K-fold, at
-the cost of K-token admission/eviction granularity (K=1 recovers pure
-iteration-level scheduling; results are identical for any K). EOS
-detection, admission, and eviction are host-side bookkeeping on the
-fetched block.
+Each host round trip with no prefill in flight runs ``steps_per_sync``
+decode steps in one on-device loop and fetches a (K, num_slots) token block
+(multi-step scheduling, the vLLM ``--num-scheduler-steps`` trick): dispatch
++ fetch amortize K-fold, at the cost of K-token admission/eviction
+granularity (K=1 recovers pure iteration-level scheduling; results are
+identical for any K). EOS detection, admission, and eviction are host-side
+bookkeeping on the fetched block.
 
 Telemetry (PR-1 sink): gauges ``serving/slot_occupancy``,
-``serving/batch_efficiency``, ``serving/kv_token_utilization``; counters
-``serving/admitted``, ``serving/evicted``, ``serving/decode_steps``,
-``serving/decode_tokens``; histograms ``serving/ttft_ms``,
-``serving/step_ms``, ``serving/tokens_per_step``.
+``serving/batch_efficiency``, ``serving/kv_token_utilization``,
+``serving/prefix_cache_hit_rate``; counters ``serving/admitted``,
+``serving/evicted``, ``serving/decode_steps``, ``serving/decode_tokens``,
+``serving/prefix_cache_{hit,miss,evict}``; histograms ``serving/ttft_ms``,
+``serving/step_ms``, ``serving/tokens_per_step``,
+``serving/prefill_stall_ms``.
 """
 
 import collections
@@ -41,7 +68,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .engine import _round_up
-from .kv_cache import SlotKVCache, slot_slice, slot_update
+from .kv_cache import RadixPrefixCache, SlotKVCache, copy_slot, slot_slice, slot_update
 
 
 def _bucket_len(n, base, cap):
@@ -143,16 +170,35 @@ class SchedulerHandle:
         return np.zeros((0, V), np.float32)
 
 
+class _PrefillState:
+    """The (at most one) in-flight chunked prefill: ``pos`` is the next
+    prompt position to feed — rows ``[0, pos)`` of the slot already hold KV
+    (prefix-cache copy and/or earlier chunks)."""
+
+    __slots__ = ("req", "pos")
+
+    def __init__(self, req, pos):
+        self.req = req
+        self.pos = pos
+
+
 class DecodeScheduler:
     """Continuous-batching serving loop over an :class:`InferenceEngine`.
 
     ``num_slots`` fixes the decode batch (the pool shape XLA compiles
     against); ``max_len`` is the per-slot KV capacity. Requests whose
     ``prompt + max_new_tokens`` exceed ``max_len`` are rejected at submit.
+
+    ``prefill_chunk`` > 0 (default) fuses admission into the decode step in
+    chunks of that many prompt tokens (see module docstring); 0 restores
+    the legacy monolithic pow2-bucketed prefill. ``prefix_cache`` retains
+    finished prefixes for cross-request KV reuse (chunked mode only: reuse
+    rounds matches to chunk boundaries to keep hit/cold paths bit-identical).
     """
 
     def __init__(self, engine, num_slots=8, max_len=None, prefill_bucket=64,
-                 collect_logits=False, steps_per_sync=4):
+                 collect_logits=False, steps_per_sync=4, prefill_chunk=64,
+                 prefix_cache=True):
         self.engine = engine
         model = engine.module
         cfg = engine._config
@@ -183,8 +229,16 @@ class DecodeScheduler:
         # scheduling). Token/logits results are IDENTICAL for any K:
         # sampling keys fold in the absolute step index.
         self.steps_per_sync = max(1, int(steps_per_sync))
+        # chunked prefill: clamp the chunk to the slot capacity (a chunk
+        # wider than a slot could never land a full write)
+        self.prefill_chunk = min(max(0, int(prefill_chunk)), S)
         self.cache = SlotKVCache(engine._init_cache(int(num_slots), S),
                                  int(num_slots), S, page_size=min(block, S))
+        # radix prefix cache: chunked-mode only — reuse rounds matches to
+        # chunk boundaries so a hit replays the cold path's exact programs
+        self.radix = (RadixPrefixCache(self.cache)
+                      if prefix_cache and self.prefill_chunk > 0 else None)
+        self._prefill = None  # at most one in-flight _PrefillState
         self.queue = collections.deque()
         self.active = {}  # slot -> _Request
         self._compiled = {}
@@ -203,6 +257,15 @@ class DecodeScheduler:
                        self.collect_logits if collect_logits is None else collect_logits,
                        tel.now())
         self._rid += 1
+        # validate the PROMPT alone up front (before any early return): a
+        # prompt that can never fit a slot must fail here with a clear
+        # message, not deep inside a compiled prefill
+        if req.prompt.size >= self.max_len:
+            raise ValueError(
+                f"prompt of {req.prompt.size} tokens exceeds the per-slot KV capacity "
+                f"{self.max_len} (a prompt needs at least one row of decode headroom); "
+                f"raise the scheduler's max_len / the engine's max_out_tokens, or "
+                f"shorten the prompt")
         if req.max_new_tokens <= 0:  # static-path parity: zero-budget -> no tokens
             req.done = True
             return SchedulerHandle(self, req)
@@ -221,7 +284,7 @@ class DecodeScheduler:
 
     def drain(self):
         """Run until every queued/active request finishes."""
-        while self.queue or self.active:
+        while self.queue or self.active or self._prefill is not None:
             self.step()
 
     @property
@@ -230,51 +293,170 @@ class DecodeScheduler:
 
     # ------------------------------------------------------------------ loop
     def step(self):
-        """One scheduler iteration: settle cancellations, admit while slots
-        are free, then advance every live sequence one token."""
+        """One scheduler iteration: settle cancellations, admit (chunked: at
+        most one in-flight prefill; legacy: while slots are free), then
+        advance — one fused chunk+decode step while a prefill is in flight,
+        else ``steps_per_sync`` decode steps."""
         tel = self.telemetry
         t0 = tel.now()
         self._reap_cancelled()
         admitted = 0
-        while self.queue and self.cache.active_slots < self.cache.num_slots:
-            req = self.queue.popleft()
-            if req.cancelled:
-                req.done = True
-                continue
-            self._admit(req)
-            admitted += 1
+        if self.prefill_chunk > 0:
+            while self.queue and self.queue[0].cancelled:
+                self.queue.popleft().done = True
+            if self._prefill is None and self.queue:
+                slot, match = self._acquire_slot(self.queue[0])
+                if slot is not None:
+                    self._begin_prefill(self.queue.popleft(), slot, match)
+                    admitted = 1
+        else:
+            while self.queue and self.cache.active_slots < self.cache.num_slots:
+                req = self.queue.popleft()
+                if req.cancelled:
+                    req.done = True
+                    continue
+                self._admit(req)
+                admitted += 1
         if admitted and tel.enabled:
             tel.counter("serving/admitted", admitted)
-        if not self.active:
+        fused = self._prefill is not None
+        if fused:
+            delivered, ksteps = self._fused_chunk_step()
+        elif self.active:
+            delivered, ksteps = self._decode_step()
+        else:
             return 0
-        delivered = self._decode_step()
         if tel.enabled:
-            K = self.steps_per_sync
             dur_ms = (tel.now() - t0) * 1e3
-            tel.counter("serving/decode_steps", K)
+            tel.counter("serving/decode_steps", ksteps)
             tel.counter("serving/decode_tokens", delivered)
-            tel.histogram("serving/step_ms", dur_ms / K)
-            tel.histogram("serving/tokens_per_step", delivered / K)
+            tel.histogram("serving/step_ms", dur_ms / ksteps)
+            tel.histogram("serving/tokens_per_step", delivered / ksteps)
             tel.gauges([("serving/slot_occupancy", self.cache.occupancy(), None),
                         ("serving/batch_efficiency",
-                         delivered / (K * self.cache.num_slots), None),
+                         delivered / (ksteps * self.cache.num_slots), None),
                         ("serving/kv_token_utilization", self.cache.token_utilization(),
                          None)])
         return delivered
+
+    def _release_slot(self, slot):
+        """Return a finished/cancelled request's slot: retained (state
+        ``cached``) when the radix trie references its prefix, else freed.
+        Retained lengths clamp to the trie-registered prompt prefix — the
+        decode/substep rows past it (including K-step overshoot) are
+        garbage for reuse, and counting them would inflate
+        ``cached_tokens``/``kv_token_utilization``."""
+        if self.radix is not None and self.cache.refs[slot] > 0:
+            self.cache.lengths[slot] = min(int(self.cache.lengths[slot]),
+                                           self.radix.registered_len(slot))
+            self.cache.retain(slot)
+        else:
+            self.cache.free(slot)
 
     def _reap_cancelled(self):
         """Evict slots whose requests were cancelled (handle dropped). Runs
         only from step() — the single-threaded loop — so eviction never
         races an in-flight decode dispatch."""
+        tel = self.telemetry
         for slot, req in list(self.active.items()):
             if req.cancelled and not req.done:
                 req.done = True
                 del self.active[slot]
-                self.cache.free(slot)
-                if self.telemetry.enabled:
-                    self.telemetry.counter("serving/cancelled")
+                self._release_slot(slot)
+                if tel.enabled:
+                    tel.counter("serving/cancelled")
+        if self._prefill is not None and self._prefill.req.cancelled:
+            req = self._prefill.req
+            req.done = True
+            # mid-prefill slots are never trie-registered yet -> plain free
+            self._release_slot(req.slot)
+            self._prefill = None
+            if tel.enabled:
+                tel.counter("serving/cancelled")
 
     # ------------------------------------------------------------------ admit
+    def _acquire_slot(self, req):
+        """A free slot for admission plus the radix match for ``req``'s
+        prompt, matched BEFORE any eviction — reclaiming a cached slot drops
+        its trie registration, so matching after could lose the prompt's
+        only donor. When the free list is dry, reclaims the LRU cached
+        prefix slot, preferring victims other than the matched donor.
+        Returns ``(slot, (matched_len, donor))``; slot is None when every
+        slot serves a live request."""
+        match = (self.radix.match(req.prompt) if self.radix is not None
+                 else (0, None))
+        slot = self.cache.alloc(owner=req.rid)
+        if slot is None and self.radix is not None:
+            victim = self.radix.evict_lru(prefer_not=match[1])
+            if victim is not None:
+                self.cache.reclaim(victim)
+                if self.telemetry.enabled:
+                    self.telemetry.counter("serving/prefix_cache_evict")
+                slot = self.cache.alloc(owner=req.rid)
+        return slot, match
+
+    def _begin_prefill(self, req, slot, match=(0, None)):
+        """Start the chunked prefill for ``req`` on ``slot``: seed the slot
+        with the longest matched prefix (``match`` from :meth:`_acquire_slot`,
+        one compiled copy program) and leave the suffix to the fused chunk
+        steps.
+
+        Matches are capped at ``prompt - 1`` (the last prompt token must
+        run through the model to produce the first-token logits) and
+        rounded DOWN to a ``prefill_chunk`` multiple so the suffix replays
+        the cold path's exact chunk boundaries — a hit is bit-identical to
+        a cold prefill."""
+        tel = self.telemetry
+        req.slot = slot
+        pos = 0
+        if self.radix is not None:
+            m, donor = match
+            m = min(m, req.prompt.size - 1)
+            m = (m // self.prefill_chunk) * self.prefill_chunk
+            # the donor may have been the LRU victim reclaimed for this very
+            # admission (eviction only falls back to the donor when every
+            # other slot is live); its registration is gone, but the freed
+            # slot became OUR slot with the prefix rows still resident —
+            # src == dst makes the copy a no-op and the hit stands
+            if m > 0 and donor is not None and (
+                    donor == slot or donor in self.radix._slot_node):
+                if donor != slot:
+                    with self.engine.mesh:
+                        self.cache.pool = self._copy_fn()(
+                            self.cache.pool, jnp.asarray(donor, jnp.int32),
+                            jnp.asarray(slot, jnp.int32))
+                pos = m
+                self.radix.hits += 1
+                self.radix.touch(donor)
+                if tel.enabled:
+                    tel.counter("serving/prefix_cache_hit")
+                    tel.counter("serving/prefix_cache_hit_tokens", m)
+            else:
+                self.radix.misses += 1
+                if tel.enabled:
+                    tel.counter("serving/prefix_cache_miss")
+            if tel.enabled:
+                tel.gauge("serving/prefix_cache_hit_rate", self.radix.hit_rate())
+        self.cache.lengths[slot] = pos
+        self._prefill = _PrefillState(req, pos)
+
+    def _finish_prefill(self, req, tok, last_logits):
+        """The final chunk landed: deliver token 0, register the prompt in
+        the radix trie (live prefixes serve as donors too — prefill rows are
+        never rewritten during decode), and move the row to decode."""
+        tel = self.telemetry
+        self._prefill = None
+        self.active[req.slot] = req
+        if self.radix is not None:
+            self.radix.insert(req.slot, req.prompt)
+        req.first_token_ts = tel.now()
+        if tel.enabled:
+            tel.histogram("serving/ttft_ms", (req.first_token_ts - req.submit_ts) * 1e3)
+            tel.gauge("serving/queue_depth", len(self.queue))
+        if req.collect_logits and last_logits is not None:
+            req.logits.append(last_logits)
+        self._deliver(req, tok)
+
     def _admit(self, req):
         eng = self.engine
         slot = self.cache.alloc(owner=req.rid)
@@ -285,6 +467,7 @@ class DecodeScheduler:
         ids = np.zeros((1, Pb), np.int32)
         ids[0, :L] = req.prompt
         fn = self._prefill_fn(Pb, req.collect_logits)
+        t_pf = self.telemetry.now()
         try:
             with eng.mesh:
                 out = fn(eng.params, self.cache.pool, jnp.asarray(ids),
@@ -310,6 +493,9 @@ class DecodeScheduler:
         tel = self.telemetry
         req.first_token_ts = tel.now()
         if tel.enabled:
+            # monolithic prefill stalls every live decode row for the WHOLE
+            # prompt — the interference chunked prefill bounds at one chunk
+            tel.histogram("serving/prefill_stall_ms", (req.first_token_ts - t_pf) * 1e3)
             tel.histogram("serving/ttft_ms", (req.first_token_ts - req.submit_ts) * 1e3)
             tel.gauge("serving/queue_depth", len(self.queue))
         self._deliver(req, tok)
@@ -327,26 +513,28 @@ class DecodeScheduler:
             req.done = True
             if req.slot in self.active:
                 del self.active[req.slot]
-            self.cache.free(req.slot)
+            self._release_slot(req.slot)
             if self.telemetry.enabled:
                 self.telemetry.counter("serving/evicted")
 
     # ------------------------------------------------------------------ decode
-    def _decode_step(self):
-        eng = self.engine
+    def _gather_sampling(self, live):
+        """Per-slot sampling-parameter rows for a compiled step program
+        (shared by the decode and fused-chunk paths — the bit-identity
+        contract between them rests on this assembly never diverging).
+        Returns (seeds, steps, flags, temps, topks, topps, sampling,
+        collect); ``steps`` is each row's ABSOLUTE step index, so results
+        are K/fused-invariant."""
         N = self.cache.num_slots
-        toks = np.zeros(N, np.int32)
         seeds = np.zeros(N, np.uint32)
         steps = np.zeros(N, np.int32)
         flags = np.zeros(N, bool)
         temps = np.ones(N, np.float32)
         topks = np.zeros(N, np.int32)
         topps = np.ones(N, np.float32)
-        live = sorted(self.active.items())
         sampling = False
         collect = False
         for slot, req in live:
-            toks[slot] = req.out[-1]
             seeds[slot] = req.seed
             steps[slot] = len(req.out)  # prefill consumed step 0
             flags[slot] = req.do_sample
@@ -355,35 +543,250 @@ class DecodeScheduler:
             topps[slot] = req.top_p
             sampling = sampling or req.do_sample
             collect = collect or req.collect_logits
-        K = self.steps_per_sync
-        fn = self._decode_fn(sampling, collect)
-        lengths = jnp.asarray(self.cache.lengths)
-        with eng.mesh:
-            out = fn(eng.params, self.cache.pool, jnp.asarray(toks), lengths,
-                     jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
-                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+        return seeds, steps, flags, temps, topks, topps, sampling, collect
+
+    def _fetch_block(self, out, collect, K):
+        """Unpack a compiled step program's result: replace the pool, fetch
+        the (K, num_slots) token block (+ logits when collected)."""
         if collect:
             self.cache.pool, toks_k, logits_k = out
             logits_k = np.asarray(jax.device_get(logits_k), np.float32)  # (K, N, V)
         else:
             self.cache.pool, toks_k = out
             logits_k = None
-        toks_k = np.asarray(jax.device_get(toks_k)).reshape(K, N)
+        toks_k = np.asarray(jax.device_get(toks_k)).reshape(K, self.cache.num_slots)
         self._steps += K
+        return toks_k, logits_k
+
+    def _deliver_block(self, live, toks_k, logits_k, K):
+        """Deliver a fetched K-step token block to the live rows. Each row's
+        KV advanced K positions on device (the program wrote rows
+        [len, len+K)); tokens past EOS/budget were computed but are
+        discarded. Returns tokens delivered."""
         n_delivered = 0
         for slot, req in live:
-            # the K-step program wrote this row's KV at rows [len, len+K)
             self.cache.lengths[slot] += K
             for k in range(K):
                 if req.done:
-                    break  # tokens past EOS/budget are computed but discarded
+                    break
                 if req.collect_logits and logits_k is not None:
                     req.logits.append(logits_k[k, slot])
                 self._deliver(req, int(toks_k[k, slot]))
                 n_delivered += 1
         return n_delivered
 
+    def _decode_step(self):
+        """A pure decode sync: the fused program at chunk width 1 (every
+        live row span 1, no prefill row) — ONE on-device step body serves
+        both paths, so fused-vs-decode results can never diverge. Dead and
+        cached rows carry span 0 and length 0: their writes are dropped and
+        the paged kernel's KV-block walk stays bounded by the longest LIVE
+        row, not the longest retained prefix."""
+        eng = self.engine
+        N = self.cache.num_slots
+        live = sorted(self.active.items())
+        ids = np.zeros((N, 1), np.int32)
+        spans = np.zeros(N, np.int32)
+        lens = np.zeros(N, np.int32)
+        for slot, req in live:
+            ids[slot, 0] = req.out[-1]
+            spans[slot] = 1
+            lens[slot] = self.cache.lengths[slot]
+        (seeds, steps, flags, temps, topks, topps, sampling,
+         collect) = self._gather_sampling(live)
+        K = self.steps_per_sync
+        fn = self._fused_fn(sampling, collect, K, 1)
+        with eng.mesh:
+            out = fn(eng.params, self.cache.pool, jnp.asarray(ids),
+                     jnp.asarray(lens), jnp.asarray(spans),
+                     jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+        toks_k, logits_k = self._fetch_block(out, collect, K)
+        return self._deliver_block(live, toks_k, logits_k, K), K
+
+    # ------------------------------------------------------------------ fused chunk step
+    def _fused_chunk_step(self):
+        """One fixed-shape fused SYNC over ``(num_slots, prefill_chunk)``
+        query columns plus the remaining ``steps_per_sync - 1`` decode
+        steps, all in one dispatch: live decode rows advance K tokens
+        (column 0 + the substeps), the in-flight prefill row consumes up to
+        a chunk of prompt tokens (and, on its final chunk, starts decoding
+        in the same dispatch), dead rows carry span 0 (their KV writes are
+        dropped, so retained prefix slots stay byte-stable). Returns
+        (tokens delivered, K)."""
+        eng = self.engine
+        N, C = self.cache.num_slots, self.prefill_chunk
+        pf = self._prefill
+        preq = pf.req
+        L = preq.prompt.size
+        take = min(C, L - pf.pos)
+        final = pf.pos + take >= L
+        ids = np.zeros((N, C), np.int32)
+        spans = np.zeros(N, np.int32)
+        # dead/cached rows keep length 0 in the program input: their writes
+        # are dropped (span 0), and the paged kernel's KV-block walk stays
+        # bounded by the longest live row, not the longest retained prefix
+        lens = np.zeros(N, np.int32)
+        live = sorted(self.active.items())
+        (seeds, steps, flags, temps, topks, topps, sampling,
+         collect) = self._gather_sampling(live)
+        sampling = sampling or preq.do_sample
+        collect = collect or preq.collect_logits
+        for slot, req in live:
+            ids[slot, 0] = req.out[-1]
+            spans[slot] = 1
+            lens[slot] = self.cache.lengths[slot]
+        ps = preq.slot
+        ids[ps, :take] = preq.prompt[pf.pos:pf.pos + take]
+        spans[ps] = take
+        seeds[ps] = preq.seed  # steps[ps] stays 0: prefill samples token 0
+        flags[ps] = preq.do_sample
+        temps[ps] = preq.temperature
+        topks[ps] = preq.top_k
+        topps[ps] = preq.top_p
+        # substeps only pay off when something real decodes in them: live
+        # rows, or the prefill row itself once its final chunk lands — a
+        # non-final chunk on an otherwise idle pool runs the 1-step variant
+        K = self.steps_per_sync if (live or final) else 1
+        fn = self._fused_fn(sampling, collect, K, C)
+        tel = self.telemetry
+        t0 = tel.now()
+        lens[ps] = self.cache.lengths[ps]  # prefix copy and/or earlier chunks
+        with eng.mesh:
+            out = fn(eng.params, self.cache.pool, jnp.asarray(ids),
+                     jnp.asarray(lens), jnp.asarray(spans),
+                     jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+        toks_k, logits_k = self._fetch_block(out, collect, K)
+        if tel.enabled:
+            # the stall co-resident decode rows eat while a prefill chunk
+            # rides their sync (one chunk + K-1 substeps of compute; the
+            # monolithic path records the WHOLE prefill here). Measured
+            # through the block fetch — jit dispatch alone returns before
+            # the compute finishes on async backends
+            tel.histogram("serving/prefill_stall_ms", (tel.now() - t0) * 1e3)
+        # live rows: column 0 + each substep appended one KV row
+        delivered = self._deliver_block(live, toks_k, logits_k, K)
+        pf.pos += take
+        if final:
+            # the chunk's rows plus K-1 substep rows: token 0's KV landed
+            # when substep 1 consumed it; the newest token's KV is written
+            # when the NEXT sync feeds it (same contract as the decode
+            # program). Set the length BEFORE delivery — a request finishing
+            # mid-sync releases the slot, which must see the final length.
+            self.cache.lengths[ps] = L + K - 1
+            self._finish_prefill(
+                preq, int(toks_k[0, ps]),
+                logits_k[0, ps] if (preq.collect_logits and logits_k is not None)
+                else None)
+            delivered += 1
+            for k in range(1, K):
+                if preq.done:
+                    break
+                if preq.collect_logits and logits_k is not None:
+                    preq.logits.append(logits_k[k, ps])
+                self._deliver(preq, int(toks_k[k, ps]))
+                delivered += 1
+        else:
+            self.cache.lengths[ps] = pf.pos
+        return delivered, K
+
     # ------------------------------------------------------------------ compiled programs
+    def _fused_fn(self, sampling, collect, ksteps, chunk):
+        """THE step program: per-row query spans over a fixed ``(num_slots,
+        chunk)`` ids block, then the sync's remaining ``ksteps - 1`` decode
+        steps in the same on-device loop — one dispatch per scheduler
+        iteration, so decode keeps its K-step amortization while prefills
+        chain. A pure decode sync is the same program at ``chunk == 1``
+        (every live row span 1): one step body serves both paths, so
+        fused-vs-decode results can never diverge. Which row is prefilling,
+        its chunk fill, and every sampling parameter are runtime data —
+        compiled at most (greedy/sampling) x logits-collection x two step
+        counts (K, and 1 for chunks with nothing to decode) x two widths
+        (chunk, 1) regardless of the prompt-length mix.
+
+        Substep write positions: each row continues at its own write head
+        (``lengths + max(span, 1) - 1 + k``) — decode rows one past their
+        column-0 token, a FINAL chunk's row one past its chunk (so the
+        fresh request starts decoding inside this very dispatch). Span-0
+        (dead/cached) rows never write — the span-write path drops their
+        rows in the first forward AND the substeps — so the scheduler can
+        pass them length 0 and keep the paged kernel's KV-block walk
+        bounded by the longest LIVE row, not the longest retained prefix.
+
+        NOTE: the fused per-layer decode kernel (decode_block.py) needs a
+        shared position scalar, so the slot-pool step always uses the
+        per-projection path (paged Pallas kernels or XLA)."""
+        key = ("fused", sampling, collect, chunk, ksteps)
+        if key not in self._compiled:
+            model = self.engine.module
+            K = ksteps
+            V = model.cfg.vocab_size
+
+            def sample(l2, seeds, steps, flags, temps, topks, topps):
+                if sampling:
+                    return jax.vmap(_sample_slot)(seeds, steps, l2, flags,
+                                                  temps, topks, topps)
+                return jnp.argmax(l2, axis=-1).astype(jnp.int32)
+
+            def fused(params, pool, ids, lengths, spans, seeds, steps, flags,
+                      temps, topks, topps):
+                C = ids.shape[1]
+                N = ids.shape[0]
+                pos = lengths[:, None] + jnp.arange(C)[None, :]
+                logits, pool = model.apply_with_cache(
+                    params, ids, pool, 0, position_ids=pos, write_index=lengths,
+                    q_spans=spans)
+                # each row's LAST live column: decode rows column 0, the
+                # prefill row its chunk fill - 1 (dead rows clamp to 0 —
+                # their token is garbage the host never reads)
+                last_col = jnp.maximum(spans - 1, 0)
+                l0 = jnp.take_along_axis(
+                    logits, last_col[:, None, None], axis=1)[:, 0].astype(jnp.float32)
+                tok0 = sample(l0, seeds, steps, flags, temps, topks, topps)
+                out_toks = jnp.zeros((K, N), jnp.int32).at[0].set(tok0)
+                out_logits = jnp.zeros((K, N, V) if collect else (), jnp.float32)
+                if collect:
+                    out_logits = out_logits.at[0].set(l0)
+                if K == 1:
+                    if collect:
+                        return pool, out_toks, out_logits
+                    return pool, out_toks
+                base = lengths + jnp.maximum(spans, 1) - 1  # per-row write head - 1
+                live01 = jnp.minimum(spans, 1)  # substep spans: drop dead rows' writes
+
+                def body(k, carry):
+                    pool, tok, out_toks, out_logits = carry
+                    logits, pool = model.apply_with_cache(
+                        params, tok[:, None], pool, 0,
+                        position_ids=(base + k)[:, None], write_index=base + k,
+                        q_spans=live01)
+                    l2 = logits[:, 0].astype(jnp.float32)
+                    nxt = sample(l2, seeds, steps + k, flags, temps, topks, topps)
+                    out_toks = jax.lax.dynamic_update_index_in_dim(out_toks, nxt, k, 0)
+                    if collect:
+                        out_logits = jax.lax.dynamic_update_index_in_dim(
+                            out_logits, l2, k, 0)
+                    return pool, nxt, out_toks, out_logits
+
+                pool, _, out_toks, out_logits = jax.lax.fori_loop(
+                    1, K, body, (pool, tok0, out_toks, out_logits))
+                if collect:
+                    return pool, out_toks, out_logits
+                return pool, out_toks
+
+            self._compiled[key] = jax.jit(fused, donate_argnums=(1, ))
+        return self._compiled[key]
+
+    def _copy_fn(self):
+        """The ONE slot-to-slot cache copy program (radix prefix hit): src and
+        dst are runtime scalars, so every donor/recipient pair shares it."""
+        if "copy" not in self._compiled:
+            self._compiled["copy"] = jax.jit(
+                lambda pool, src, dst: copy_slot(pool, src, dst),
+                donate_argnums=(0, ))
+        return self._compiled["copy"]
+
     def _prefill_fn(self, Pb, collect):
         """Single-request prefill into one pool slot, compiled per prompt
         bucket ``Pb``: right-pad the prompt to ``Pb`` (padding rows are
@@ -407,54 +810,6 @@ class DecodeScheduler:
                 return pool, tok
 
             self._compiled[key] = jax.jit(prefill, donate_argnums=(1, ))
-        return self._compiled[key]
-
-    def _decode_fn(self, sampling, collect):
-        """The one shared decode program: every slot advances
-        ``steps_per_sync`` tokens in a single on-device loop (dead slots
-        compute too — their writes land at rows [0, K) and are overwritten
-        by the next prefill into that slot; rows past a request's EOS are
-        discarded by the host). Compiled at most twice (greedy / sampling)
-        x logits collection.
-
-        NOTE: the fused per-layer decode kernel (decode_block.py) needs a
-        shared position scalar, so the slot-pool step always uses the
-        per-projection path (paged Pallas decode kernel or XLA)."""
-        K = self.steps_per_sync
-        key = ("decode", sampling, collect, K)
-        if key not in self._compiled:
-            model = self.engine.module
-            V = model.cfg.vocab_size
-
-            def decode(params, pool, toks, lengths, seeds, steps, flags,
-                       temps, topks, topps):
-                N = toks.shape[0]
-
-                def body(k, carry):
-                    pool, tok, out_toks, out_logits = carry
-                    logits, pool = model.apply_with_cache(
-                        params, tok[:, None], pool, 0,
-                        position_ids=(lengths + k)[:, None], write_index=lengths + k)
-                    l2 = logits[:, 0].astype(jnp.float32)
-                    if sampling:
-                        nxt = jax.vmap(_sample_slot)(seeds, steps + k, l2, flags,
-                                                     temps, topks, topps)
-                    else:
-                        nxt = jnp.argmax(l2, axis=-1).astype(jnp.int32)
-                    out_toks = jax.lax.dynamic_update_index_in_dim(out_toks, nxt, k, 0)
-                    if collect:
-                        out_logits = jax.lax.dynamic_update_index_in_dim(
-                            out_logits, l2, k, 0)
-                    return pool, nxt, out_toks, out_logits
-
-                out_logits = jnp.zeros((K, N, V) if collect else (), jnp.float32)
-                pool, _, out_toks, out_logits = jax.lax.fori_loop(
-                    0, K, body, (pool, toks, jnp.zeros((K, N), jnp.int32), out_logits))
-                if collect:
-                    return pool, out_toks, out_logits
-                return pool, out_toks
-
-            self._compiled[key] = jax.jit(decode, donate_argnums=(1, ))
         return self._compiled[key]
 
     # ------------------------------------------------------------------ introspection

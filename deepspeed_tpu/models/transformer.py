@@ -554,16 +554,23 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, kv_cache=None, cache_index=None,
-                 position_ids=None, write_index=None):
+                 position_ids=None, write_index=None, q_spans=None):
         """``attn_mask`` semantics: without a cache it is (B, T) over the
         current tokens; with a cache it is (B, S) over cache slots (True =
         attendable, used for left-pad masking during generation).
 
         ``write_index``: optional (B,) int32 per-row cache write positions
         (continuous-batching slot pool — every sequence sits at its own
-        length). Decode-only (T == 1); overrides ``cache_index`` for both the
-        cache write and the causal window, and positions must then come from
-        ``position_ids``.
+        length). Overrides ``cache_index`` for both the cache write and the
+        causal window, and positions must then come from ``position_ids``.
+        Without ``q_spans`` it is decode-only (T == 1).
+
+        ``q_spans``: optional (B,) int32 live query counts per row (chunked
+        prefill fused into the decode step: decode rows carry span 1, the
+        in-flight prefill row up to a chunk of T). Column ``j`` of row ``i``
+        sits at absolute position ``write_index_i + j``; columns at or past
+        the span are padding — their KV write is dropped and their outputs
+        are garbage the caller never reads.
         """
         cfg = self.cfg
         B, T, H = x.shape
@@ -621,7 +628,20 @@ class Attention(nn.Module):
             # arena: csrc/transformer/inference/includes/inference_context.h).
             # k/v are already bhtd, so the cache write needs no transpose.
             ck, cv = kv_cache
-            if write_index is not None:
+            if write_index is not None and q_spans is not None:
+                # fused chunk/decode span write: column j of row i lands at
+                # row position write_index_i + j; columns past the row's live
+                # span target row S (out of range) and are DROPPED — padding
+                # never writes, so retained prefix slots and co-resident
+                # decode rows in the pool stay byte-stable
+                tgt = write_index[:, None] + jnp.arange(T)[None, :]
+                tgt = jnp.where(jnp.arange(T)[None, :] < q_spans[:, None], tgt,
+                                ck.shape[2])
+                upd = lambda c, kk, i: c.at[:, i, :].set(kk.astype(c.dtype), mode="drop")
+                ck = jax.vmap(upd)(ck, k, tgt)
+                cv = jax.vmap(upd)(cv, v, tgt)
+                cache_index = write_index  # per-row causal window below
+            elif write_index is not None:
                 # slot-pool decode: each row appends at its own position
                 upd = lambda c, kk, i: jax.lax.dynamic_update_slice_in_dim(c, kk, i, axis=1)
                 ck = jax.vmap(upd)(ck, k.astype(ck.dtype), write_index)
@@ -647,6 +667,19 @@ class Attention(nn.Module):
                 else:
                     out = decode_attention(q[:, :, 0], ck, cv, starts, cache_index + 1,
                                            block_kv=cfg.decode_block_kv)[:, :, None]
+            elif (cfg.attention_impl == "flash" and write_index is not None
+                  and q_spans is not None and alibi is None and not window):
+                # fused chunked-prefill + decode step over the slot pool:
+                # per-row query spans through the span variant of the paged
+                # decode kernel (each row's causal window advances with its
+                # query column)
+                from ..ops.pallas.decode_attention import paged_span_attention
+                if attn_mask is not None:
+                    starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
+                else:
+                    starts = jnp.zeros((B, ), jnp.int32)
+                out = paged_span_attention(q, ck, cv, starts, write_index,
+                                           block_kv=cfg.decode_block_kv)
             elif (cfg.attention_impl == "flash" and attn_mask is None and T >= 128
                   and isinstance(cache_index, int) and cache_index == 0 and alibi is None
                   and not window):
@@ -782,7 +815,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, deterministic=True, kv_cache=None,
-                 cache_index=None, position_ids=None, write_index=None):
+                 cache_index=None, position_ids=None, write_index=None, q_spans=None):
         cfg = self.cfg
         drop = nn.Dropout(rate=cfg.dropout) if cfg.dropout > 0 else None
         if cfg.act_quant_bits:  # QAT activation fake-quant (compression)
@@ -791,7 +824,8 @@ class Block(nn.Module):
                               symmetric=cfg.act_quant_symmetric)
         h = make_norm(cfg, name="attn_norm")(x)
         h, new_cache = Attention(cfg, layer_idx=self.layer_idx, name="attn")(
-            h, sin, cos, attn_mask, kv_cache, cache_index, position_ids, write_index)
+            h, sin, cos, attn_mask, kv_cache, cache_index, position_ids, write_index,
+            q_spans)
         if drop is not None:
             h = drop(h, deterministic=deterministic)
         if cfg.parallel_residual:
@@ -822,7 +856,7 @@ class CausalLM(nn.Module):
     def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
                  cache_index=None, position_ids=None, return_hidden=False,
                  pld_theta=None, pld_rng=None, ltd_keep=None, ltd_layers=(), ltd_rng=None,
-                 write_index=None):
+                 write_index=None, q_spans=None):
         """``kv_cache``: optional per-layer (k, v) with leading layer dim —
         shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
         stack. Returns logits, or (logits, new_kv_cache) when caching, or the
@@ -895,7 +929,8 @@ class CausalLM(nn.Module):
                         carry, layer_idx)
                 else:
                     y, c = mdl(carry, sin, cos, attn_mask, deterministic,
-                               layer_cache, cache_index, position_ids, write_index)
+                               layer_cache, cache_index, position_ids, write_index,
+                               q_spans)
                 return apply_pld(y, carry, layer_idx), c
 
             x, new_cache = nn.scan(
@@ -919,7 +954,8 @@ class CausalLM(nn.Module):
                         x, i)
                 else:
                     y, c = blk(x, sin, cos, attn_mask, deterministic,
-                               layer_cache, cache_index, position_ids, write_index)
+                               layer_cache, cache_index, position_ids, write_index,
+                               q_spans)
                 x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
@@ -1122,15 +1158,17 @@ class CausalLMModel:
                 tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_layers)))
 
     def apply_with_cache(self, params, input_ids, kv_cache, cache_index, cache_mask=None,
-                         position_ids=None, write_index=None):
+                         position_ids=None, write_index=None, q_spans=None):
         """Forward writing into (and attending over) the KV cache. Returns
         (logits, new_cache). ``cache_mask``: (B, S) attendable cache slots.
         ``write_index``: optional (B,) per-row cache positions (slot-pool
-        decode, T == 1); pass ``position_ids`` alongside it."""
+        decode, T == 1 — unless ``q_spans`` widens it); pass ``position_ids``
+        alongside it. ``q_spans``: optional (B,) live query counts per row
+        (fused chunked-prefill/decode step; see :class:`Attention`)."""
         mutable = ["intermediates"] if self.cfg.num_experts > 0 else False
         out = self.module.apply({"params": params}, input_ids, cache_mask, True, kv_cache,
                                 cache_index, position_ids, write_index=write_index,
-                                mutable=mutable)
+                                q_spans=q_spans, mutable=mutable)
         if mutable:
             (logits, new_cache), _ = out
         else:

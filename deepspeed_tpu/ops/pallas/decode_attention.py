@@ -27,6 +27,13 @@ generation; ``end`` is the write head. Two entry points share one kernel:
   slot pool: every slot sits at its own sequence position, so each row
   attends its own live window). Blocks past the LONGEST live row are
   skipped, so a mostly-short batch still pays only for its max context.
+- :func:`paged_span_attention` — per-row QUERY SPANS of ``T`` columns
+  (chunked prefill fused into the decode step: decode rows carry one live
+  query, the in-flight prefill row carries up to a chunk of them). Query
+  column ``j`` of row ``i`` sits at absolute position ``base_i + j`` and
+  attends ``[start_i, base_i + j]``; the span fold reuses the same kernel
+  with the query columns folded into the head-group axis and a per-column
+  offset added to the causal end.
 """
 
 import functools
@@ -46,7 +53,12 @@ def _interpret():
 
 
 def _decode_kernel(start_ref, end_ref, max_end_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_s, l_s, acc_s, *, scale, block_kv, B, nkv, g, D):
+                   m_s, l_s, acc_s, *, scale, block_kv, B, nkv, g, D, span=1):
+    """``g`` is the FOLDED query axis: head-groups x span columns. With
+    ``span > 1`` the per-row ``end`` is the causal end of column 0 and each
+    later column's window extends by its offset (column j of a row attends
+    one more key than column j-1 — per-row mixed decode/prefill query
+    spans share this one kernel)."""
     j = pl.program_id(0)
     nj = pl.num_programs(0)
     max_end = max_end_ref[0]
@@ -75,6 +87,11 @@ def _decode_kernel(start_ref, end_ref, max_end_ref, q_ref, k_ref, v_ref, o_ref,
             [jnp.full((nkv * g, block_kv), start_ref[i], jnp.int32) for i in range(B)])
         end2d = jnp.concatenate(
             [jnp.full((nkv * g, block_kv), end_ref[i], jnp.int32) for i in range(B)])
+        if span > 1:
+            # folded rows cycle through span columns fastest: column j of a
+            # row sits j positions later, so its causal end advances by j
+            col = jax.lax.broadcasted_iota(jnp.int32, (BH * g, block_kv), 0) % span
+            end2d = end2d + col
         mask = (kv_pos >= start2d) & (kv_pos < end2d)
         s2 = jnp.where(mask, s2, DEFAULT_MASK_VALUE)
 
@@ -99,18 +116,19 @@ def _decode_kernel(start_ref, end_ref, max_end_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[...] = out.reshape(B, nkv, g, D).astype(o_ref.dtype)
 
 
-def _decode_call(q, k_cache, v_cache, start, ends, max_end, *, block_kv, scale):
+def _decode_call(qg, k_cache, v_cache, start, ends, max_end, *, block_kv, scale,
+                 span=1):
     """Shared pallas_call builder: per-row windows [start_i, ends_i), with
-    ``max_end`` (scalar) bounding the walked KV blocks."""
-    B, H, D = q.shape
-    nkv, S = k_cache.shape[1], k_cache.shape[2]
-    g = H // nkv
+    ``max_end`` (scalar) bounding the walked KV blocks. ``qg``: queries
+    pre-folded to (B, nkv, g, D) where ``g`` = head-groups x ``span``
+    columns (span fastest)."""
+    B, nkv, g, D = qg.shape
+    S = k_cache.shape[2]
     scale = scale if scale is not None else 1.0 / (D**0.5)
     block_kv = min(block_kv, S)
     if S % block_kv:
         raise ValueError(f"cache length {S} must be a multiple of block_kv={block_kv}")
 
-    qg = q.reshape(B, nkv, g, D)
     start = start.astype(jnp.int32)
     ends = ends.astype(jnp.int32)
     max_end_arr = jnp.full((1, ), max_end, jnp.int32)
@@ -123,7 +141,7 @@ def _decode_call(q, k_cache, v_cache, start, ends, max_end, *, block_kv, scale):
         return (0, 0, jnp.minimum(j, last), 0)
 
     kernel = functools.partial(_decode_kernel, scale=scale, block_kv=block_kv,
-                               B=B, nkv=nkv, g=g, D=D)
+                               B=B, nkv=nkv, g=g, D=D, span=span)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -141,11 +159,16 @@ def _decode_call(q, k_cache, v_cache, start, ends, max_end, *, block_kv, scale):
                 pltpu.VMEM((B * nkv, g * D), jnp.float32),  # running numerator
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, nkv, g, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, g, D), qg.dtype),
         compiler_params=_CompilerParams(dimension_semantics=("arbitrary", )),
         interpret=_interpret(),
     )(start, ends, max_end_arr, qg, k_cache, v_cache)
-    return out.reshape(B, H, D)
+    return out
+
+
+def _group(q, nkv):
+    B, H, D = q.shape
+    return q.reshape(B, nkv, H // nkv, D)
 
 
 def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=None):
@@ -153,10 +176,11 @@ def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=Non
     (B, kv_heads, S, D); start: (B,) int32 first attendable cache slot per
     row; end: scalar int32, one past the last written slot (shared).
     Returns (B, H, D)."""
-    B = q.shape[0]
+    B, H, D = q.shape
     ends = jnp.full((B, ), end, jnp.int32)
-    return _decode_call(q, k_cache, v_cache, start, ends, end,
-                        block_kv=block_kv, scale=scale)
+    out = _decode_call(_group(q, k_cache.shape[1]), k_cache, v_cache, start, ends,
+                       end, block_kv=block_kv, scale=scale)
+    return out.reshape(B, H, D)
 
 
 def paged_decode_attention(q, k_cache, v_cache, start, ends, *, block_kv=256, scale=None):
@@ -167,6 +191,29 @@ def paged_decode_attention(q, k_cache, v_cache, start, ends, *, block_kv=256, sc
     The KV-block walk stops at ``max(ends)``, so compute and DMA
     scale with the longest LIVE context, not the pool capacity S.
     Returns (B, H, D)."""
+    B, H, D = q.shape
     ends = ends.astype(jnp.int32)
-    return _decode_call(q, k_cache, v_cache, start, ends, jnp.max(ends),
-                        block_kv=block_kv, scale=scale)
+    out = _decode_call(_group(q, k_cache.shape[1]), k_cache, v_cache, start, ends,
+                       jnp.max(ends), block_kv=block_kv, scale=scale)
+    return out.reshape(B, H, D)
+
+
+def paged_span_attention(q, k_cache, v_cache, start, base, *, block_kv=256,
+                         scale=None):
+    """Fused chunked-prefill/decode variant: per-row query SPANS. q:
+    (B, H, T, D) — row ``i``'s query column ``j`` sits at absolute cache
+    position ``base_i + j`` and attends keys in ``[start_i, base_i + j]``
+    (its own freshly-written KV included). Decode rows put their one live
+    token in column 0; the in-flight prefill row fills up to a chunk; columns
+    past a row's live span compute garbage that the caller never reads.
+    ``base``: (B,) int32 per-row write heads (== column 0's position). The
+    KV-block walk stops at ``max(base) + T``. Returns (B, H, T, D)."""
+    B, H, T, D = q.shape
+    nkv = k_cache.shape[1]
+    # fold (head-group, column) into one query axis, column fastest — the
+    # kernel recovers the per-column causal offset from ``idx % span``
+    qf = q.reshape(B, nkv, (H // nkv) * T, D)
+    base = base.astype(jnp.int32)
+    out = _decode_call(qf, k_cache, v_cache, start, base + 1, jnp.max(base) + T,
+                       block_kv=block_kv, scale=scale, span=T)
+    return out.reshape(B, H, T, D)

@@ -1,0 +1,233 @@
+"""SlotKVCache + RadixPrefixCache host-side accounting tests.
+
+The scheduler's correctness rests on the pool's bookkeeping never drifting:
+every slot in exactly one of free/active/cached, the free list matching the
+state row, refcounts released exactly once, and the page/token gauges
+derivable from the lengths row at any instant — including under eviction
+storms where every admission reclaims a retained prefix slot. These tests
+drive the same alloc/insert/retain/evict/reclaim protocol the scheduler
+uses, with :meth:`SlotKVCache.check_invariants` after every operation.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.kv_cache import RadixPrefixCache, SlotKVCache
+
+
+def make_pool(num_slots=4, max_len=128, page_size=16):
+    # pool=None: these tests exercise host bookkeeping only — the device
+    # tree is opaque to SlotKVCache outside slot_slice/copy_slot
+    return SlotKVCache(None, num_slots, max_len, page_size=page_size)
+
+
+# --------------------------------------------------------------------- slots
+def test_slot_state_machine_and_errors():
+    kv = make_pool(num_slots=2)
+    radix = RadixPrefixCache(kv)
+    s0 = kv.alloc(owner="r0")
+    assert s0 == 0 and kv.state[0] == "active" and kv.free_slots == 1
+    kv.check_invariants()
+    # free without a trie registration (cancelled mid-prefill)
+    kv.free(s0)
+    with pytest.raises(ValueError, match="double free"):
+        kv.free(s0)
+    kv.check_invariants()
+    # retain demands a reference; reclaim demands cached + zero refs
+    s1 = kv.alloc()
+    with pytest.raises(ValueError, match="no trie reference"):
+        kv.retain(s1)
+    radix.insert(s1, [1, 2, 3])
+    kv.lengths[s1] = 3
+    kv.retain(s1)
+    assert kv.state[s1] == "cached" and kv.cached_slots == 1
+    with pytest.raises(ValueError, match="still holding"):
+        kv.reclaim(s1)
+    radix.remove(s1)
+    kv.reclaim(s1)
+    with pytest.raises(ValueError, match="non-cached"):
+        kv.reclaim(s1)
+    kv.check_invariants()
+    assert kv.free_slots == 2 and kv.total_allocs == 2 and kv.total_frees == 2
+
+
+def test_page_accounting_matches_ledger():
+    kv = make_pool(num_slots=3, max_len=64, page_size=16)
+    radix = RadixPrefixCache(kv)
+    a, b, c = kv.alloc(), kv.alloc(), kv.alloc()
+    kv.lengths[a], kv.lengths[b], kv.lengths[c] = 1, 16, 17
+    # ceil(len/16): 1 + 1 + 2
+    assert kv.live_pages() == 4 and kv.cached_pages() == 0
+    assert kv.live_tokens() == 34
+    assert kv.token_utilization() == pytest.approx(34 / (3 * 64))
+    radix.insert(b, list(range(16)))
+    kv.retain(b)
+    assert kv.live_pages() == 3 and kv.cached_pages() == 1
+    # retained rows still count toward utilization: they do reuse work
+    assert kv.token_utilization() == pytest.approx(34 / (3 * 64))
+    kv.free(a)
+    kv.free(c)
+    assert kv.live_pages() == 0 and kv.token_utilization() == pytest.approx(16 / (3 * 64))
+
+
+def test_eviction_storm_never_drifts():
+    """Hundreds of admissions through a 3-slot pool with shared-prefix
+    prompts: invariants hold after EVERY operation and the page gauges stay
+    derivable from an independent ledger; a full drain returns the pool to
+    all-free with zero refs."""
+    rng = np.random.default_rng(7)
+    kv = make_pool(num_slots=3, max_len=96, page_size=16)
+    radix = RadixPrefixCache(kv)
+    system = [9, 9, 9, 9]  # shared system prompt forcing trie sharing/splits
+    live = {}  # slot -> length
+
+    def ledger_pages(state):
+        return sum(-(-int(kv.lengths[s]) // kv.page_size)
+                   for s in range(kv.num_slots) if kv.state[s] == state)
+
+    for i in range(300):
+        op = rng.integers(0, 4)
+        if op <= 1:  # admit (reclaiming LRU cached when dry), register, keep live
+            slot = kv.alloc(owner=i)
+            if slot is None:
+                victim = radix.evict_lru()
+                if victim is None:  # every slot busy with a live request
+                    continue
+                kv.reclaim(victim)
+                kv.check_invariants()
+                slot = kv.alloc(owner=i)
+            prompt = system + [int(t) for t in rng.integers(0, 50, rng.integers(1, 40))]
+            kv.lengths[slot] = len(prompt) + int(rng.integers(0, 8))
+            radix.insert(slot, prompt)
+            live[slot] = int(kv.lengths[slot])
+        elif op == 2 and live:  # request finishes -> retained for reuse
+            slot = int(rng.choice(list(live)))
+            del live[slot]
+            kv.retain(slot)
+        elif op == 3 and live:  # cancelled before registration mattered
+            slot = int(rng.choice(list(live)))
+            del live[slot]
+            radix.remove(slot)
+            kv.free(slot)
+        kv.check_invariants()
+        assert kv.live_pages() == ledger_pages("active")
+        assert kv.cached_pages() == ledger_pages("cached")
+        assert 0.0 <= kv.token_utilization() <= 1.0
+        if radix.match(system)[0]:
+            assert radix.match(system)[0] <= len(system)
+    # drain: retain the stragglers, evict every registration, reclaim all
+    for slot in list(live):
+        kv.retain(slot)
+    while True:
+        victim = radix.evict_lru()
+        if victim is None:
+            break
+        kv.reclaim(victim)
+        kv.check_invariants()
+    assert kv.free_slots == kv.num_slots
+    assert kv.live_pages() == 0 and kv.cached_pages() == 0
+    assert kv.token_utilization() == 0.0
+    assert not radix.registered_slots() and int(kv.refs.sum()) == 0
+    assert kv.total_allocs == kv.total_frees + 0  # every alloc was released
+
+
+# --------------------------------------------------------------------- radix
+def test_radix_match_longest_prefix_and_edge_split():
+    kv = make_pool(num_slots=4)
+    radix = RadixPrefixCache(kv)
+    a = kv.alloc()
+    radix.insert(a, [1, 2, 3, 4])
+    assert radix.match([1, 2, 3, 4, 5]) == (4, a)
+    assert radix.match([1, 2, 7]) == (2, a)  # partial edge: subtree shares 2
+    assert radix.match([5, 6]) == (0, None)
+    b = kv.alloc()
+    radix.insert(b, [1, 2, 9, 9])  # splits the (1,2,3,4) edge at depth 2
+    m, donor = radix.match([1, 2, 3])
+    assert m == 3 and donor == a
+    m, donor = radix.match([1, 2, 9, 9, 9])
+    assert m == 4 and donor == b
+    # match never exceeds the donor's registered length
+    c = kv.alloc()
+    radix.insert(c, [1, 2])
+    radix.touch(c)  # MRU at the split node
+    m, donor = radix.match([1, 2])
+    assert donor == c and m == 2
+
+
+def test_radix_mru_donor_and_lru_eviction_order():
+    kv = make_pool(num_slots=3)
+    radix = RadixPrefixCache(kv)
+    slots = []
+    for _ in range(3):
+        s = kv.alloc()
+        kv.lengths[s] = 4
+        radix.insert(s, [1, 2, 3, 4])
+        slots.append(s)
+    # all three registered on one node; the most recently used donates
+    radix.touch(slots[0])
+    assert radix.match([1, 2, 3, 4])[1] == slots[0]
+    for s in slots:
+        kv.retain(s)
+    # eviction walks LRU-first among CACHED slots: 1, 2, then the touched 0
+    assert radix.evict_lru() == slots[1]
+    kv.reclaim(slots[1])
+    assert radix.evict_lru() == slots[2]
+    kv.reclaim(slots[2])
+    assert radix.evict_lru() == slots[0]
+    kv.reclaim(slots[0])
+    assert radix.evict_lru() is None and radix.evictions == 3
+    kv.check_invariants()
+
+
+def test_radix_evict_lru_spares_preferred_donor():
+    """``prefer_not`` spares the matched donor while any other cached
+    candidate exists — even when the donor is the LRU entry — and falls
+    back to the donor only when it is the sole candidate."""
+    kv = make_pool(num_slots=2)
+    radix = RadixPrefixCache(kv)
+    a, b = kv.alloc(), kv.alloc()
+    radix.insert(a, [1, 2, 3, 4])  # LRU
+    radix.insert(b, [7, 8, 9])
+    kv.retain(a)
+    kv.retain(b)
+    assert radix.evict_lru(prefer_not=a) == b  # donor spared despite LRU order
+    kv.reclaim(b)
+    assert radix.match([1, 2, 3, 4]) == (4, a)  # donor registration intact
+    assert radix.evict_lru(prefer_not=a) == a  # sole candidate: donor falls
+    kv.reclaim(a)
+    kv.check_invariants()
+
+
+def test_radix_active_slots_are_pinned():
+    """evict_lru must never evict a slot still serving a live request —
+    admission pressure cannot cannibalize in-flight KV."""
+    kv = make_pool(num_slots=2)
+    radix = RadixPrefixCache(kv)
+    a = kv.alloc()
+    radix.insert(a, [1, 2, 3])  # live donor: registered while decoding
+    assert kv.state[a] == "active"
+    assert radix.evict_lru() is None
+    b = kv.alloc()
+    radix.insert(b, [1, 2, 9])
+    kv.retain(b)
+    assert radix.evict_lru() == b  # only the cached one is fair game
+    kv.reclaim(b)
+    kv.check_invariants()
+
+
+def test_radix_remove_prunes_empty_branches():
+    kv = make_pool(num_slots=4)
+    radix = RadixPrefixCache(kv)
+    a, b = kv.alloc(), kv.alloc()
+    radix.insert(a, [1, 2, 3, 4])
+    radix.insert(b, [1, 2, 9])
+    assert radix.remove(a) and not radix.remove(a)  # idempotent
+    assert kv.refs[a] == 0
+    # b's branch survives; a's pruned
+    assert radix.match([1, 2, 3, 4]) == (2, b)
+    assert radix.match([1, 2, 9]) == (3, b)
+    radix.remove(b)
+    assert radix.root.children == {} and radix.registered_slots() == []
+    radix.insert(a, [5])
+    with pytest.raises(ValueError, match="already registered"):
+        radix.insert(a, [6])

@@ -19,6 +19,11 @@ PROMPTS = [[5, 6, 7, 8, 9], [10, 11, 12]]
 
 def make_engine(model="tiny", params=None, **cfg):
     comm._state["mesh"] = None
+    # drop any process-global telemetry sink a previous test's engine
+    # installed: an enabled global sink takes precedence over this engine's
+    # own config, so counter assertions would see cross-test events
+    from deepspeed_tpu.telemetry import set_sink
+    set_sink(None)
     config = {"dtype": "float32"}
     config.update(cfg)
     return deepspeed_tpu.init_inference(model, config=config, params=params)
@@ -133,20 +138,23 @@ def test_sampling_reproducible_and_slot_independent(baseline):
     for h in filler:
         h.result()
     assert (a == b).all()
-    # and mixed greedy/sampled rows share one decode program
-    assert ("decode", True, False, sched.steps_per_sync) in sched._compiled
+    # and mixed greedy/sampled rows share one decode program (the width-1
+    # variant of the fused step)
+    assert ("fused", True, False, 1, sched.steps_per_sync) in sched._compiled
 
 
 def test_scheduler_kernel_inject_matches_xla(baseline):
-    """The paged Pallas decode kernel path == the XLA slot path."""
+    """The paged Pallas decode kernel path == the XLA slot path — including
+    the span kernel (paged_span_attention) through a multi-chunk prefill."""
     params, _ = baseline
+    prompts = PROMPTS + [list(range(1, 101))]  # 100 tokens: 2 fused chunks
     eng_x = make_sched_engine(params)
     got_x = [h.result() for h in
-             [eng_x.scheduler().submit(p, max_new_tokens=8) for p in PROMPTS]]
+             [eng_x.scheduler().submit(p, max_new_tokens=8) for p in prompts]]
     eng_k = make_sched_engine(params, replace_with_kernel_inject=True)
     assert eng_k.model_config.attention_impl == "flash"
     got_k = [h.result() for h in
-             [eng_k.scheduler().submit(p, max_new_tokens=8) for p in PROMPTS]]
+             [eng_k.scheduler().submit(p, max_new_tokens=8) for p in prompts]]
     assert all((a == b).all() for a, b in zip(got_x, got_k))
 
 
@@ -177,7 +185,8 @@ def test_cancelled_handles_free_slots(baseline):
     eng = make_sched_engine(params, num_slots=2)
     sched = eng.scheduler()
     abandoned = eng.submit([PROMPTS[0], PROMPTS[1]], max_new_tokens=64)
-    sched.step()  # both admitted, mid-generation
+    sched.step()  # chunked admission: at most ONE prefill starts per iteration
+    sched.step()  # second request admitted, both mid-generation
     assert sched.cache.active_slots == 2
     del abandoned  # __del__ cancels, must not run the decode loop
     import gc
@@ -213,30 +222,72 @@ def test_edge_budgets_and_seeds(baseline):
     assert sched.cache.active_slots == 0  # nothing stranded
 
 
+_XLA_COMPILES = []  # registered once: jax.monitoring listeners can't detach
+
+
+def _count_xla_compiles():
+    if not _XLA_COMPILES:
+        _XLA_COMPILES.append("registered")
+        jax.monitoring.register_event_duration_secs_listener(
+            lambda name, *a, **kw: _XLA_COMPILES.append(name)
+            if name == "/jax/core/compile/backend_compile_duration" else None)
+    return _XLA_COMPILES
+
+
 def test_compile_count_bounded_on_mixed_stream(baseline):
-    """Compile-count regression guard: a mixed-length request stream must
-    stay within the bucketed bound — one decode program plus one prefill
-    program per power-of-two bucket — measured by actual XLA backend
-    compiles (jax.monitoring), not just the scheduler's own cache."""
+    """Compile-count regression guard, legacy monolithic-prefill mode: a
+    mixed-length request stream must stay within the bucketed bound — one
+    decode program plus one prefill program per power-of-two bucket —
+    measured by actual XLA backend compiles (jax.monitoring), not just the
+    scheduler's own cache."""
     params, _ = baseline
     eng = make_sched_engine(params, num_slots=3)
-    sched = eng.scheduler()
-    compiles = []
-    jax.monitoring.register_event_duration_secs_listener(
-        lambda name, *a, **kw: compiles.append(name)
-        if name == "/jax/core/compile/backend_compile_duration" else None)
+    sched = eng.scheduler(prefill_chunk=0)
+    # warm one 64-bucket request first: the first _admit also compiles a few
+    # one-off scalar-convert helpers that would otherwise pollute the count
+    sched.submit([1, 2], max_new_tokens=4).result()
+    compiles = _count_xla_compiles()
     n_before = len(compiles)
     lens = [2, 3, 5, 9, 17, 33, 40, 50, 63, 64, 65, 70, 90, 100]
     handles = [sched.submit(list(range(1, n + 1)), max_new_tokens=4) for n in lens]
     for h in handles:
         h.result()
     n_compiles = len(compiles) - n_before
-    # buckets hit: 64 (lens<=64) and 128 (lens>64) -> 2 prefill programs +
-    # 1 greedy decode program; allow slack of 1 for cache-init style helpers
+    # buckets hit: 64 (warmed) and 128 (lens>64) -> the stream may compile
+    # ONE new prefill program (the 128 bucket) and nothing else
     assert sched.compiled_program_count() <= 3
-    assert n_compiles <= 4, f"XLA compiled {n_compiles} programs for a mixed stream"
+    assert n_compiles <= 2, f"XLA compiled {n_compiles} programs for a mixed stream"
     # and the stream produced sane output
     assert all(len(h.result()) == 4 for h in handles)
+
+
+def test_fused_compile_count_o1_in_length_mix(baseline):
+    """Compile-count guard for the CHUNKED path: the same mixed-length
+    stream through the fused chunk+decode sync compiles O(1) programs —
+    the fused sync (its K-step and, for idle-pool non-final chunks, 1-step
+    variants), its width-1 pure-decode variant, and the slot-copy program —
+    with NO per-bucket prefill growth (a bucketed run of this mix compiles
+    one prefill per power-of-two bucket on top)."""
+    params, _ = baseline
+    eng = make_sched_engine(params, num_slots=3)
+    sched = eng.scheduler()  # chunked prefill + radix cache on by default
+    assert sched.prefill_chunk > 0 and sched.radix is not None
+    compiles = _count_xla_compiles()
+    n_before = len(compiles)
+    lens = [2, 3, 5, 9, 17, 33, 40, 50, 63, 64, 65, 70, 90, 100]
+    handles = [sched.submit(list(range(1, n + 1)), max_new_tokens=4) for n in lens]
+    for h in handles:
+        h.result()
+    n_compiles = len(compiles) - n_before
+    keys = set(sched._compiled)
+    C, K = sched.prefill_chunk, sched.steps_per_sync
+    assert keys <= {("fused", False, False, C, K), ("fused", False, False, C, 1),
+                    ("fused", False, False, 1, K), "copy"}, keys
+    assert sched.compiled_program_count() <= 4
+    assert n_compiles <= 5, f"XLA compiled {n_compiles} programs on the fused path"
+    assert all(len(h.result()) == 4 for h in handles)
+    # the nested-range stream shares prefixes: the radix cache must land hits
+    assert sched.radix.hits > 0
 
 
 def test_telemetry_gauges_and_counters(tmp_path, baseline):
@@ -257,6 +308,174 @@ def test_telemetry_gauges_and_counters(tmp_path, baseline):
     text = (tmp_path / "telemetry.jsonl").read_text()
     for name in ("serving/slot_occupancy", "serving/batch_efficiency",
                  "serving/kv_token_utilization", "serving/ttft_ms", "serving/step_ms"):
+        assert name in text, f"{name} missing from telemetry stream"
+
+
+def test_prompt_exceeding_capacity_rejected_at_submit(baseline):
+    """A prompt that can never fit a slot fails at submit() with a clear
+    message — not deep inside a compiled prefill — and leaves no state
+    behind (satellite bugfix: the pre-chunking scheduler only validated
+    prompt + budget, so a too-long prompt with a tiny budget crashed in
+    the prefill program)."""
+    params, _ = baseline
+    eng = make_sched_engine(params)
+    sched = eng.scheduler()
+    with pytest.raises(ValueError, match="per-slot KV capacity"):
+        sched.submit(list(range(1, sched.max_len + 2)), max_new_tokens=1)
+    # boundary: prompt == max_len leaves no decode headroom either
+    with pytest.raises(ValueError, match="per-slot KV capacity"):
+        sched.submit([1] * sched.max_len, max_new_tokens=1)
+    assert sched.cache.total_allocs == 0 and not sched.queue
+
+
+def test_chunked_prefill_matches_legacy(baseline):
+    """Multi-chunk prefill (prompt >> chunk) produces the same tokens as the
+    monolithic-prefill scheduler, for any chunk size. (generate() parity for
+    scheduler-servable prompt lengths is test_scheduler_matches_generate —
+    the static path can't fit this prompt's padded cache on the tiny model.)"""
+    params, _ = baseline
+    prompt = [int(t) for t in np.resize(np.arange(3, 40), 100)]
+    eng_leg = make_sched_engine(params)
+    out_leg = eng_leg.scheduler(prefill_chunk=0).submit(prompt, max_new_tokens=8).result()
+    assert len(out_leg) == 8
+    for chunk in (16, 64):  # 7 chunks and 2 chunks through the state machine
+        eng = make_sched_engine(params)
+        got = eng.scheduler(prefill_chunk=chunk).submit(prompt, max_new_tokens=8).result()
+        assert (got == out_leg).all(), f"chunk={chunk} diverged from monolithic prefill"
+
+
+def test_decode_advances_during_chunked_prefill(baseline):
+    """The Sarathi-Serve property: while a long prompt chunk-prefills, live
+    decode rows keep advancing every scheduler iteration (one token in the
+    fused step + the sync's remaining K-1 decode steps — never stalling for
+    the whole prompt) and their outputs stay BIT-identical to an idle-pool
+    run."""
+    params, _ = baseline
+    long_prompt = [int(t) for t in np.resize(np.arange(3, 40), 100)]
+    eng = make_sched_engine(params, num_slots=2)
+    sched = eng.scheduler(prefill_chunk=16)
+    solo_out = sched.submit(PROMPTS[0], max_new_tokens=10).result()
+    a = sched.submit(PROMPTS[0], max_new_tokens=10)
+    sched.step()  # a admitted + prefilled (single chunk)
+    b = sched.submit(long_prompt, max_new_tokens=4)
+    sched.step()  # b's first chunk rides the fused step
+    assert sched._prefill is not None, "100-token prompt must span many chunks"
+    n_before = len(a._req.out)
+    sched.step()
+    # the fused step advances a one token and the sync's remaining K-1
+    # decode steps keep multi-step amortization (capped by a's budget)
+    n_after = len(a._req.out)
+    assert n_after > n_before, "decode stalled behind the prefill"
+    assert n_after <= n_before + sched.steps_per_sync
+    assert sched._prefill is not None
+    assert (a.result() == solo_out).all()
+    assert len(b.result()) == 4
+    sched.cache.check_invariants()
+
+
+def test_prefix_cache_hit_bit_identical_logits(baseline):
+    """Acceptance criterion: a request served via a radix prefix hit (donor
+    KV rows copied, only the suffix chunk-prefilled) produces BIT-identical
+    per-step logits to the same request cold-prefilled on a cache-less
+    scheduler."""
+    params, _ = baseline
+    prompt = [int(t) for t in np.resize(np.arange(5, 47), 70)]  # > one chunk
+    eng_cold = make_sched_engine(params, collect_logits=True)
+    sched_cold = eng_cold.scheduler(prefix_cache=False)
+    cold = sched_cold.submit(prompt, max_new_tokens=6)
+    cold_logits = cold.result_logits()
+    assert sched_cold.radix is None
+
+    eng = make_sched_engine(params, collect_logits=True)
+    sched = eng.scheduler()
+    first = sched.submit(prompt, max_new_tokens=6)
+    first_logits = first.result_logits()  # cold: registers the 70-token prefix
+    hit = sched.submit(prompt, max_new_tokens=6)
+    hit_logits = hit.result_logits()  # 64 rows copied from the donor slot
+    assert sched.radix.misses == 1 and sched.radix.hits == 1
+    assert "copy" in sched._compiled, "prefix hit must run the slot-copy program"
+    np.testing.assert_array_equal(cold_logits, first_logits)
+    np.testing.assert_array_equal(cold_logits, hit_logits)
+    assert (cold.result() == hit.result()).all()
+    sched.cache.check_invariants()
+
+
+def test_prefix_cache_single_slot_repeat_hits(baseline):
+    """Admission-for-eviction must not destroy the incoming prompt's only
+    donor: with ONE slot, re-submitting the same prompt reclaims the cached
+    donor slot itself — the freed slot IS the donor, its rows stay
+    resident (src == dst copy is a no-op), and the hit stands."""
+    params, _ = baseline
+    prompt = [int(t) for t in np.resize(np.arange(5, 47), 70)]  # > one chunk
+    eng = make_sched_engine(params, num_slots=1)
+    sched = eng.scheduler()
+    first = sched.submit(prompt, max_new_tokens=6).result()
+    again = sched.submit(prompt, max_new_tokens=6).result()
+    assert sched.radix.hits == 1 and sched.radix.misses == 1
+    assert sched.radix.evictions == 1  # the donor slot was reclaimed...
+    assert "copy" not in sched._compiled  # ...so the hit needed no copy
+    assert (first == again).all()
+    # retained lengths clamp to the registered prompt prefix: decode and
+    # K-step-overshoot rows must not inflate the utilization gauges
+    assert sched.cache.cached_tokens() == len(prompt)
+    sched.cache.check_invariants()
+
+
+def test_prefix_cache_eviction_spares_matched_donor(baseline):
+    """When OTHER cached slots exist, eviction-for-admission must pick one
+    of them over the incoming prompt's matched donor — even when the donor
+    is the least recently used registration."""
+    params, _ = baseline
+    pa = [int(t) for t in np.resize(np.arange(5, 47), 70)]
+    pb = [int(t) for t in np.resize(np.arange(90, 140), 70)]
+    eng = make_sched_engine(params, num_slots=2)
+    sched = eng.scheduler()
+    sched.submit(pa, max_new_tokens=3).result()  # donor, and the LRU entry
+    sched.submit(pb, max_new_tokens=3).result()
+    out_a = sched.submit(pa, max_new_tokens=3).result()  # must evict pb's slot
+    assert sched.radix.hits == 1 and sched.radix.evictions == 1
+    assert "copy" in sched._compiled, "spared donor should seed via slot copy"
+    assert (out_a == sched.submit(pa, max_new_tokens=3).result()).all()
+    sched.cache.check_invariants()
+
+
+def test_prefix_cache_eviction_storm_through_scheduler(baseline):
+    """More distinct prompts than slots: every admission reclaims the LRU
+    cached prefix; accounting never drifts and every request completes."""
+    params, _ = baseline
+    rng = np.random.default_rng(3)
+    eng = make_sched_engine(params, num_slots=2)
+    sched = eng.scheduler()
+    for i in range(8):
+        p = [int(t) for t in rng.integers(1, 200, int(rng.integers(2, 90)))]
+        out = sched.submit(p, max_new_tokens=3).result()
+        assert len(out) == 3
+        sched.cache.check_invariants()
+    assert sched.radix.evictions > 0
+    assert sched.cache.active_slots == 0 and sched.cache.cached_slots > 0
+    assert sched.cache.total_allocs == sched.cache.total_frees == 8
+
+
+def test_prefix_cache_and_stall_telemetry(tmp_path, baseline):
+    """Satellite: serving/prefix_cache_{hit,miss,evict} counters, the
+    hit-rate gauge, and the prefill_stall_ms histogram all reach the sink."""
+    params, _ = baseline
+    eng = make_sched_engine(params, num_slots=2,
+                            telemetry={"enabled": True, "output_path": str(tmp_path)})
+    sched = eng.scheduler()
+    shared = [int(t) for t in np.resize(np.arange(5, 47), 70)]
+    sched.submit(shared, max_new_tokens=3).result()  # miss: registers
+    sched.submit(shared, max_new_tokens=3).result()  # hit: donor copy
+    for base in (100, 140):  # distinct prompts forcing LRU eviction
+        sched.submit(list(range(base, base + 80)), max_new_tokens=3).result()
+    tel = eng.telemetry
+    assert tel.counter_total("serving/prefix_cache_hit") == 1
+    assert tel.counter_total("serving/prefix_cache_miss") == 3
+    assert tel.counter_total("serving/prefix_cache_evict") >= 1
+    assert tel.counter_total("serving/prefix_cache_hit_tokens") == 64
+    tel.flush()
+    text = (tmp_path / "telemetry.jsonl").read_text()
+    for name in ("serving/prefix_cache_hit_rate", "serving/prefill_stall_ms"):
         assert name in text, f"{name} missing from telemetry stream"
 
 

@@ -12,8 +12,15 @@ cd "$(dirname "$0")/.."
 
 guards() {
   echo "== perf-regression guards =="
+  # test_scheduler.py carries BOTH compile-count guards: the legacy bucketed
+  # bound (test_compile_count_bounded_on_mixed_stream) and the fused
+  # chunked-prefill O(1)-in-length-mix bound
+  # (test_fused_compile_count_o1_in_length_mix), plus the prefix-cache
+  # hit-vs-cold bit-identity check; test_kv_cache.py guards the slot/radix
+  # accounting invariants under eviction storms
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/unit/inference/test_scheduler.py \
+    tests/unit/inference/test_kv_cache.py \
     "tests/unit/inference/test_inference.py::test_paged_decode_kernel_vs_reference" \
     "tests/unit/inference/test_inference.py::test_decode_kernel_vs_reference" \
     -q -p no:cacheprovider
