@@ -396,6 +396,181 @@ def _shared_prefix_bench(make, num_slots, n_requests, max_new, seed,
     return out
 
 
+def _gateway_bench(model_name="gpt2-large", dtype="int8", num_slots=8,
+                   n_requests=32, max_new=64, kernel_inject=True, seed=0):
+    """Serving-gateway benchmark: the same engine serving over localhost
+    HTTP (SSE streaming) vs the in-process scheduler loop, then an
+    open-loop client swarm at 2x the measured capacity to exercise
+    admission control.
+
+    Legs:
+    - ``direct``: the request stream through ``scheduler.submit()`` in
+      process (the PR 2/3 serving loop) — the no-HTTP baseline.
+    - ``gateway``: the same stream as concurrent streamed HTTP requests;
+      per-token SSE timestamps give TTFT and inter-token latency (ITL)
+      percentiles, and ``vs`` the direct leg prices the HTTP+streaming tax.
+    - ``overload_2x``: open-loop Poisson-less arrivals at 2x the measured
+      request capacity with a bounded queue: reports the shed rate (429s),
+      that every ACCEPTED request completed in full, and accepted-TTFT p95
+      (the admission-control contract: past capacity you shed fast, you
+      don't build an unbounded queue)."""
+    import http.client
+    import threading
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm as _comm
+    from deepspeed_tpu.serving import Gateway
+
+    _comm._state["mesh"] = None
+    rng = np.random.default_rng(seed)
+    eng = deepspeed_tpu.init_inference(
+        model_name, config={"dtype": dtype, "max_out_tokens": 512,
+                            "kernel_inject": kernel_inject,
+                            "continuous_batching": {"enabled": True,
+                                                    "num_slots": num_slots}})
+    sched = eng.scheduler()
+    cap = max(8, sched.max_len - max_new - 2 * sched.steps_per_sync)
+    prompts = [rng.integers(0, eng.model_config.vocab_size,
+                            int(n)).astype(np.int32).tolist()
+               for n in rng.integers(8, min(160, cap), n_requests)]
+
+    # --- direct in-process baseline (also warms every compiled program) --
+    sched.submit(prompts[0], max_new_tokens=max_new).result()  # compile
+    t0 = time.perf_counter()
+    handles = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+    direct_toks = sum(len(h.result()) for h in handles)
+    direct = {"tokens_per_sec": round(direct_toks / (time.perf_counter() - t0), 1)}
+
+    gw = Gateway(eng, port=0, max_queue_depth=max(4, n_requests // 2),
+                 request_timeout_s=600)
+    gw.start_background()
+
+    def stream_one(prompt, rec):
+        """One streamed completion; records (status, ttft_s, itls_s, n_tok)."""
+        t_send = time.perf_counter()
+        conn = http.client.HTTPConnection("127.0.0.1", gw.port, timeout=600)
+        try:
+            conn.request("POST", "/v1/completions",
+                         json.dumps({"prompt": prompt, "max_tokens": max_new,
+                                     "stream": True}), {})
+            resp = conn.getresponse()
+            if resp.status != 200:
+                rec.append((resp.status, None, [], 0))
+                resp.read()
+                return
+            ttft, last, itls, n_tok = None, t_send, [], 0
+            while True:
+                line = resp.readline()
+                if not line:
+                    break
+                if not line.startswith(b"data: ") or b"[DONE]" in line:
+                    continue
+                now = time.perf_counter()
+                if ttft is None:
+                    ttft = now - t_send
+                else:
+                    itls.append(now - last)
+                last = now
+                n_tok += 1
+            rec.append((200, ttft, itls, n_tok))
+        except Exception:  # noqa: BLE001 — a failed client records as an error
+            rec.append(("error", None, [], 0))
+        finally:
+            conn.close()
+
+    # --- gateway closed-loop: num_slots concurrent streamed clients ------
+    rec = []
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=stream_one, args=(p, rec)) for p in prompts]
+    for i in range(0, len(threads), num_slots):
+        batch = threads[i:i + num_slots]
+        for t in batch:
+            t.start()
+        for t in batch:
+            t.join()
+    dt = time.perf_counter() - t0
+    ok = [r for r in rec if r[0] == 200]
+    toks = sum(r[3] for r in ok)
+    ttfts = sorted(r[1] * 1e3 for r in ok if r[1] is not None)
+    itls = sorted(x * 1e3 for r in ok for x in r[2])
+    gateway = {
+        "tokens_per_sec": round(toks / dt, 1),
+        "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 2) if ttfts else None,
+        "ttft_ms_p95": round(float(np.percentile(ttfts, 95)), 2) if ttfts else None,
+        "itl_ms_p50": round(float(np.percentile(itls, 50)), 2) if itls else None,
+        "itl_ms_p95": round(float(np.percentile(itls, 95)), 2) if itls else None,
+        "http_tax_vs_direct": round(
+            (toks / dt) / direct["tokens_per_sec"], 3) if toks else None,
+    }
+
+    # --- 2x overload: open-loop arrivals at twice the measured capacity --
+    capacity_rps = (toks / dt) / max_new if toks else 1.0
+    offered_rps = 2.0 * capacity_rps
+    n_over = min(2 * n_requests, 64)
+    rec2 = []
+    threads = []
+    t0 = time.perf_counter()
+    for i in range(n_over):
+        arrival = t0 + i / offered_rps
+        wait = arrival - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        t = threading.Thread(target=stream_one,
+                             args=(prompts[i % len(prompts)], rec2))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    ok2 = [r for r in rec2 if r[0] == 200]
+    shed = sum(1 for r in rec2 if r[0] == 429)
+    ttfts2 = sorted(r[1] * 1e3 for r in ok2 if r[1] is not None)
+    overload = {
+        "offered_rps": round(offered_rps, 2),
+        "requests": n_over,
+        "accepted": len(ok2),
+        "shed_429": shed,
+        "shed_rate": round(shed / n_over, 3),
+        "accepted_complete": all(r[3] == max_new for r in ok2),
+        "ttft_ms_p95_accepted": round(float(np.percentile(ttfts2, 95)), 2)
+        if ttfts2 else None,
+    }
+    drained = gw.close(timeout=120)
+    return {"direct": direct, "gateway": gateway, "overload_2x": overload,
+            "num_slots": num_slots, "max_new": max_new,
+            "drained_clean": bool(drained)}
+
+
+def gateway_main():
+    """`python bench.py gateway`: one BENCH_GATEWAY JSON line (graceful
+    structured skip on backend failure, like the other benches)."""
+    global _HEADLINE, _UNIT
+    model = os.environ.get("BENCH_GATEWAY_MODEL", "gpt2-large")
+    dtype = os.environ.get("BENCH_GATEWAY_DTYPE", "int8")
+    _HEADLINE = f"gateway: streamed HTTP decode tokens/sec ({model} {dtype})"
+    _UNIT = "tokens/sec"
+    if _ensure_backend() is None:
+        return
+    try:
+        res = _gateway_bench(
+            model_name=model,
+            dtype=dtype,
+            num_slots=int(os.environ.get("BENCH_GATEWAY_SLOTS", "8")),
+            n_requests=int(os.environ.get("BENCH_GATEWAY_REQUESTS", "32")),
+            max_new=int(os.environ.get("BENCH_GATEWAY_MAX_NEW", "64")),
+            kernel_inject=os.environ.get("BENCH_GATEWAY_KERNEL_INJECT", "1") != "0")
+    except Exception as e:  # noqa: BLE001 — a failed leg must yield structured JSON
+        _emit_skipped(f"gateway bench failed: {type(e).__name__}: {e}".splitlines()[0][:500])
+        return
+    print(json.dumps({
+        "metric": _HEADLINE,
+        "value": res["gateway"]["tokens_per_sec"],
+        "unit": _UNIT,
+        # the HTTP+SSE tax: gateway throughput over the in-process loop
+        "vs_baseline": res["gateway"]["http_tax_vs_direct"] or 0.0,
+        "extra": res,
+    }))
+
+
 def serving_main():
     """`python bench.py serving`: one BENCH_SERVING JSON line (graceful
     structured skip on backend failure, like the training bench)."""
@@ -525,5 +700,7 @@ def main():
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "serving":
         serving_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "gateway":
+        gateway_main()
     else:
         main()

@@ -78,6 +78,51 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
                                "slot); chunked-prefill mode only")
 
 
+class GatewayConfig(DeepSpeedConfigModel):
+    """Serving-gateway section (``deepspeed_tpu/serving/``): the stdlib
+    HTTP frontend over the continuous-batching scheduler — admission
+    control, per-tenant weighted fair queuing, SSE token streaming, and
+    graceful drain. See ``benchmarks/SERVING.md`` ("Gateway")."""
+
+    host = ConfigField(default="127.0.0.1")
+    port = ConfigField(default=8000, help="0 binds an ephemeral port (the bound "
+                       "port is on Gateway.port and in the ready log line)")
+    max_queue_depth = ConfigField(default=64, help="bound on requests waiting in "
+                                  "the fair queue; past it new requests shed with "
+                                  "429 + Retry-After instead of growing the queue")
+    default_max_tokens = ConfigField(default=64, help="max_tokens when the request "
+                                     "body omits it")
+    request_timeout_s = ConfigField(default=120.0, help="per-request deadline "
+                                    "(queue wait + decode); a request body's "
+                                    "'timeout_s' overrides it downward. Expired "
+                                    "requests cancel their slot mid-decode")
+    drain_timeout_s = ConfigField(default=60.0, help="SIGTERM drain grace: how long "
+                                  "to wait for admitted requests to finish before "
+                                  "forcing exit")
+    tenant_header = ConfigField(default="x-tenant-id", help="HTTP header carrying "
+                                "the tenant key (falls back to the body's 'user' "
+                                "field, then to 'anonymous')")
+    priority_header = ConfigField(default="x-priority", help="HTTP header selecting "
+                                  "the priority class (a key of priority_weights)")
+    default_priority = ConfigField(default="standard")
+    priority_weights = ConfigField(
+        default=lambda: {"interactive": 4.0, "standard": 2.0, "batch": 1.0},
+        help="priority class -> DRR weight multiplier")
+    tenant_weights = ConfigField(default=dict, help="tenant key -> DRR weight "
+                                 "(default 1.0); a 2.0 tenant gets twice the "
+                                 "admission bandwidth of a 1.0 tenant under "
+                                 "contention")
+    quantum_tokens = ConfigField(default=256, help="DRR quantum: deficit credit "
+                                 "(in estimated prompt+max_tokens units) a flow "
+                                 "earns per round-robin visit")
+    retry_after_cap_s = ConfigField(default=30, help="upper bound on the advertised "
+                                    "Retry-After")
+    max_body_bytes = ConfigField(default=1 << 22, help="largest accepted request "
+                                 "body (bytes); bigger Content-Lengths answer 413 "
+                                 "WITHOUT buffering the body — a long-lived gateway "
+                                 "must not be OOM-able by one fat POST")
+
+
 class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
     """Reference ``inference/config.py`` key parity."""
 
@@ -111,6 +156,10 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
         default=ContinuousBatchingConfig, aliases=("serving", ),
         help="continuous-batching scheduler section (slot-pool paged KV cache; "
         "see benchmarks/SERVING.md)")
+    gateway = ConfigField(
+        default=GatewayConfig,
+        help="serving-gateway section (HTTP frontend + admission control + "
+        "per-tenant fair queuing over the scheduler; see benchmarks/SERVING.md)")
 
     def __init__(self, param_dict=None):
         super().__init__(param_dict)

@@ -282,8 +282,26 @@ class InferenceEngine:
         (``ops/pallas/decode_block.py`` — the reference's fused
         qkv_gemm/softmax_context/mlp_gemm pass, pt_binding.cpp:1745):
         int8 fused-qkv serving, layernorm + sequential residual + ungated
-        MLP, no rope/alibi, MHA (nh == kv), unrolled layers, tp=1."""
+        MLP, no rope/alibi, MHA (nh == kv), unrolled layers, tp=1.
+
+        VMEM gate (ADVICE r5): the fused kernels' k-block pickers
+        (``pick_block_k``) never split a quantization group, so a coarse
+        group (``int8_group_size`` > the 1024 cap, or a dim the group size
+        doesn't divide — quantize_params then falls back to ONE group
+        spanning the whole contraction dim) forces a weight block covering
+        the full K axis, which can exceed VMEM at compile time. Such
+        configs fall back to the per-projection path instead."""
         mc = self.model_config
+
+        def _group_ok():
+            gs = getattr(mc, "int8_group_size", 0) or 128
+            # effective group per contraction dim: quantize_params uses gs
+            # only when it divides K, else the whole dim is one group
+            dims = (mc.hidden_size,                      # qkv / up K
+                    mc.num_heads * mc.head_size,         # o-proj K
+                    getattr(mc, "ffn_size", 4 * mc.hidden_size))  # down K
+            return all((gs if k % gs == 0 else k) <= 1024 for k in dims)
+
         return (getattr(mc, "int8_weights", False)
                 and getattr(mc, "int8_fused_qkv", False)
                 and getattr(mc, "scan_layers", True) is False
@@ -302,6 +320,7 @@ class InferenceEngine:
                 and getattr(mc, "attn_scale", None) is None
                 and not getattr(mc, "local_attention_layers", ())
                 and not getattr(mc, "act_quant_bits", 0)
+                and _group_ok()
                 and self.mesh.shape[dist.TENSOR_AXIS] == 1
                 and self._config.fused_decode_block)
 
@@ -310,9 +329,19 @@ class InferenceEngine:
         the quantized param tree. Built EAGERLY (no jit wrapper): the int8
         kernels and embedding pass through by reference — a jit'd rebuild
         would copy every weight into fresh buffers and double resident
-        model memory; only the small norm/bias/scale leaves convert."""
-        if getattr(self, "_fast_tree_cache", None) is not None:
-            return self._fast_tree_cache
+        model memory; only the small norm/bias/scale leaves convert.
+
+        Keyed on the param-tree OBJECT (``is``, not ``id()`` — a freed
+        tree's address can be reused by the replacement, which would
+        false-hit): replacing the param tree (a checkpoint reload onto a
+        live engine) invalidates the cache, so the fused decode path can
+        never keep serving the OLD weights while the unfused prefill uses
+        the new ones (a long-lived serving process reloads in place;
+        ADVICE r5). Holding the old tree until rebuild costs nothing extra:
+        the cached fast tree references the same weight buffers."""
+        cached = getattr(self, "_fast_tree_cache", None)
+        if cached is not None and cached[0] is self.params:
+            return cached[1]
 
         def build(params):
             mc = self.model_config
@@ -345,8 +374,8 @@ class InferenceEngine:
             return tuple(layers), head
 
         with self.mesh:
-            self._fast_tree_cache = build(self.params)
-        return self._fast_tree_cache
+            self._fast_tree_cache = (self.params, build(self.params))
+        return self._fast_tree_cache[1]
 
     def _fused_step(self, layers, head, caches, tok, pos_rows, pos, pads):
         """One fused-token decode step: embeds -> L fused layer kernels (+

@@ -107,10 +107,12 @@ def _sample_slot(seed, step, logits, do_sample, temperature, top_k, top_p):
 class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id", "do_sample",
                  "temperature", "top_k", "top_p", "seed", "slot", "out", "logits",
-                 "done", "cancelled", "submit_ts", "first_token_ts", "collect_logits")
+                 "done", "cancelled", "submit_ts", "first_token_ts", "collect_logits",
+                 "on_token")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id, do_sample,
-                 temperature, top_k, top_p, seed, collect_logits, submit_ts):
+                 temperature, top_k, top_p, seed, collect_logits, submit_ts,
+                 on_token=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -130,6 +132,7 @@ class _Request:
         self.cancelled = False
         self.submit_ts = submit_ts
         self.first_token_ts = None
+        self.on_token = on_token
 
 
 class SchedulerHandle:
@@ -248,14 +251,27 @@ class DecodeScheduler:
 
     # ------------------------------------------------------------------ API
     def submit(self, prompt, max_new_tokens=64, eos_token_id=None, do_sample=False,
-               temperature=1.0, top_k=0, top_p=1.0, seed=0, collect_logits=None):
+               temperature=1.0, top_k=0, top_p=1.0, seed=0, collect_logits=None,
+               on_token=None):
         """Enqueue one request; returns a :class:`SchedulerHandle`. The
-        request joins the decode batch as soon as a slot frees up."""
+        request joins the decode batch as soon as a slot frees up.
+
+        ``on_token(token, done)`` is an OPTIONAL host-side streaming hook,
+        called once per generated token from inside the scheduler loop (the
+        thread pumping ``step()``/``result()``), in delivery order, with
+        ``done=True`` on the request's final token. It observes tokens the
+        moment the host fetches them — the serving gateway's SSE stream
+        hangs off this — and is pure bookkeeping: hook presence cannot
+        change logits, sampling, or the compiled-program set (it runs after
+        the device step, never inside it). Hook exceptions are logged and
+        swallowed so one bad consumer can't wedge the shared decode loop.
+        Cancelled requests stop receiving callbacks; the hook is never
+        called with a token after it has seen ``done=True``."""
         tel = self.telemetry
         req = _Request(self._rid, prompt, max_new_tokens, eos_token_id, do_sample,
                        temperature, top_k, top_p, seed,
                        self.collect_logits if collect_logits is None else collect_logits,
-                       tel.now())
+                       tel.now(), on_token=on_token)
         self._rid += 1
         # validate the PROMPT alone up front (before any early return): a
         # prompt that can never fit a slot must fail here with a clear
@@ -516,6 +532,15 @@ class DecodeScheduler:
             self._release_slot(req.slot)
             if self.telemetry.enabled:
                 self.telemetry.counter("serving/evicted")
+        if req.on_token is not None:
+            # after the done/eviction decision so the hook sees the final
+            # state; a hook exception must not wedge the shared loop (the
+            # token is already delivered and the slot already settled)
+            try:
+                req.on_token(tok, req.done)
+            except Exception:
+                from ..utils.logging import logger
+                logger.warning("scheduler on_token hook raised", exc_info=True)
 
     # ------------------------------------------------------------------ decode
     def _gather_sampling(self, live):

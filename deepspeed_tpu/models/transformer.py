@@ -69,6 +69,12 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # Megatron-style biased expert FFNs. EXPLICIT on purpose (ADVICE r5):
+    # inferring from norm == 'layernorm' silently changed the param tree of
+    # every layernorm MoE model. Megatron-DeepSpeed MoE checkpoints carry
+    # expert biases — set True when loading them (MegatronPolicy.convert
+    # enforces it); HF Mixtral-family experts are bias-less (default).
+    moe_expert_bias: bool = False
     # systems
     dtype: Any = jnp.bfloat16
     scan_layers: bool = True

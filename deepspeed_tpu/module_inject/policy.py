@@ -934,7 +934,9 @@ class MegatronPolicy(InjectionPolicy):
             "Megatron checkpoints carry no config.json to derive a model from; pass the "
             "model explicitly and route the checkpoint through init_inference(model, "
             "config={'checkpoint': {'type': 'Megatron', 'checkpoints': [...], "
-            "'version': ...}})")
+            "'version': ...}}). MoE checkpoints: build the TransformerConfig with "
+            "moe_expert_bias=True (Megatron-DeepSpeed expert FFNs are biased, and "
+            "bias presence is an explicit config choice, not inferred from the norm)")
 
     _PREFIXES = ("transformer.", "")  # checkpoint families differ
 
@@ -976,6 +978,12 @@ class MegatronPolicy(InjectionPolicy):
                 # containers/megatron_gpt_moe.py + moe/experts.py's
                 # ``deepspeed_experts`` module list): per-expert biased
                 # gelu FFNs + the TopKGate's ``wg`` projection
+                if not getattr(cfg, "moe_expert_bias", False):
+                    raise ValueError(
+                        "Megatron-DeepSpeed MoE checkpoints carry expert FFN biases; "
+                        "build the model config with moe_expert_bias=True so the "
+                        "Experts module declares (and applies) them — bias presence "
+                        "is an explicit config flag, never inferred from the norm")
                 E = cfg.num_experts
                 pre = "mlp.deepspeed_moe.experts.deepspeed_experts"
                 out["moe"] = {

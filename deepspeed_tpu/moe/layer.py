@@ -69,9 +69,10 @@ class Experts(nn.Module):
             down_k = self.param("down_proj", init, (E, F, H), jnp.float32)
             gk, uk, dk = (k.astype(self.dtype) for k in (gate_k, up_k, down_k))
         if self.use_bias:  # Megatron-style biased expert FFNs
-            up_b = self.param("up_bias", nn.initializers.zeros, (E, F), jnp.float32)
             down_b = self.param("down_bias", nn.initializers.zeros, (E, H), jnp.float32)
         if self.activation in ("swiglu", "geglu"):
+            # no up_bias here: the glu branch never applies one, so declaring
+            # it would add a dead trainable param to every biased glu model
             g = jnp.einsum("ech,ehf->ecf", x, gk)
             u = jnp.einsum("ech,ehf->ecf", x, uk)
             act = nn.silu(g) if self.activation == "swiglu" else nn.gelu(g)
@@ -79,6 +80,7 @@ class Experts(nn.Module):
         else:
             h = jnp.einsum("ech,ehf->ecf", x, uk)
             if self.use_bias:
+                up_b = self.param("up_bias", nn.initializers.zeros, (E, F), jnp.float32)
                 h = h + up_b[:, None, :].astype(h.dtype)
             h = nn.gelu(h) if self.activation == "gelu" else nn.relu(h)
         out = jnp.einsum("ecf,efh->ech", h, dk)
@@ -131,7 +133,10 @@ class MoE(nn.Module):
         expert_out = Experts(E, H, cfg.ffn_size, cfg.activation, cfg.dtype,
                              int8=getattr(cfg, "int8_weights", False),
                              int8_groups=getattr(cfg, "int8_group_size", 0),
-                             use_bias=getattr(cfg, "norm", "") == "layernorm",
+                             # explicit flag, NOT inferred from cfg.norm: bias
+                             # presence changes the param tree, so it must be
+                             # a deliberate config choice (ADVICE r5)
+                             use_bias=getattr(cfg, "moe_expert_bias", False),
                              name="experts")(expert_in)
         expert_out = _expert_constraint(expert_out, P(dist.EXPERT_AXIS, None, None))
         out = jnp.einsum("nec,ech->nh", combine.astype(cfg.dtype), expert_out)

@@ -1567,7 +1567,17 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True, exclude_frozen_parameters=False):
         """Sharded, layout-independent checkpoint (reference engine.py:2802;
         the universal-checkpoint property — resumable onto a different mesh —
-        comes free because arrays are saved as global logical tensors)."""
+        comes free because arrays are saved as global logical tensors).
+
+        **Shared-filesystem requirement (param offload)**: on the
+        param-offload path only RANK 0 writes the store/client/latest files
+        (the host-resident state is replicated, and per-rank writes would
+        race on the same paths), so ``save_dir`` MUST be on a filesystem
+        visible to every process (NFS/GCS-fuse/Lustre). With per-host local
+        dirs, non-zero hosts end up with an empty ``save_dir`` and a later
+        ``load_checkpoint`` there returns ``(None, None)``. The non-offload
+        path has no such requirement: every host writes (and reads back) its
+        own shard files."""
         from .checkpoint_engine.engine import save_checkpoint as _save
         tag = tag or f"global_step{self.global_steps}"
         client_sd = dict(client_state or {})
@@ -1623,6 +1633,11 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True, load_optimizer_states=True,
                         load_lr_scheduler_states=True, load_module_only=False, custom_load_fn=None):
+        """Load a checkpoint saved by :meth:`save_checkpoint`. Param-offload
+        checkpoints are written by rank 0 only, so ``load_dir`` must be the
+        SHARED directory every process can see (see the save-side
+        docstring); a host-local dir on non-zero ranks silently has no
+        checkpoint and returns ``(None, None)``."""
         from .checkpoint_engine.engine import load_checkpoint as _load
         if self.param_stream is not None:
             from .checkpoint_engine.engine import get_latest_tag

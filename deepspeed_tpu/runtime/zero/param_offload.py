@@ -772,13 +772,22 @@ class ParamStreamRunner:
             self.global_steps += 1
             self._last_gnorm = gnorm
             self._update_scaler(bool(skipped_blocks))
+            # clip_coef: the coefficient ACTUALLY applied this step. The
+            # streaming path clips by the PREVIOUS step's norm (the true
+            # norm isn't known until every grad lands), so this surfaces
+            # the approximation — runs comparing stream vs buffered
+            # clipping can account for the one-step lag (step 1 applies
+            # unclipped: coef 1.0)
             return {"loss": loss_sum / self.gas, "grad_norm": gnorm, "lr": lr,
-                    "overflow": bool(skipped_blocks), "loss_scale": scale}
+                    "overflow": bool(skipped_blocks), "loss_scale": scale,
+                    "clip_coef": stream_coef * scale}
 
+        clip_coef = 1.0
         if not overflow:
             coef = 1.0 / self.gas / scale
             if self.clip and self.clip > 0:
-                coef *= min(1.0, self.clip / (gnorm + 1e-6))
+                clip_coef = min(1.0, self.clip / (gnorm + 1e-6))
+                coef *= clip_coef
             self.store.begin_step()
             for name in self.store.block_names():
                 slot = grads.get(name)
@@ -797,8 +806,10 @@ class ParamStreamRunner:
             self.global_steps += 1
         self._last_gnorm = gnorm
         self._update_scaler(overflow)
+        # buffered path: clip_coef is exact (computed from THIS step's norm)
         return {"loss": loss_sum / self.gas, "grad_norm": gnorm, "lr": lr,
-                "overflow": overflow, "loss_scale": scale}
+                "overflow": overflow, "loss_scale": scale,
+                "clip_coef": clip_coef}
 
     def _update_scaler(self, overflow):
         """Host-side dynamic loss scaler (reference DynamicLossScaler

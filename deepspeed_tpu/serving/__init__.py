@@ -1,0 +1,14 @@
+"""Serving gateway: streaming HTTP frontend over the continuous-batching
+scheduler — admission control, per-tenant fair queuing, graceful lifecycle.
+
+Quickstart (see ``benchmarks/SERVING.md`` "Gateway" for the full protocol)::
+
+    python -m deepspeed_tpu.serving --model gpt2-large --port 8000
+
+    curl -N localhost:8000/v1/completions -d \\
+      '{"prompt": [5, 6, 7], "max_tokens": 16, "stream": true}'
+"""
+
+from ..inference.config import GatewayConfig  # noqa: F401
+from .fair_queue import FairQueue, QueueFull  # noqa: F401
+from .gateway import Gateway  # noqa: F401

@@ -1,0 +1,76 @@
+"""``python -m deepspeed_tpu.serving``: run the serving gateway.
+
+Builds an :class:`InferenceEngine` (continuous batching on), binds the HTTP
+gateway, and serves until SIGTERM/SIGINT — which trigger a graceful drain:
+readiness flips to 503, admitted requests finish, telemetry flushes, and
+the process exits 0. Prints one ``GATEWAY_READY`` JSON line (with the bound
+port — ``--port 0`` binds an ephemeral one) once accepting traffic.
+"""
+
+import argparse
+import json
+import signal
+import sys
+
+
+def build_parser():
+    p = argparse.ArgumentParser(prog="python -m deepspeed_tpu.serving",
+                                description=__doc__.splitlines()[0])
+    p.add_argument("--model", default="gpt2-large",
+                   help="zoo model preset name (see deepspeed_tpu.models)")
+    p.add_argument("--config", default=None,
+                   help="path to a DeepSpeedInferenceConfig JSON (flags below "
+                        "override its gateway/serving sections)")
+    p.add_argument("--checkpoint", default=None, help="weights to load")
+    p.add_argument("--dtype", default=None, help="serving dtype (bf16/int8/...)")
+    p.add_argument("--host", default=None)
+    p.add_argument("--port", type=int, default=None,
+                   help="0 binds an ephemeral port (printed in GATEWAY_READY)")
+    p.add_argument("--num-slots", type=int, default=None,
+                   help="decode batch slots (continuous_batching.num_slots)")
+    p.add_argument("--max-queue-depth", type=int, default=None)
+    p.add_argument("--default-max-tokens", type=int, default=None)
+    p.add_argument("--request-timeout-s", type=float, default=None)
+    p.add_argument("--drain-timeout-s", type=float, default=None)
+    p.add_argument("--kernel-inject", action="store_true",
+                   help="enable the Pallas kernel-injected decode path")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    cfg = {}
+    if args.config:
+        with open(args.config) as f:
+            cfg = json.load(f)
+    cfg.setdefault("continuous_batching", {})["enabled"] = True
+    if args.num_slots is not None:
+        cfg["continuous_batching"]["num_slots"] = args.num_slots
+    if args.dtype is not None:
+        cfg["dtype"] = args.dtype
+    if args.checkpoint is not None:
+        cfg["checkpoint"] = args.checkpoint
+    if args.kernel_inject:
+        cfg["kernel_inject"] = True
+    gw_cfg = cfg.setdefault("gateway", {})
+    for flag, key in (("host", "host"), ("port", "port"),
+                      ("max_queue_depth", "max_queue_depth"),
+                      ("default_max_tokens", "default_max_tokens"),
+                      ("request_timeout_s", "request_timeout_s"),
+                      ("drain_timeout_s", "drain_timeout_s")):
+        val = getattr(args, flag)
+        if val is not None:
+            gw_cfg[key] = val
+
+    import deepspeed_tpu
+    from deepspeed_tpu.serving import Gateway
+
+    engine = deepspeed_tpu.init_inference(args.model, config=cfg)
+    gateway = Gateway(engine)
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: gateway.begin_drain())
+    return gateway.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -138,6 +138,7 @@ class TelemetrySink:
         self._trace_events = []  # retained chrome-trace events
         self._counters = {}      # name -> [count, total, attrs]
         self._hists = {}         # name -> sorted-on-demand observation list
+        self._last_gauges = {}   # name -> latest value (for snapshot())
         self._dropped_trace_events = 0
         self._t0 = time.perf_counter()
         self.started_at = time.time()
@@ -201,6 +202,7 @@ class TelemetrySink:
         with self._lock:
             ts = self.now()
             for name, value, step in events:
+                self._last_gauges[name] = float(value)
                 event = {"type": "gauge", "name": name, "value": float(value),
                          "ts": round(ts, 6)}
                 if step is not None:
@@ -321,3 +323,28 @@ class TelemetrySink:
     def counter_total(self, name):
         entry = self._counters.get(name)
         return entry[1] if entry else 0
+
+    def snapshot(self):
+        """Point-in-time JSON-safe view of every counter, the latest value
+        of every gauge, and each histogram's summary stats — the serving
+        gateway's ``/v1/metrics`` endpoint serves exactly this. Read-only:
+        no flush, no file I/O, safe to call from any thread (and from a
+        disabled sink, which reports whatever reached it while enabled)."""
+        with self._lock:
+            counters = {name: {"count": c, "total": t}
+                        for name, (c, t, _attrs) in self._counters.items()}
+            gauges = dict(self._last_gauges)
+            hists = {}
+            for name, obs in self._hists.items():
+                ordered = sorted(obs)
+                hists[name] = {
+                    "count": len(ordered),
+                    "sum": round(sum(ordered), 6),
+                    "min": ordered[0] if ordered else 0.0,
+                    "max": ordered[-1] if ordered else 0.0,
+                    "p50": _percentile(ordered, 0.50),
+                    "p95": _percentile(ordered, 0.95),
+                    "p99": _percentile(ordered, 0.99),
+                }
+            return {"counters": counters, "gauges": gauges, "histograms": hists,
+                    "uptime_s": round(self.now(), 3)}

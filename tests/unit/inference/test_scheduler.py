@@ -479,6 +479,61 @@ def test_prefix_cache_and_stall_telemetry(tmp_path, baseline):
         assert name in text, f"{name} missing from telemetry stream"
 
 
+def test_on_token_streams_in_delivery_order(baseline):
+    """The incremental streaming hook: on_token sees every generated token
+    in order, done=True exactly on the final one, and the hooked result
+    equals result() — for greedy AND seeded sampling, across slot reuse."""
+    params, out = baseline
+    eng = make_sched_engine(params, num_slots=2)
+    sched = eng.scheduler()
+    seen = {}
+
+    def hook(name):
+        seen[name] = []
+        return lambda tok, done: seen[name].append((tok, done))
+
+    hs = [sched.submit(PROMPTS[0], max_new_tokens=8, on_token=hook("a")),
+          sched.submit(PROMPTS[1], max_new_tokens=5, do_sample=True, seed=3,
+                       on_token=hook("b")),
+          sched.submit(PROMPTS[0], max_new_tokens=8, on_token=hook("c"))]
+    res = [h.result() for h in hs]
+    assert [t for t, _ in seen["a"]] == list(res[0]) == list(out[0])
+    assert [t for t, _ in seen["b"]] == list(res[1])
+    assert [t for t, _ in seen["c"]] == list(res[2])
+    for evs in seen.values():
+        assert [d for _, d in evs] == [False] * (len(evs) - 1) + [True]
+    # zero-budget edge: done at submit, the hook never fires
+    h0 = sched.submit(PROMPTS[0], max_new_tokens=0, on_token=hook("z"))
+    assert h0.done and seen["z"] == []
+
+
+def test_on_token_changes_nothing(baseline):
+    """Hook presence must not change logits or the compiled-program set —
+    it runs host-side after the fetch, never inside a program. A raising
+    hook is logged and swallowed: delivery and the shared loop continue."""
+    params, _ = baseline
+    eng = make_sched_engine(params, num_slots=2, collect_logits=True)
+    sched = eng.scheduler()
+    plain = sched.submit(PROMPTS[0], max_new_tokens=6)
+    plain_logits = plain.result_logits()
+    programs_before = sched.compiled_program_count()
+    toks = []
+    hooked = sched.submit(PROMPTS[0], max_new_tokens=6,
+                          on_token=lambda tok, done: toks.append(tok))
+    hooked_logits = hooked.result_logits()
+    np.testing.assert_array_equal(plain_logits, hooked_logits)
+    assert (plain.result() == hooked.result()).all()
+    assert toks == list(hooked.result())
+    assert sched.compiled_program_count() == programs_before
+
+    def bad_hook(tok, done):
+        raise RuntimeError("consumer bug")
+
+    broken = sched.submit(PROMPTS[1], max_new_tokens=4, on_token=bad_hook)
+    assert len(broken.result()) == 4  # delivery survived the raising hook
+    assert sched.cache.active_slots == 0
+
+
 def test_abandoned_submit_handle_never_raises(baseline):
     """_Handle.__del__ must settle the queue-depth gauge and never raise —
     even when the handle is dropped without result() (satellite: teardown

@@ -128,7 +128,8 @@ def test_megatron_moe_conversion():
     cfg = TransformerConfig(vocab_size=V, hidden_size=H, num_layers=1, num_heads=4,
                             max_seq_len=32, pos_embedding="learned", norm="layernorm",
                             activation="gelu", tie_embeddings=True, num_experts=E,
-                            moe_top_k=2, intermediate_size=F, dtype=jnp.float32)
+                            moe_top_k=2, intermediate_size=F, dtype=jnp.float32,
+                            moe_expert_bias=True)
     r = np.random.default_rng(5)
     sd = {
         "word_embeddings.weight": r.standard_normal((V, H)).astype(np.float32),

@@ -2,8 +2,9 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards
+#   tools/ci_check.sh            # tier-1 + guards + gateway smoke
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
+#   tools/ci_check.sh --gateway  # gateway smoke only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
 # pass count the growth driver tracks (ROADMAP.md "Tier-1 verify").
@@ -17,17 +18,31 @@ guards() {
   # chunked-prefill O(1)-in-length-mix bound
   # (test_fused_compile_count_o1_in_length_mix), plus the prefix-cache
   # hit-vs-cold bit-identity check; test_kv_cache.py guards the slot/radix
-  # accounting invariants under eviction storms
+  # accounting invariants under eviction storms; test_gateway.py guards the
+  # serving gateway's admission/fairness/lifecycle contracts
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/unit/inference/test_scheduler.py \
     tests/unit/inference/test_kv_cache.py \
+    tests/unit/serving/test_gateway.py \
     "tests/unit/inference/test_inference.py::test_paged_decode_kernel_vs_reference" \
     "tests/unit/inference/test_inference.py::test_decode_kernel_vs_reference" \
     -q -p no:cacheprovider
 }
 
+gateway_smoke() {
+  echo "== gateway smoke =="
+  # black-box lifecycle of `python -m deepspeed_tpu.serving`: ephemeral
+  # port, one streamed completion, one shed (429 + Retry-After), the
+  # compiled-program bound via /v1/metrics, SIGTERM drain exits 0
+  timeout -k 10 420 env JAX_PLATFORMS=cpu python tools/gateway_smoke.py
+}
+
 if [ "${1:-}" = "--guards" ]; then
   guards
+  exit $?
+fi
+if [ "${1:-}" = "--gateway" ]; then
+  gateway_smoke
   exit $?
 fi
 
@@ -44,4 +59,7 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 guards
 g_rc=$?
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ]
+gateway_smoke
+gw_rc=$?
+
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ]
