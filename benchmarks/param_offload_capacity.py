@@ -5,9 +5,11 @@ bf16 weights host-resident and streamed per layer block
 (``runtime/zero/param_offload.py``), and records the evidence file
 ``benchmarks/param_offload_capacity.json`` that ``bench.py`` folds into
 its output — including the per-phase wall breakdown
-(``runner.last_phase_times``: total step, time BLOCKED draining grad
-fetches/applies, host->device param-put dispatch time) that makes the
-prefetch-overlap claim measurable (VERDICT r4 weak #5).
+(``runner.last_phase_times``: total step, critical-path put/fetch
+exposure, dispatch vs FENCED realized transfer time, and the derived
+``overlap_efficiency``) that makes the prefetch-overlap claim measurable
+with realized — not dispatched — transfers (VERDICT r4 weak #5; see
+``benchmarks/OFFLOAD.md``).
 
 Usage: python benchmarks/param_offload_capacity.py [model] [steps] [seq]
 Defaults: llama2-7b 1 512 (the 6.7B-on-one-16GB-chip headline; on the dev
@@ -52,7 +54,8 @@ def main():
         t0 = time.perf_counter()
         losses.append(float(engine.train_batch(batch=batch)))
         step_s.append(round(time.perf_counter() - t0, 1))
-        phases.append({k: round(v, 1) for k, v in
+        # seconds round to 0.1s; the overlap_efficiency RATIO keeps 3 places
+        phases.append({k: round(v, 3 if k == "overlap_efficiency" else 1) for k, v in
                        (engine.param_stream.last_phase_times or {}).items()})
 
     out = {
@@ -62,8 +65,10 @@ def main():
         "losses": [round(l, 4) for l in losses],
         "init_s": round(init_s, 1),
         "step_s": step_s,
-        # overlap evidence: step_s - (drain_s + put_s) is the compute the
-        # host link successfully hid behind
+        # overlap evidence: put_s/drain_s are CRITICAL-PATH exposure (the
+        # streaming executor fences transfers, so prefetched puts no longer
+        # count), put_realized_s is total fenced transfer time, and
+        # overlap_efficiency = 1 - exposed/realized is the hidden fraction
         "phase_times": phases,
         "peak_host_dram_bytes": resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024,
         "gradient_clipping": 1.0,

@@ -1124,8 +1124,24 @@ class DeepSpeedEngine:
             if t0 is not None:
                 dur = time.perf_counter() - t0
                 self._last_step_dur = dur
-                self.telemetry.record_span("step", self.telemetry.now() - dur, dur,
-                                           attrs={"path": "param_stream"})
+                pt = self.param_stream.last_phase_times or {}
+                self.telemetry.record_span(
+                    "step", self.telemetry.now() - dur, dur,
+                    attrs={"path": "param_stream",
+                           "overlap_efficiency": round(pt.get("overlap_efficiency", 0.0), 4)})
+                # realized (not dispatched) transfer-overlap evidence: the
+                # executor fences every put, so these separate issue time
+                # from transfer completion from critical-path exposure
+                self.telemetry.gauges([
+                    ("offload/put_dispatch_ms", pt.get("put_dispatch_s", 0.0) * 1e3,
+                     self.global_samples),
+                    ("offload/put_realized_ms", pt.get("put_realized_s", 0.0) * 1e3,
+                     self.global_samples),
+                    ("offload/fetch_wait_ms", pt.get("drain_s", 0.0) * 1e3,
+                     self.global_samples),
+                    ("offload/overlap_efficiency", pt.get("overlap_efficiency", 0.0),
+                     self.global_samples),
+                ])
             self._report(metrics)
             if self.lr_scheduler is not None:
                 self.lr_scheduler.last_batch_iteration = self.global_steps

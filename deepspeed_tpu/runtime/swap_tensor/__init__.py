@@ -1,2 +1,3 @@
 from .aio_config import get_aio_config  # noqa: F401
 from .optimizer_swapper import NVMeOffloadOptimizer  # noqa: F401
+from .read_window import AioReadWindow  # noqa: F401

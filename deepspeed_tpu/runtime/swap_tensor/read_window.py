@@ -1,0 +1,67 @@
+"""Rotating NVMe read window: per-slot AIO handles + persistent buffers.
+
+The ZeRO-Infinity streaming pipeline wants optimizer-state reads issued
+``k`` blocks ahead of the block being applied (reference
+``pipelined_optimizer_swapper.py:164`` keeps an ``aio_read``/``aio_write``
+pair in flight around the CPU Adam step). A single shared
+:class:`~deepspeed_tpu.ops.aio.AsyncIOHandle` cannot express that: its
+``wait()`` fences *every* submitted request, so waiting for block ``i``'s
+state would also wait for the look-ahead reads of ``i+1..i+k`` that were
+just issued — serializing exactly the overlap the prefetch exists to buy.
+
+:class:`AioReadWindow` rotates a small pool of slots. Each slot owns a
+private AIO handle (so its ``wait()`` fences only its own block) plus
+persistent 4096-aligned buffers, keyed by flat block size and reused across
+steps instead of reallocated per prefetch — the staging-buffer half of the
+pipeline (host DRAM high-water mark: ``slots x bufs_per_block x
+max_block_bytes``, independent of step count).
+
+A slot's buffers may still be riding a write-back when the slot would
+otherwise be reused; callers hand such slots back through
+``release(slot)`` only once the write has been fenced (see
+``NVMeParamStore.apply_block``).
+"""
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle, aligned_empty
+
+
+class _Slot:
+    """One window slot: a private AIO handle + its persistent buffers."""
+
+    __slots__ = ("handle", "_bufs")
+
+    def __init__(self, handle_kw):
+        self.handle = AsyncIOHandle(**handle_kw)
+        self._bufs = {}  # (n, count) -> tuple of flat fp32 aligned buffers
+
+    def buffers(self, n, count):
+        """``count`` persistent aligned fp32 buffers of flat size ``n``."""
+        key = (int(n), int(count))
+        bufs = self._bufs.get(key)
+        if bufs is None:
+            bufs = tuple(aligned_empty((int(n), ), np.float32) for _ in range(count))
+            self._bufs[key] = bufs
+        return bufs
+
+
+class AioReadWindow:
+    """Pool of read slots; acquire one per in-flight block, release after
+    the block's buffers are no longer referenced by any async request."""
+
+    def __init__(self, slots, handle_kw):
+        self._slots = [_Slot(handle_kw) for _ in range(max(1, int(slots)))]
+        self._free = list(self._slots)
+
+    def acquire(self):
+        """A free slot, or None when the window is saturated (the caller
+        falls back to its synchronous path)."""
+        return self._free.pop() if self._free else None
+
+    def release(self, slot):
+        self._free.append(slot)
+
+    @property
+    def size(self):
+        return len(self._slots)

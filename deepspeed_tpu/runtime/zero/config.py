@@ -41,6 +41,20 @@ class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
     pin_memory = ConfigField(default=False)
 
 
+def _check_nonneg_int(value):
+    value = int(value)
+    if value < 0:
+        raise ValueError(f"expected a non-negative integer, got {value}")
+    return value
+
+
+def _check_pos_int(value):
+    value = int(value)
+    if value < 1:
+        raise ValueError(f"expected a positive integer, got {value}")
+    return value
+
+
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     device = ConfigField(default=OffloadDeviceEnum.none, validator=_check_offload_device)
     nvme_path = ConfigField(default=None)
@@ -50,6 +64,17 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     pipeline_write = ConfigField(default=False)
     fast_init = ConfigField(default=False)
     ratio = ConfigField(default=1.0)
+    # ZeRO-Infinity streaming pipeline (zero/param_offload.py
+    # LayerStreamExecutor): depth of the bidirectional host->device
+    # parameter / NVMe optimizer-state look-ahead, and the max in-flight
+    # gradient device->host fetches. prefetch_depth=0 is the fully
+    # SYNCHRONOUS no-overlap step (every put fenced at point of use) — a
+    # measurement/debug mode, slower than the pre-pipeline 1-deep async
+    # look-ahead; use prefetch_depth=1 for that legacy behavior. Numerics
+    # are bit-identical at any setting; each extra depth step costs ~one
+    # layer block of HBM headroom.
+    prefetch_depth = ConfigField(default=2, validator=_check_nonneg_int)
+    fetch_window = ConfigField(default=4, validator=_check_pos_int)
 
     @property
     def pipeline(self):

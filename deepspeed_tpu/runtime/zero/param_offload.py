@@ -33,10 +33,25 @@ The NVMe tier (``NVMeParamStore``) keeps master/m/v in flat per-block files
 under ``nvme_path`` via the AIO pool (``ops/csrc/aio.c``) and bounds DRAM to
 the bf16 compute copies plus a rotating read/compute/write window, the
 pipelined-swapper scheme of ``swap_tensor/optimizer_swapper.py``.
+
+All four host<->device/NVMe flows of the step are pipelined by
+:class:`LayerStreamExecutor` (the prefetch-coordinator role of the
+reference's ``PartitionedParameterCoordinator``): depth-``k`` parameter
+prefetch in both traversal directions, a bounded-window async gradient
+fetch queue, persistent staging buffers, and NVMe optimizer-state reads
+scheduled ``k`` blocks ahead of ``apply_block``. Knobs:
+``zero_optimization.offload_optimizer.prefetch_depth`` / ``fetch_window``
+(``zero/config.py``); ``prefetch_depth=0`` degenerates to the synchronous
+point-of-use put — bit-identical numerics by construction, the executor
+moves bytes, never math.
 """
 
 import os
 import json
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -98,6 +113,240 @@ def _num_params(tree):
                for x in jax.tree_util.tree_leaves(tree))
 
 
+# Transfer-completion fence pool — module-level because test suites build
+# many engines (per-runner pools would leak threads). Fences only OBSERVE
+# (block_until_ready + a timestamp); puts dispatch from the caller's thread
+# so DMA stays in traversal order with no GIL ping-pong on the hot loop.
+_FENCE_POOL = ThreadPoolExecutor(max_workers=4, thread_name_prefix="offload-fence")
+
+
+class LayerStreamExecutor:
+    """Double-buffered bidirectional streaming transfer executor.
+
+    Pipelines the four data flows of the offload step against compute:
+
+    1. **Parameter prefetch** (host->device, both traversal directions):
+       ``take(name, ahead=...)`` returns the device tree for ``name`` and
+       issues (asynchronous) puts for the caller's next ``prefetch_depth``
+       blocks in its OWN walk order — the backward loop passes its reversed
+       layer order and gets the same look-ahead the forward loop has.
+    2. **Gradient fetch queue** (device->host, bounded window):
+       ``submit_fetch`` runs grad fetches/applies on the transfer pool and
+       blocks only when more than ``fetch_window`` are in flight, so
+       ``grad_sink`` work drains while the next layer's backward computes.
+    3. **Persistent staging buffers**: ``stage_grad`` accumulates into
+       per-(block, leaf) host buffers reused across microbatches and steps
+       (generation-tagged: first write of a step overwrites in place, later
+       writes add) instead of reallocating full-model-size accumulators.
+    4. **NVMe optimizer-state look-ahead**: ``schedule_state_prefetch``
+       forwards the predicted apply order to the store so state reads run
+       ``prefetch_depth`` blocks ahead of ``apply_block`` (no-op on the
+       host tier, whose state is already DRAM-resident).
+
+    Accounting separates DISPATCH (wall time issuing ``jax.device_put``,
+    wherever it runs), REALIZED (dispatch -> transfer-completion fence via
+    ``jax.block_until_ready`` on a fence thread; reported as the UNION of
+    in-flight spans so k overlapping transfers count each wall second once)
+    and WAIT (main-thread blocked time) — so prefetched puts stop counting
+    against the critical path and the step can report *realized* (not
+    dispatched) overlap:
+    ``overlap_efficiency = 1 - exposed_wait / realized_transfer``.
+    """
+
+    def __init__(self, dispatch_fn, store, prefetch_depth, fetch_window):
+        self._dispatch = dispatch_fn  # block name -> device pytree
+        self._store = store
+        self.depth = max(0, int(prefetch_depth))
+        self.window = max(1, int(fetch_window))
+        self._puts = {}          # name -> in-flight put entry
+        self._fetches = deque()  # in-flight grad fetch futures
+        self._fences = []        # transfer-completion fence futures (per step)
+        self._grad_stage = {}    # (name, path) -> persistent host accumulator
+        self._stage_gen = {}     # (name, path) -> generation last written
+        self._gen = 0
+        self._lock = threading.Lock()
+        self.reset_stats()
+
+    def reset_stats(self):
+        self.stats = {"put_dispatch_s": 0.0, "put_wait_s": 0.0,
+                      "fetch_wait_s": 0.0, "puts": 0, "puts_prefetched": 0}
+        # realized transfer time is the UNION of in-flight spans (wall-clock
+        # busy time): with k transfers in flight, summing per-transfer
+        # durations would count the same wall second k times and bias
+        # overlap_efficiency toward 1. [accumulated_busy, last_span_end]
+        self._busy = {"put": [0.0, 0.0], "fetch": [0.0, 0.0]}
+
+    def _bump(self, key, dt):
+        with self._lock:
+            self.stats[key] += dt
+
+    def _bump_busy(self, key, t0, t1):
+        """Fold span [t0, t1] into ``key``'s busy-interval union (spans
+        arrive roughly in completion order; a span ending before an already
+        counted end is fully inside the counted region)."""
+        with self._lock:
+            acc, last = self._busy[key]
+            if t1 > last:
+                self._busy[key] = [acc + t1 - max(t0, last), t1]
+
+    def begin_step(self):
+        """Reset per-step transfer stats and advance the staging generation
+        (first ``stage_grad`` write of the new step overwrites in place)."""
+        # join stragglers before the generation bump: a fetch stranded by an
+        # aborted step would otherwise run AFTER the bump and tag its stale
+        # data with the new generation (the retry's first contribution would
+        # then accumulate instead of overwriting); a late fence would fold
+        # its span into this step's busy union with a stale start time
+        while self._fetches:
+            try:
+                self._fetches.popleft().result()
+            except Exception:  # noqa: BLE001 — the aborted step already
+                pass           # surfaced this; its data is discarded
+        for f in self._fences:
+            f.result()
+        self._fences = []
+        self._gen += 1
+        self.invalidate()
+        with self._lock:
+            self.reset_stats()
+
+    def invalidate(self):
+        """Drop in-flight puts. A normally-completed walk consumes every
+        put, but an aborted step can strand entries whose host buffers the
+        applies have since mutated — stale snapshots must never be served."""
+        self._puts.clear()
+
+    def collect_stats(self):
+        """Join outstanding fences (cheap once the step's work has drained)
+        and return this step's transfer accounting."""
+        for f in self._fences:
+            f.result()
+        self._fences = []
+        with self._lock:
+            out = dict(self.stats)
+            out["put_realized_s"] = self._busy["put"][0]
+            out["fetch_realized_s"] = self._busy["fetch"][0]
+            return out
+
+    # -- flow 1: host->device parameter streaming ---------------------------
+    def _dispatch_timed(self, name):
+        """Issue the put (asynchronous on the device stream) and fence its
+        completion on the observer pool. Returns (device_tree, fence)."""
+        t0 = time.perf_counter()
+        val = self._dispatch(name)
+        self._bump("put_dispatch_s", time.perf_counter() - t0)
+
+        def fence():
+            jax.block_until_ready(val)
+            self._bump_busy("put", t0, time.perf_counter())
+        f = _FENCE_POOL.submit(fence)
+        # outside a train step (eval/generate never call begin_step /
+        # collect_stats) the fence list would grow one future per put
+        # forever; prune the completed ones once it gets long
+        if len(self._fences) > 256:
+            self._fences = [p for p in self._fences if not p.done()]
+        self._fences.append(f)
+        return val, f
+
+    def prefetch(self, names):
+        """Issue puts for ``names`` now (skips in-flight blocks; no-op at
+        depth 0). ``jax.device_put`` is asynchronous, so issuing ``k``
+        blocks ahead keeps that many transfers in flight behind the
+        device's compute stream — double-buffering without handing the
+        dispatch to another thread (which would fight the hot loop for
+        the GIL and reorder DMA)."""
+        if self.depth == 0:
+            return
+        for name in names:
+            if name not in self._puts:
+                self._puts[name] = self._dispatch_timed(name)
+
+    def take(self, name, ahead=()):
+        """Device tree for ``name``. Issues ``name`` (if cold) plus
+        ``ahead`` (the caller's next blocks in walk order, truncated to the
+        prefetch depth), so the pipeline stays ``depth`` blocks deep in
+        either traversal direction. At depth 0 the put is fenced at point
+        of use — the genuinely unpipelined step: compute never overlaps a
+        parameter transfer (the measurement baseline, and the reference's
+        no-prefetch hook semantics of fetch-then-forward)."""
+        was_ahead = name in self._puts  # issued by an EARLIER take's look-ahead
+        self.prefetch([name])
+        self.prefetch(list(ahead)[:self.depth])
+        ent = self._puts.pop(name, None)
+        t0 = time.perf_counter()
+        if ent is None:  # depth 0: synchronous point-of-use put
+            val, fence = self._dispatch_timed(name)
+            fence.result()
+        else:
+            val, _ = ent
+        with self._lock:
+            self.stats["put_wait_s"] += time.perf_counter() - t0
+            self.stats["puts"] += 1
+            self.stats["puts_prefetched"] += was_ahead
+        return val
+
+    # -- flow 2: bounded-window async gradient fetch ------------------------
+    def timed_fetch(self):
+        """Context manager bracketing the device->host TRANSFER portion of a
+        fetch into the fetch busy union. The fetch fn wraps only its
+        ``device_get`` section with this — timing the whole fn would count
+        the host AdamW apply as 'realized transfer' and inflate
+        overlap_efficiency with compute that was never a transfer."""
+        ex = self
+
+        class _Span:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *exc):
+                ex._bump_busy("fetch", self.t0, time.perf_counter())
+                return False
+        return _Span()
+
+    def submit_fetch(self, fn):
+        """Run ``fn`` (a grad fetch / streaming apply) on the transfer pool;
+        block only while more than ``fetch_window`` fetches are in flight."""
+        self._fetches.append(_TRANSFER_POOL.submit(fn))
+        t0 = time.perf_counter()
+        while len(self._fetches) > self.window:
+            self._fetches.popleft().result()
+        self._bump("fetch_wait_s", time.perf_counter() - t0)
+
+    def drain_fetches(self):
+        """Block until every in-flight fetch has landed (microbatch
+        boundary: same-slot fetches accumulate in place and must not race
+        the next microbatch's contributions)."""
+        t0 = time.perf_counter()
+        while self._fetches:
+            self._fetches.popleft().result()
+        self._bump("fetch_wait_s", time.perf_counter() - t0)
+
+    # -- flow 3: persistent grad staging ------------------------------------
+    def stage_grad(self, name, path, host, dtype):
+        """Accumulate ``host`` into the persistent ``(name, path)`` staging
+        buffer and return it. The buffer is allocated once and reused across
+        microbatches AND steps; the generation tag decides overwrite-vs-add."""
+        key = (name, path)
+        buf = self._grad_stage.get(key)
+        if buf is None or buf.shape != np.shape(host) or buf.dtype != np.dtype(dtype):
+            buf = np.empty(np.shape(host), dtype)
+            self._grad_stage[key] = buf
+            self._stage_gen[key] = -1
+        if self._stage_gen[key] != self._gen:
+            np.copyto(buf, host, casting="unsafe")
+            self._stage_gen[key] = self._gen
+        else:
+            np.add(buf, np.asarray(host, buf.dtype), out=buf)
+        return buf
+
+    # -- flow 4: NVMe optimizer-state look-ahead ----------------------------
+    def schedule_state_prefetch(self, names):
+        """Issue state reads for the next blocks of the apply order (host
+        tier: no-op; depth 0: disabled like the other flows)."""
+        if self.depth and names:
+            self._store.schedule_state_prefetch(names[:self.depth])
+
+
 class HostParamStore:
     """cpu tier: every block's fp32 master + Adam moments + bf16 compute copy
     in host DRAM. A block is a param pytree (one layer's slice of the stacked
@@ -141,6 +390,10 @@ class HostParamStore:
         """Slash paths of the block's master leaves, flatten order."""
         flat = jax.tree_util.tree_flatten_with_path(self.blocks[name]["master"])[0]
         return [_slash_path(p) for p, _ in flat]
+
+    def schedule_state_prefetch(self, names):
+        """Optimizer-state look-ahead hook (flow 4): host-tier master/m/v
+        are already DRAM-resident, so there is nothing to prefetch."""
 
     # -- update -----------------------------------------------------------
     def begin_step(self):
@@ -212,10 +465,11 @@ class NVMeParamStore(HostParamStore):
     the pipelined swapper scheme of ``swap_tensor/optimizer_swapper.py``."""
 
     def __init__(self, optimizer_config, nvme_path, aio_config=None, grad_dtype=np.float32,
-                 compute_dtype=None):
+                 compute_dtype=None, state_window=2):
         super().__init__(optimizer_config, grad_dtype, compute_dtype)
         from ...ops.aio import AsyncIOHandle
         from ..swap_tensor.aio_config import get_aio_config
+        from ..swap_tensor.read_window import AioReadWindow
         aio = aio_config if aio_config is not None else get_aio_config({})
         kw = dict(block_size=aio["block_size"], queue_depth=aio["queue_depth"],
                   single_submit=aio["single_submit"], overlap_events=aio["overlap_events"],
@@ -226,11 +480,21 @@ class NVMeParamStore(HostParamStore):
                                      f"zero_param_swap_rank{jax.process_index():05d}")
         os.makedirs(self.swap_dir, exist_ok=True)
         self._meta = {}  # name -> list[(path, shape)] flat leaf layout
-        self._prefetched = {}  # name -> pinned (master, m, v) flat arrays in flight
-        import threading
+        # state-read look-ahead: one slot per in-flight block, each with a
+        # private AIO handle (a shared handle's wait() would fence the
+        # look-ahead reads too) + persistent buffers. DRAM bound:
+        # slots x 3 x largest block x 4 bytes.
+        self._window = AioReadWindow(max(2, int(state_window)), kw)
+        self._prefetched = {}   # name -> _Slot with (master, m, v) in flight
+        self._writing_slot = None  # slot whose buffers ride the current write
+        self._applied_step = set()  # blocks already applied this step: a
+        # late look-ahead for one of these would pread files whose
+        # write-back may still be in flight, and park a window slot
+        self._grad_stage = {}   # flat size -> persistent fp32 grad staging
         # streaming applies arrive from transfer-pool threads; the shared
-        # read/write AIO handles and prefetch window are single-consumer
-        self._apply_lock = threading.Lock()
+        # read/write AIO handles and prefetch window are single-consumer.
+        # RLock: prefetch_state is public and also called under apply_block.
+        self._apply_lock = threading.RLock()
 
     def _file(self, name, kind):
         return os.path.join(self.swap_dir, f"{name.replace('/', '_')}.{kind}")
@@ -254,31 +518,84 @@ class NVMeParamStore(HostParamStore):
     def _block_size(self, name):
         return sum(int(np.prod(s, dtype=np.int64)) for _, s in self._meta[name])
 
+    def begin_step(self):
+        super().begin_step()
+        with self._apply_lock:
+            self._applied_step.clear()
+
     def prefetch_state(self, name):
-        """Issue async reads of (master, m, v) for ``name``."""
-        if name in self._prefetched:
-            return
-        n = self._block_size(name)
-        bufs = tuple(aligned_empty((n, ), np.float32) for _ in range(3))
-        for buf, kind in zip(bufs, ("master", "m", "v")):
-            self._read_h.async_pread(buf, self._file(name, kind))
-        self._prefetched[name] = bufs
+        """Issue async reads of (master, m, v) for ``name`` into a free
+        read-window slot. No-op when already in flight, already applied
+        this step (a late look-ahead racing its own write-back), or the
+        window is saturated (``apply_block`` then falls back to a
+        synchronous read)."""
+        with self._apply_lock:
+            if name in self._prefetched or name in self._applied_step:
+                return
+            slot = self._window.acquire()
+            if slot is None:
+                return
+            for buf, kind in zip(slot.buffers(self._block_size(name), 3),
+                                 ("master", "m", "v")):
+                slot.handle.async_pread(buf, self._file(name, kind))
+            self._prefetched[name] = slot
+
+    def schedule_state_prefetch(self, names):
+        """Flow-4 hook: issue look-ahead state reads for the next blocks of
+        the apply order (stops silently when the window saturates)."""
+        for name in names:
+            if name in self.blocks:
+                self.prefetch_state(name)
 
     def master_paths(self, name):
         return [p for p, _ in self._meta[name]]
 
+    def _stage_grads(self, name, grad_leaves):
+        """Flatten grad leaves into a persistent per-size staging buffer
+        (applies serialize on the apply lock, so one buffer per distinct
+        block size suffices — no per-apply reallocation)."""
+        n = self._block_size(name)
+        g = self._grad_stage.get(n)
+        if g is None:
+            g = aligned_empty((n, ), np.float32)
+            self._grad_stage[n] = g
+        off = 0
+        for x in grad_leaves:
+            x = np.ascontiguousarray(x)
+            g[off:off + x.size] = x.reshape(-1)  # numpy casts to fp32 in place
+            off += x.size
+        return g
+
     def apply_block(self, name, grad_leaves, grad_coef, lr):
         assert len(grad_leaves) == len(self._meta[name])
         with self._apply_lock:
-            self.prefetch_state(name)
-            self._read_h.wait()
-            master, m, v = self._prefetched.pop(name)
-            g = np.concatenate([np.ascontiguousarray(x).ravel().astype(np.float32)
-                                for x in grad_leaves])
+            slot = self._prefetched.pop(name, None)
+            if slot is None:
+                slot = self._window.acquire()
+                if slot is not None:  # cold read through a window slot
+                    for buf, kind in zip(slot.buffers(self._block_size(name), 3),
+                                         ("master", "m", "v")):
+                        slot.handle.async_pread(buf, self._file(name, kind))
+            if slot is not None:
+                slot.handle.wait()
+                master, m, v = slot.buffers(self._block_size(name), 3)
+            else:  # window fully busy: one-off buffers via the shared handle
+                bufs = tuple(aligned_empty((self._block_size(name), ), np.float32)
+                             for _ in range(3))
+                for buf, kind in zip(bufs, ("master", "m", "v")):
+                    self._read_h.async_pread(buf, self._file(name, kind))
+                self._read_h.wait()
+                master, m, v = bufs
+            self._applied_step.add(name)
+            g = self._stage_grads(name, grad_leaves)
             self.opt.step(master, m, v, g, self.t, lr=lr, grad_coef=grad_coef)
-            # write-back overlaps the next block's read + compute
+            # write-back overlaps the next block's read + compute; the slot
+            # (and its buffers) rejoins the free window only after the NEXT
+            # wait() proves the write consumed them
             self._write_h.wait()
-            self._wb_keepalive = (master, m, v)  # pin until the next wait()
+            if self._writing_slot is not None:
+                self._window.release(self._writing_slot)
+            self._writing_slot = slot  # None for the one-off path (GC'd)
             for buf, kind in zip((master, m, v), ("master", "m", "v")):
                 self._write_h.async_pwrite(buf, self._file(name, kind))
             # refresh bf16 views from the updated flat master
@@ -292,7 +609,15 @@ class NVMeParamStore(HostParamStore):
     def flush(self):
         with self._apply_lock:
             self._write_h.wait()
-            self._wb_keepalive = None
+            if self._writing_slot is not None:
+                self._window.release(self._writing_slot)
+                self._writing_slot = None
+            # stale look-aheads (e.g. a skipped non-finite block): fence and
+            # reclaim their slots so the window never leaks
+            for name, slot in list(self._prefetched.items()):
+                slot.handle.wait()
+                self._window.release(slot)
+            self._prefetched.clear()
 
     def save_to(self, tag_dir):
         self.flush()
@@ -417,6 +742,13 @@ class ParamStreamRunner:
 
         off = cfg.zero_optimization.offload_param
         opt_cfg = cfg.optimizer
+        # streaming-pipeline knobs live on offload_optimizer (offload_param
+        # subsumes it here — the streamed step keeps optimizer state host/
+        # NVMe-resident by construction, so its tuning section is the one
+        # that configures the transfer executor)
+        opt_off = cfg.zero_optimization.offload_optimizer
+        self.prefetch_depth = max(0, int(getattr(opt_off, "prefetch_depth", 2)))
+        self.fetch_window = max(1, int(getattr(opt_off, "fetch_window", 4)))
         store_dtype = np.dtype(jnp.dtype(compute_dtype).name)  # bf16 or fp16 copies
         grad_dtype = store_dtype if self.gas == 1 else np.float32
         if off.device == "nvme":
@@ -425,17 +757,20 @@ class ParamStreamRunner:
             from ..swap_tensor.aio_config import get_aio_config
             self.store = NVMeParamStore(opt_cfg, nvme_path=off.nvme_path,
                                         aio_config=get_aio_config(cfg.raw_config),
-                                        grad_dtype=grad_dtype, compute_dtype=store_dtype)
+                                        grad_dtype=grad_dtype, compute_dtype=store_dtype,
+                                        state_window=min(4, self.prefetch_depth + 1))
         else:
             self.store = HostParamStore(opt_cfg, grad_dtype=grad_dtype,
                                         compute_dtype=store_dtype)
         self._grad_dtype = grad_dtype
 
         self._init_store()
+        self._layer_names = [f"layer{l:05d}" for l in range(self.L)]
+        self.executor = LayerStreamExecutor(self._dispatch_block, self.store,
+                                            self.prefetch_depth, self.fetch_window)
         self._fns = {}
         self.global_steps = 0
         self._last_gnorm = 0.0
-        self._put_time = 0.0
         self.last_phase_times = None
         tier = "NVMe" if off.device == "nvme" else "host DRAM"
         log_dist(f"ZeRO-Infinity param offload: {self.store.num_params():,} params resident "
@@ -495,19 +830,14 @@ class ParamStreamRunner:
             t["embed"] = self.store.bf16("embed")["embed"]
         return t
 
-    def _put(self, host_tree, shardings):
-        import time as _time
-        t0 = _time.perf_counter()
-        out = jax.device_put(host_tree, shardings)
-        self._put_time += _time.perf_counter() - t0
-        return out
-
-    def _put_layer(self, l):
-        import time as _time
-        t0 = _time.perf_counter()
-        out = jax.device_put(self.store.bf16(f"layer{l:05d}"), self._shard_layer)
-        self._put_time += _time.perf_counter() - t0
-        return out
+    def _dispatch_block(self, name):
+        """Raw host->device put of one block (the executor owns timing: it
+        separates dispatch from realized transfer via completion fencing)."""
+        if name == "embed":
+            return jax.device_put(self.store.bf16("embed"), self._shard_embed)
+        if name == "tail":
+            return jax.device_put(self._tail_store_tree(), self._shard_tail)
+        return jax.device_put(self.store.bf16(name), self._shard_layer)
 
     # -- compiled pieces ----------------------------------------------------
     def _get(self, name, builder):
@@ -577,17 +907,24 @@ class ParamStreamRunner:
         """One microbatch: streamed forward + backward; per-block grads are
         handed to ``grad_sink(name, grad_tree)`` as device arrays the moment
         they exist (their host fetch overlaps the next block's compute).
-        ``scale``: fp16 loss scale seeded into the tail vjp (1.0 for bf16)."""
+        ``scale``: fp16 loss scale seeded into the tail vjp (1.0 for bf16).
+
+        Both traversal directions stream through the executor with the same
+        depth-``k`` look-ahead: the forward walk prefetches
+        ``embed -> layers -> tail``; the backward walk re-streams the layer
+        blocks in REVERSED order (``ep`` is still live from the forward, so
+        only layers re-fetch)."""
+        ex = self.executor
+        names = self._layer_names
+        fwd = ["embed"] + names + ["tail"]
+        bwd = names[::-1]
         with self.mesh:
-            ep = self._put(self.store.bf16("embed"), self._shard_embed)
+            ep = ex.take("embed", ahead=fwd[1:])
             h = fns["embed_fwd"](ep, ids)
             acts = []
             aux_total = 0.0
-            lp_next = self._put_layer(0)
             for l in range(self.L):
-                lp = lp_next
-                if l + 1 < self.L:
-                    lp_next = self._put_layer(l + 1)  # prefetch overlaps compute
+                lp = ex.take(names[l], ahead=fwd[l + 2:])  # prefetch overlaps compute
                 acts.append(h)
                 if self._moe:
                     h, aux = fns["layer_fwd"](lp, h, mask)
@@ -595,22 +932,23 @@ class ParamStreamRunner:
                 else:
                     h = fns["layer_fwd"](lp, h, mask)
                 del lp
-            tp = self._put(self._tail_store_tree(), self._shard_tail)
+            # taking the tail seeds the backward direction's look-ahead
+            tp = ex.take("tail", ahead=bwd)
             loss, dtp, dh = fns["tail_grad"](tp, h, labels, valid,
                                              jnp.asarray(scale, jnp.float32))
             if self._moe:  # report CE + coef*aux like the fused engine
                 loss = loss + self._aux_coef * aux_total
             del tp, h
             grad_sink("tail", dtp)
-            for l in reversed(range(self.L)):
-                lp = self._put_layer(l)
+            for i, l in enumerate(reversed(range(self.L))):
+                lp = ex.take(bwd[i], ahead=bwd[i + 1:])
                 if self._moe:
                     dlp, dh = fns["layer_bwd"](lp, acts.pop(), mask, dh,
                                                jnp.asarray(scale, jnp.float32))
                 else:
                     dlp, dh = fns["layer_bwd"](lp, acts.pop(), mask, dh)
                 del lp
-                grad_sink(f"layer{l:05d}", dlp)
+                grad_sink(names[l], dlp)
             dep = fns["embed_bwd"](ep, ids, dh)
             del ep, dh
             grad_sink("embed", dep)
@@ -639,11 +977,9 @@ class ParamStreamRunner:
         # each block's master flatten order is re-established at apply time,
         # and a tied embedding's two contributions (embed fwd + tail CE) sum
         # into the same slot regardless of which block's vjp produced them
-        grads = {}  # name -> {path: np.ndarray}
+        grads = {}  # name -> {path: np.ndarray} (persistent staging buffers)
         acc_dtype = self._grad_dtype if self.gas == 1 else np.float32
-        fetches = []
         tied_shared = [k for k in self.plan["tail"] if k in self.plan["embed"]]
-        import threading
         acc_lock = threading.Lock()  # tail + embed fetches can target the
         # same tied-embedding slot from different pool threads
 
@@ -672,53 +1008,92 @@ class ParamStreamRunner:
             prev = getattr(self, "_last_gnorm", None)
             if prev is not None and np.isfinite(prev) and prev > 0:
                 stream_coef = min(1.0, float(self.clip) / (prev + 1e-6)) / scale
-        sq_parts = {"v": 0.0}
+        sq_by_block = {}  # name -> grad sum-of-squares; summed in SORTED key
+        # order below so the global norm is independent of fetch-thread
+        # completion order (float addition is not associative — an
+        # arrival-order sum would make clipped streaming runs
+        # timing-dependent and break depth/window parity)
         skipped_blocks = []
         if stream_apply:
             self.store.begin_step()
+        ex = self.executor
+        # streaming-apply order (grads land backward; embed/tail buffer to
+        # the main thread at the end): the NVMe state look-ahead walks this
+        # list k blocks ahead of each apply
+        apply_order = self._layer_names[::-1] + ["embed", "tail"]
+        apply_pos = {n: i for i, n in enumerate(apply_order)}
 
-        def accumulate(name, path, host):
+        def accumulate(name, path, host, src):
+            """Stage one contribution. Multi-SOURCE slots (the tied
+            embedding receives both the embed vjp and the tail CE vjp) are
+            staged PER SOURCE and combined in sorted-source order by
+            ``_finalize_grads`` — adding them in fetch-thread arrival order
+            would make the sum's bit pattern scheduler-dependent (3+ float
+            adds are order-sensitive; per-source accumulation is not,
+            because microbatch drains serialize each source's stream)."""
             with acc_lock:
-                slot = grads.setdefault(name, {})
-                if path in slot:
-                    np.add(slot[path], np.asarray(host, slot[path].dtype), out=slot[path])
-                else:
-                    # fp32 whenever a slot can receive >1 contribution (gas>1,
-                    # or the tied embedding's two vjp sources)
-                    dt = np.float32 if (name == "embed" and tied_shared) else acc_dtype
-                    slot[path] = np.array(host, dt, copy=True)
+                # fp32 whenever a slot can receive >1 contribution (gas>1,
+                # or the tied embedding's two vjp sources)
+                dt = np.float32 if (name == "embed" and tied_shared) else acc_dtype
+                slot = grads.setdefault(name, {}).setdefault(path, {})
+                slot[src] = ex.stage_grad((name, src), path, host, dt)
+
+        def _finalize_grads():
+            """Collapse per-source staging into one array per (block, leaf)
+            in sorted-source order (deterministic); runs on the main thread
+            after the final drain."""
+            for name in grads:
+                for path, slot in grads[name].items():
+                    srcs = sorted(slot)
+                    if len(srcs) == 1:
+                        grads[name][path] = slot[srcs[0]]
+                        continue
+                    out = ex.stage_grad((name, "__combined__"), path,
+                                        slot[srcs[0]], np.float32)
+                    for s in srcs[1:]:
+                        np.add(out, np.asarray(slot[s], np.float32), out=out)
+                    grads[name][path] = out
 
         def sink(name, dev_tree):
-            def fetch(dev_tree=dev_tree, name=name):
+            # flow 4: the NEXT applies' state reads go out from the fetch
+            # thread, just before this block's own fetch/apply — issuing
+            # them from the hot loop would block it on the NVMe apply lock
+            # whenever an apply is mid-flight (no-op on the host tier)
+            nxt = 0 if name == "tail" else apply_pos.get(name, len(apply_order) - 1) + 1
+            look_ahead = apply_order[nxt:] if stream_apply else ()
+
+            def fetch(dev_tree=dev_tree, name=name, look_ahead=look_ahead):
+                if look_ahead:
+                    ex.schedule_state_prefetch(look_ahead)
                 flat = jax.tree_util.tree_flatten_with_path(dev_tree)[0]
                 if stream_apply and name.startswith("layer"):
-                    by_path = {_slash_path(p): np.asarray(jax.device_get(leaf))
-                               for p, leaf in flat}
+                    with ex.timed_fetch():  # transfer only — not the apply
+                        by_path = {_slash_path(p): np.asarray(jax.device_get(leaf))
+                                   for p, leaf in flat}
                     aligned = [by_path[p] for p in self.store.master_paths(name)]
                     sq = sum(float(np.sum(np.square(np.asarray(g, np.float32))))
                              for g in aligned)
                     with acc_lock:
-                        sq_parts["v"] += sq
+                        sq_by_block[name] = sq_by_block.get(name, 0.0) + sq
                     if not np.isfinite(sq):
                         skipped_blocks.append(name)
                         return
                     self.store.apply_block(name, aligned, stream_coef, lr)
                     return
-                for p, leaf in flat:
-                    path = _slash_path(p)
-                    host = np.asarray(jax.device_get(leaf))
+                with ex.timed_fetch():
+                    fetched = [(_slash_path(p), np.asarray(jax.device_get(leaf)))
+                               for p, leaf in flat]
+                for path, host in fetched:
                     if name == "tail" and path.split("/", 1)[0] in tied_shared:
                         # tied embedding: this is the EMBED block's param
-                        accumulate("embed", path, host)
+                        accumulate("embed", path, host, src="tail")
                     else:
-                        accumulate(name, path, host)
-            fetches.append(_TRANSFER_POOL.submit(fetch))
+                        accumulate(name, path, host, src=name)
+            ex.submit_fetch(fetch)
 
-        import time as _time
-        t_step0 = _time.perf_counter()
-        self._put_time = 0.0  # step-scoped: eval/generate puts must not leak in
+        t_step0 = time.perf_counter()
+        ex.begin_step()  # step-scoped stats: eval/generate puts must not leak in
         loss_sum = 0.0
-        t_drain = 0.0
         for i in range(self.gas):
             m = None if mask is None else self._shard_batch_arr(mask[i])
             loss = self._micro_grads(fns, self._shard_batch_arr(ids[i]), m,
@@ -727,25 +1102,33 @@ class ParamStreamRunner:
             loss_sum += float(loss)
             # drain before the next microbatch: fetches for the SAME slot
             # accumulate in place and must not race
-            t0 = _time.perf_counter()
-            for f in fetches:
-                f.result()
-            t_drain += _time.perf_counter() - t0
-            fetches.clear()
+            ex.drain_fetches()
+        _finalize_grads()
         # per-phase breakdown (capacity-run evidence: how much of the step
-        # hides behind compute vs blocks on the host link): 'drain_s' is
-        # wall time BLOCKED waiting on grad fetches/applies that did not
-        # overlap; 'put_s' is host->device param-stream dispatch time
+        # hid behind compute vs blocked on the host link). 'put_s'/'drain_s'
+        # are CRITICAL-PATH exposure (main-thread blocked time) — prefetched
+        # puts no longer count against them; 'put_dispatch_s' is issue time
+        # wherever it ran, 'put_realized_s'/'fetch_realized_s' are fenced
+        # transfer completions, and 'overlap_efficiency' is the realized
+        # fraction the pipeline hid: 1 - exposed / realized.
+        st = ex.collect_stats()
+        realized = st["put_realized_s"] + st["fetch_realized_s"]
+        exposed = st["put_wait_s"] + st["fetch_wait_s"]
         self.last_phase_times = {
-            "step_s": _time.perf_counter() - t_step0,
-            "drain_s": t_drain,
-            "put_s": self._put_time,
+            "step_s": time.perf_counter() - t_step0,
+            "drain_s": st["fetch_wait_s"],
+            "put_s": st["put_wait_s"],
+            "put_dispatch_s": st["put_dispatch_s"],
+            "put_realized_s": st["put_realized_s"],
+            "fetch_realized_s": st["fetch_realized_s"],
+            "overlap_efficiency": (max(0.0, min(1.0, 1.0 - exposed / realized))
+                                   if realized > 0 else 0.0),
         }
 
-        sq_sum = sq_parts["v"]
-        for slot in grads.values():
-            for g in slot.values():
-                sq_sum += float(np.sum(np.square(np.asarray(g, np.float32))))
+        sq_sum = sum(sq_by_block[k] for k in sorted(sq_by_block))
+        for name in sorted(grads):
+            for path in sorted(grads[name]):
+                sq_sum += float(np.sum(np.square(np.asarray(grads[name][path], np.float32))))
         gnorm_raw = float(np.sqrt(sq_sum)) if np.isfinite(sq_sum) else float("inf")
         overflow = not np.isfinite(gnorm_raw)
         gnorm = gnorm_raw / self.gas / scale  # true-norm units
@@ -848,18 +1231,21 @@ class ParamStreamRunner:
             return ef, lf, tf
 
         ef, lf, tf = self._get(("eval", ids.shape[1], shift, mask is not None), build)
+        # same streaming executor as the train loop: depth-k forward-order
+        # parameter prefetch (ZeRO-Inference eval rides the pipeline too)
+        ex = self.executor
+        ex.invalidate()
+        names = self._layer_names
+        fwd = ["embed"] + names + ["tail"]
         with self.mesh:
-            ep = self._put(self.store.bf16("embed"), self._shard_embed)
+            ep = ex.take("embed", ahead=fwd[1:])
             h = ef(ep, jnp.asarray(ids))
             del ep
-            lp_next = self._put_layer(0)
             for l in range(self.L):
-                lp = lp_next
-                if l + 1 < self.L:
-                    lp_next = self._put_layer(l + 1)
+                lp = ex.take(names[l], ahead=fwd[l + 2:])
                 h = lf(lp, h, None if mask is None else jnp.asarray(mask))
                 del lp
-            tp = self._put(self._tail_store_tree(), self._shard_tail)
+            tp = ex.take("tail")
             loss = tf(tp, h, jnp.asarray(labels_c), jnp.asarray(valid))
         return {"loss": float(loss)}
 
@@ -889,6 +1275,13 @@ class ParamStreamRunner:
         ef, lf, lg = self._get(("gen", ), build)
         out = list(ids.T)  # per-position columns
         pos = np.arange(S)
+        # decode re-streams every weight block per token (bandwidth-bound by
+        # design); the executor's forward-order look-ahead is what hides the
+        # host link behind the per-layer compute here too
+        ex = self.executor
+        ex.invalidate()
+        names = self._layer_names
+        fwd = ["embed"] + names + ["tail"]
         with self.mesh:
             cur = jnp.asarray(ids)
             index = 0
@@ -901,17 +1294,14 @@ class ParamStreamRunner:
                 # baked static and retrace every decode step
                 ci = jnp.asarray(index, jnp.int32)
                 cm = jnp.asarray((pos < index + cur.shape[1]).astype(np.int32))[None].repeat(B, 0)
-                ep = self._put(self.store.bf16("embed"), self._shard_embed)
+                ep = ex.take("embed", ahead=fwd[1:])
                 h = ef(ep, cur, ci)
                 del ep
-                lp_next = self._put_layer(0)
                 for l in range(self.L):
-                    lp = lp_next
-                    if l + 1 < self.L:
-                        lp_next = self._put_layer(l + 1)
+                    lp = ex.take(names[l], ahead=fwd[l + 2:])
                     h, cache[l] = lf(lp, h, cache[l], ci, cm)
                     del lp
-                tp = self._put(self._tail_store_tree(), self._shard_tail)
+                tp = ex.take("tail")
                 logits = lg(tp, h)
                 del tp, h
                 index += cur.shape[1]
@@ -936,15 +1326,18 @@ class ParamStreamRunner:
 
     def get_params_tree(self, dtype=np.float32):
         """Assemble the full param pytree on host (export / tests). DRAM cost
-        is one full model copy — never materialized on device."""
+        is one full model copy — never materialized on device. Leaves are
+        OWNED copies: a same-dtype ``np.asarray`` would alias the live
+        masters and silently mutate the caller's tree as training steps."""
         out = {}
         for k in self.plan["embed"]:
-            out[k] = jax.tree_util.tree_map(lambda x: np.asarray(x, dtype),
+            out[k] = jax.tree_util.tree_map(lambda x: np.array(x, dtype, copy=True),
                                             self._host_master("embed")[k])
         tail = self._host_master("tail")
         for k in self.plan["tail"]:
             if k not in out:
-                out[k] = jax.tree_util.tree_map(lambda x: np.asarray(x, dtype), tail[k])
+                out[k] = jax.tree_util.tree_map(lambda x: np.array(x, dtype, copy=True),
+                                                tail[k])
         layers = [self._host_master(f"layer{l:05d}") for l in range(self.L)]
         out[self.plan["layer_key"]] = jax.tree_util.tree_map(
             lambda *xs: np.stack([np.asarray(x, dtype) for x in xs]), *layers)

@@ -2,9 +2,10 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + gateway smoke
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
+#   tools/ci_check.sh --offload  # offload-streaming lane only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
 # pass count the growth driver tracks (ROADMAP.md "Tier-1 verify").
@@ -29,6 +30,17 @@ guards() {
     -q -p no:cacheprovider
 }
 
+offload_lane() {
+  echo "== offload streaming lane =="
+  # ZeRO-Infinity streaming-pipeline guards: depth/window parity must stay
+  # BIT-identical (host + NVMe tiers, gas>1 buffered path) and the
+  # LayerStreamExecutor must add zero new XLA programs (jax.monitoring
+  # compile-count). The matching perf leg is `python bench.py offload_stream`
+  # (BENCH_OFFLOAD_STREAM JSON: depth 0 vs 2 step time + overlap_efficiency).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/test_offload_stream.py -q -p no:cacheprovider
+}
+
 gateway_smoke() {
   echo "== gateway smoke =="
   # black-box lifecycle of `python -m deepspeed_tpu.serving`: ephemeral
@@ -45,6 +57,10 @@ if [ "${1:-}" = "--gateway" ]; then
   gateway_smoke
   exit $?
 fi
+if [ "${1:-}" = "--offload" ]; then
+  offload_lane
+  exit $?
+fi
 
 echo "== tier-1 core lane =="
 rm -f /tmp/_t1.log
@@ -59,7 +75,10 @@ echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -c
 guards
 g_rc=$?
 
+offload_lane
+o_rc=$?
+
 gateway_smoke
 gw_rc=$?
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ]
