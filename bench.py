@@ -197,6 +197,24 @@ def _decode_bench(model_name="gpt2-large", bs=8, prompt=32, dtype="int8"):
     }
 
 
+def _leg_error(e):
+    """One-line structured form of a leg failure (shared by every
+    fault-isolated bench leg so the JSON error shapes never drift)."""
+    return f"{type(e).__name__}: {e}".splitlines()[0][:300]
+
+
+def _guard_leg(results, name, fn):
+    """Run one bench leg; a failure records a structured error entry instead
+    of sinking every other leg's numbers (the BENCH_r05 lesson applied at
+    leg granularity: partial results always persist)."""
+    try:
+        results[name] = fn()
+    except Exception as e:  # noqa: BLE001 — any leg failure becomes data
+        results[name] = {"error": _leg_error(e)}
+        print(f"# serving leg {name!r} failed: {results[name]['error']}", flush=True)
+    return results[name]
+
+
 def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_requests=32,
                    max_new=64, arrival_rate=None, seed=0, max_prompt=192,
                    kernel_inject=True, steps_per_sync=4, prefill_chunk=None):
@@ -207,7 +225,9 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
     ``arrival_rate``: mean requests/sec for the Poisson process; None =
     open-loop saturation (all requests queued at t=0 — the concurrency
     sweep's high end). Reports aggregate decode tokens/sec, TTFT p50/p95,
-    and mean slot occupancy, per concurrency level."""
+    and mean slot occupancy, per concurrency level. Every leg is
+    fault-isolated: one leg's failure records an error entry and the rest
+    of the round's numbers persist."""
     import deepspeed_tpu
     from deepspeed_tpu.comm import comm as _comm
     rng = np.random.default_rng(seed)
@@ -225,8 +245,9 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
         return deepspeed_tpu.init_inference(model_name, config=cfg)
 
     results = {}
+
     # --- scheduler path, per concurrency level -------------------------------
-    for slots in sorted({1, max(2, num_slots // 2), num_slots}):
+    def run_level(slots):
         eng = make(True)
         # PR2-comparable leg: monolithic bucketed prefill (this sweep's
         # random stream shares no prefixes, and its warm pass warms per
@@ -270,38 +291,142 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
             if req.first_token_ts is not None:
                 ttfts.append((req.first_token_ts - req.submit_ts) * 1e3)
         ttfts.sort()
-        results[f"slots{slots}"] = {
+        return {
             "tokens_per_sec": round(toks / dt, 1),
             "ttft_ms_p50": round(ttfts[len(ttfts) // 2], 1) if ttfts else None,
             "ttft_ms_p95": round(ttfts[int(0.95 * (len(ttfts) - 1))], 1) if ttfts else None,
             "mean_slot_occupancy": round(float(np.mean(occ)), 3) if occ else 0.0,
         }
+
+    for slots in sorted({1, max(2, num_slots // 2), num_slots}):
+        _guard_leg(results, f"slots{slots}", lambda s=slots: run_level(s))
+
     # --- sequential generate() baseline (same stream, one request at a time,
     # honoring the same arrival schedule so rate-limited runs compare like
     # for like). Two passes: the cold pass pays one whole-decode-loop
     # compile per distinct prompt shape (the static-batch pathology the
     # scheduler removes); the warm pass is the fair steady-state comparison.
-    eng = make(False)
-    seq = {}
-    for label in ("sequential_generate_cold", "sequential_generate"):
-        t0 = time.perf_counter()
-        toks = 0
-        arrival = 0.0
-        for gap, p in zip(gaps, prompts):
-            arrival += gap
-            wait = t0 + arrival - time.perf_counter()
-            if wait > 0:
-                time.sleep(wait)
-            out = eng.generate([p], max_new_tokens=max_new)
-            toks += sum(len(r) for r in out)
-        seq[label] = {"tokens_per_sec": round(toks / (time.perf_counter() - t0), 1)}
-    results.update(seq)
-    best = max(v["tokens_per_sec"] for k, v in results.items() if k.startswith("slots"))
-    results["speedup_vs_sequential"] = round(
-        best / results["sequential_generate"]["tokens_per_sec"], 3)
-    results["shared_prefix"] = _shared_prefix_bench(make, num_slots, n_requests,
-                                                    max_new, seed, prefill_chunk)
+    def run_sequential():
+        eng = make(False)
+        seq = {}
+        for label in ("sequential_generate_cold", "sequential_generate"):
+            t0 = time.perf_counter()
+            toks = 0
+            arrival = 0.0
+            for gap, p in zip(gaps, prompts):
+                arrival += gap
+                wait = t0 + arrival - time.perf_counter()
+                if wait > 0:
+                    time.sleep(wait)
+                out = eng.generate([p], max_new_tokens=max_new)
+                toks += sum(len(r) for r in out)
+            seq[label] = {"tokens_per_sec": round(toks / (time.perf_counter() - t0), 1)}
+        return seq
+
+    seq = _guard_leg(results, "sequential", run_sequential)
+    if isinstance(seq, dict) and "sequential_generate" in seq:
+        results.update(seq)
+        del results["sequential"]
+        slot_tps = [v["tokens_per_sec"] for k, v in results.items()
+                    if k.startswith("slots") and "tokens_per_sec" in v]
+        if slot_tps:
+            results["speedup_vs_sequential"] = round(
+                max(slot_tps) / results["sequential_generate"]["tokens_per_sec"], 3)
+    _guard_leg(results, "shared_prefix",
+               lambda: _shared_prefix_bench(make, num_slots, n_requests, max_new,
+                                            seed, prefill_chunk))
+    _guard_leg(results, "speculative",
+               lambda: _speculative_bench(make, num_slots, n_requests, max_new, seed))
+    _guard_leg(results, "kv_int8",
+               lambda: _kv_int8_bench(make, num_slots, max_new, seed))
     return results
+
+
+def _speculative_bench(make, num_slots, n_requests, max_new, seed, spec_tokens=4):
+    """Self-speculative decoding leg: a repetitive request stream (the
+    agent-loop/template shape prompt-lookup drafting targets) served with
+    ``spec_tokens`` drafted-and-verified tokens per step vs the identical
+    stream through the non-speculative scheduler. Reports tokens/sec both
+    ways, the acceptance rate, and mean tokens per (row, verify step) —
+    > 1.0 means speculation is netting multi-token steps."""
+    out = {}
+    prompts = None
+    for label, overrides in (("baseline", {}),
+                             ("speculative", {"spec_tokens": spec_tokens})):
+        eng = make(True)
+        sched = eng.scheduler(num_slots=num_slots, **overrides)
+        if prompts is None:  # both legs serve the SAME stream
+            rng = np.random.default_rng(seed + 13)
+            V = eng.model_config.vocab_size
+            cap = sched.max_len - max_new - 2 * sched.steps_per_sync - spec_tokens - 1
+            if cap < 16:
+                return {"skipped": f"slot capacity {sched.max_len} too small for the "
+                                   f"speculative stream at max_new={max_new}"}
+            pattern = rng.integers(0, V, 7).astype(np.int32)
+            plen = min(96, cap)
+            prompts = [np.concatenate([np.resize(pattern, plen - 2),
+                                       rng.integers(0, V, 2).astype(np.int32)])
+                       for _ in range(n_requests)]
+        sched.submit(prompts[0], max_new_tokens=max_new).result()  # warm programs
+        t0 = time.perf_counter()
+        handles = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+        toks = sum(len(h.result()) for h in handles)
+        dt = time.perf_counter() - t0
+        entry = {"tokens_per_sec": round(toks / dt, 1)}
+        if label == "speculative":
+            entry.update({
+                "spec_steps": sched.spec_steps,
+                "drafted": sched.spec_drafted,
+                "accepted": sched.spec_accepted,
+                "acceptance_rate": round(
+                    sched.spec_accepted / max(1, sched.spec_drafted), 3),
+                # delivered tokens per (row, verify step): accepted drafts
+                # + the always-produced column-0 token — NOT an accepted
+                # count (which acceptance_rate already covers)
+                "mean_tokens_per_step": round(
+                    sched.mean_spec_tokens_per_step(), 3),
+            })
+        out[label] = entry
+    out["speedup_vs_baseline"] = round(
+        out["speculative"]["tokens_per_sec"]
+        / max(out["baseline"]["tokens_per_sec"], 1e-9), 3)
+    out["spec_tokens"] = spec_tokens
+    return out
+
+
+def _kv_int8_bench(make, num_slots, max_new, seed):
+    """int8 paged-KV leg: resident-slot density at equal HBM budget (the
+    acceptance bar is >= 1.9x a bf16 pool of the same geometry) plus the
+    decode logit error the quantized tier costs, measured against the bf16
+    pool on the same greedy request."""
+    rng = np.random.default_rng(seed + 21)
+    eng_b = make(True)
+    sb = eng_b.scheduler(num_slots=num_slots, kv_cache_dtype="bf16",
+                         collect_logits=True)
+    V = eng_b.model_config.vocab_size
+    cap = sb.max_len - max_new - 2 * sb.steps_per_sync
+    prompt = rng.integers(0, V, max(8, min(64, cap))).astype(np.int32)
+    ref = sb.submit(prompt, max_new_tokens=max_new).result_logits()
+    bpt_b = sb.cache.bytes_per_token()
+
+    eng_q = make(True)
+    sq = eng_q.scheduler(num_slots=num_slots, kv_cache_dtype="int8",
+                         collect_logits=True)
+    got = sq.submit(prompt, max_new_tokens=max_new).result_logits()
+    bpt_q = sq.cache.bytes_per_token()
+    budget = sb.cache.capacity_bytes()
+    n = min(len(ref), len(got))
+    return {
+        "bytes_per_token_bf16": bpt_b,
+        "bytes_per_token_int8": bpt_q,
+        "slots_at_equal_hbm_bf16": int(num_slots),
+        "slots_at_equal_hbm_int8": int(budget // max(1, bpt_q * sq.cache.max_len)),
+        "slot_ratio_at_equal_hbm": round(bpt_b / max(1, bpt_q), 3),
+        "max_abs_logit_err": round(float(np.abs(got[:n] - ref[:n]).max()), 5) if n else None,
+        "ref_logit_absmax": round(float(np.abs(ref).max()), 4) if n else None,
+        "top1_agreement": round(float(
+            (got[:n].argmax(-1) == ref[:n].argmax(-1)).mean()), 4) if n else None,
+    }
 
 
 def _shared_prefix_bench(make, num_slots, n_requests, max_new, seed,
@@ -598,13 +723,14 @@ def serving_main():
     except Exception as e:  # noqa: BLE001 — a failed leg must yield structured JSON
         _emit_skipped(f"serving bench failed: {type(e).__name__}: {e}".splitlines()[0][:500])
         return
-    best_key = max((k for k in res if k.startswith("slots")),
-                   key=lambda k: res[k]["tokens_per_sec"])
+    # legs are individually fault-isolated; report whatever survived
+    slot_tps = [res[k]["tokens_per_sec"] for k in res
+                if k.startswith("slots") and "tokens_per_sec" in res[k]]
     print(json.dumps({
         "metric": _HEADLINE,
-        "value": res[best_key]["tokens_per_sec"],
+        "value": max(slot_tps) if slot_tps else 0.0,
         "unit": _UNIT,
-        "vs_baseline": res["speedup_vs_sequential"],
+        "vs_baseline": res.get("speedup_vs_sequential", 0.0),
         "extra": res,
     }))
 
@@ -739,47 +865,70 @@ def _main_measured(devices):
     n_chips = len(devices)
     peak = get_accelerator().peak_flops()
     seq = 1024
+    extra = {}
+    leg_errors = {}
 
-    cfg_l, tok_l, step_l, loss_l, bs_l = _run("gpt2-large", micro_bs=4, steps=40, seq=seq)
-    mfu_l = _mfu(cfg_l, tok_l / n_chips, seq, peak)
+    def leg(name, fn):
+        """Per-leg fault isolation: one leg's failure records an error and
+        the round keeps every other leg's numbers (PR 5's structured-skip
+        pattern extended to every leg)."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — any leg failure becomes data
+            leg_errors[name] = _leg_error(e)
+            print(f"# {name} leg failed: {leg_errors[name]}", flush=True)
+            return None
 
-    cfg_s, tok_s, step_s, loss_s, bs_s = _run("gpt2-125m", micro_bs=16, steps=60, seq=seq)
-    mfu_s = _mfu(cfg_s, tok_s / n_chips, seq, peak)
-    decode = None
-    try:
-        decode = _decode_bench()
-    except Exception as e:  # noqa: BLE001 — int8 leg must not sink the bench
-        print(f"# int8 decode bench failed ({type(e).__name__}: {e}); bf16 fallback",
-              flush=True)
-    if decode is None:  # outside the except: the failed engine must be dead
-        decode = _decode_bench(dtype="bf16")
+    large = leg("gpt2_large_train",
+                lambda: _run("gpt2-large", micro_bs=4, steps=40, seq=seq))
+    mfu_l = 0.0
+    if large is not None:
+        cfg_l, tok_l, step_l, loss_l, bs_l = large
+        mfu_l = _mfu(cfg_l, tok_l / n_chips, seq, peak)
+        extra.update({
+            "gpt2_large_tokens_per_sec_chip": round(tok_l / n_chips, 1),
+            "gpt2_large_ms_per_step": round(step_l * 1000, 1),
+            "gpt2_large_final_loss": round(loss_l, 4),
+        })
+    else:
+        bs_l = 4
+
+    small = leg("gpt2_125m_train",
+                lambda: _run("gpt2-125m", micro_bs=16, steps=60, seq=seq))
+    if small is not None:
+        cfg_s, tok_s, step_s, loss_s, bs_s = small
+        extra.update({
+            "gpt2_125m_tokens_per_sec_chip": round(tok_s / n_chips, 1),
+            "gpt2_125m_mfu": round(_mfu(cfg_s, tok_s / n_chips, seq, peak), 4),
+            "gpt2_125m_ms_per_step": round(step_s * 1000, 1),
+        })
+
+    decode = leg("decode_int8", _decode_bench)
+    if decode is None:  # outside the leg: the failed engine must be dead
+        decode = leg("decode_bf16", lambda: _decode_bench(dtype="bf16"))
+    if decode is not None:
+        extra.update({
+            "gpt2_large_decode_tokens_per_sec": round(decode["decode_tokens_per_sec_steady"], 1),
+            "gpt2_large_decode_tokens_per_sec_e2e": round(decode["decode_tokens_per_sec_e2e"], 1),
+            "gpt2_large_decode_e2e_over_steady": round(decode["decode_e2e_over_steady"], 3),
+            "gpt2_large_decode_tokens_per_sec_pipelined": round(
+                decode["decode_tokens_per_sec_pipelined"], 1),
+            "gpt2_large_ms_per_decode_step": round(decode["decode_ms_per_token_step"], 2),
+            "gpt2_large_decode_hbm_utilization": round(decode["decode_hbm_utilization"], 3),
+            "gpt2_large_decode_hbm_utilization_actual": round(
+                decode["decode_hbm_utilization_actual"], 3),
+            "gpt2_large_decode_dtype": decode["decode_dtype"],
+        })
 
     # small-MoE single-chip training number (expert-parallel math exercised
     # at ep=1: batched expert dispatch/combine + gating aux loss)
-    try:
-        _, tok_moe, step_moe, _, _ = _run("gpt2-125m", micro_bs=4, steps=12, seq=512,
-                                          num_experts=4, moe_top_k=2)
-    except Exception as e:  # noqa: BLE001 — optional leg, never sink the bench
-        print(f"# moe bench skipped: {type(e).__name__}: {e}", flush=True)
-        tok_moe = step_moe = None
+    moe = leg("moe_train", lambda: _run("gpt2-125m", micro_bs=4, steps=12, seq=512,
+                                        num_experts=4, moe_top_k=2))
+    tok_moe = step_moe = None
+    if moe is not None:
+        _, tok_moe, step_moe, _, _ = moe
 
-    extra = {
-        "gpt2_large_tokens_per_sec_chip": round(tok_l / n_chips, 1),
-        "gpt2_large_ms_per_step": round(step_l * 1000, 1),
-        "gpt2_large_final_loss": round(loss_l, 4),
-        "gpt2_125m_tokens_per_sec_chip": round(tok_s / n_chips, 1),
-        "gpt2_125m_mfu": round(mfu_s, 4),
-        "gpt2_125m_ms_per_step": round(step_s * 1000, 1),
-        "gpt2_large_decode_tokens_per_sec": round(decode["decode_tokens_per_sec_steady"], 1),
-        "gpt2_large_decode_tokens_per_sec_e2e": round(decode["decode_tokens_per_sec_e2e"], 1),
-        "gpt2_large_decode_e2e_over_steady": round(decode["decode_e2e_over_steady"], 3),
-        "gpt2_large_decode_tokens_per_sec_pipelined": round(
-            decode["decode_tokens_per_sec_pipelined"], 1),
-        "gpt2_large_ms_per_decode_step": round(decode["decode_ms_per_token_step"], 2),
-        "gpt2_large_decode_hbm_utilization": round(decode["decode_hbm_utilization"], 3),
-        "gpt2_large_decode_hbm_utilization_actual": round(
-            decode["decode_hbm_utilization_actual"], 3),
-        "gpt2_large_decode_dtype": decode["decode_dtype"],
+    extra.update({
         "nominal_peak_tflops": round(peak / 1e12, 1),
         "n_chips": n_chips,
         # ZeRO-Offload capacity (measured offline, not re-run here: the
@@ -791,7 +940,9 @@ def _main_measured(devices):
         # need ~25 GB.
         "offload_peak_trainable_params_per_chip": 1557611200,
         "int8_decode_available": True,
-    }
+    })
+    if leg_errors:
+        extra["leg_errors"] = leg_errors
     if tok_moe is not None:
         extra["moe_gpt2s_4e_top2_tokens_per_sec_chip"] = round(tok_moe / n_chips, 1)
         extra["moe_gpt2s_4e_top2_ms_per_step"] = round(step_moe * 1000, 1)

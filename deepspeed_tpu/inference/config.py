@@ -76,6 +76,28 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
                                "token trie and seed new requests from the longest "
                                "matched prefix (LRU eviction when admission needs a "
                                "slot); chunked-prefill mode only")
+    spec_tokens = ConfigField(default=0, help="self-speculative decoding (Leviathan "
+                              "et al. / prompt-lookup drafting): up to this many "
+                              "host-drafted tokens verified per decode step through "
+                              "the fused span program — accepted prefixes commit, "
+                              "the first mismatch truncates, greedy/sampled outputs "
+                              "stay bit-identical to non-speculative decode; 0 "
+                              "disables (see benchmarks/SERVING.md)")
+    spec_ngram_max = ConfigField(default=3, help="longest context suffix n-gram the "
+                                 "prompt-lookup drafter matches against earlier "
+                                 "context before proposing its continuation")
+    spec_ngram_min = ConfigField(default=1, help="shortest n-gram the drafter falls "
+                                 "back to when longer suffixes have no prior "
+                                 "occurrence (1 = always drafts when any token "
+                                 "repeats; raise to cut wasted verify columns on "
+                                 "low-repetition streams)")
+    kv_cache_dtype = ConfigField(default="auto", help="slot-pool KV storage: 'auto' "
+                                 "= the model compute dtype; 'int8' = group-"
+                                 "quantized paged KV (per-token-row fp16 scales, "
+                                 "dequant fused into the paged decode kernels) — "
+                                 "~1.9x the resident slots per HBM byte at a small "
+                                 "bounded logit error; 'bf16'/'fp32' force a plain "
+                                 "cache at that precision")
 
 
 class GatewayConfig(DeepSpeedConfigModel):

@@ -480,7 +480,11 @@ class InferenceEngine:
                   "collect_logits": cb.collect_logits,
                   "steps_per_sync": cb.steps_per_sync,
                   "prefill_chunk": cb.prefill_chunk,
-                  "prefix_cache": cb.prefix_cache}
+                  "prefix_cache": cb.prefix_cache,
+                  "spec_tokens": cb.spec_tokens,
+                  "spec_ngram_max": cb.spec_ngram_max,
+                  "spec_ngram_min": cb.spec_ngram_min,
+                  "kv_cache_dtype": cb.kv_cache_dtype}
             kw.update(overrides)
             self._scheduler = DecodeScheduler(self, **kw)
         elif overrides:
@@ -684,26 +688,37 @@ class InferenceEngine:
             return out
         return buf, trim
 
-    def _init_cache(self, B, S):
-        key = ("init_cache", B, S)
+    def _init_cache(self, B, S, kv_dtype=None):
+        """``kv_dtype``: None = the model compute dtype; "int8" = the
+        group-quantized paged KV tier (3-leaf cache with joint per-token-row
+        scales; serving ``kv_cache_dtype: int8``); any jnp float dtype =
+        an explicit-precision plain cache."""
+        quantized = kv_dtype == "int8"
+        key = ("init_cache", B, S, str(kv_dtype))
         if key not in self._compiled:
             from jax.sharding import NamedSharding, PartitionSpec as P_
             nkv = self.model_config.kv_heads
             shard_kv = nkv % self.mesh.shape[dist.TENSOR_AXIS] == 0
 
+            def build():
+                if quantized:
+                    return self.module.init_cache(B, S, quantized=True)
+                return self.module.init_cache(B, S, dtype=kv_dtype)
+
             def spec_for(leaf):
-                # stacked (L, B, kv, S, hd) or per-layer (B, kv, S, hd)
+                # stacked (L, B, kv, S, hd) or per-layer (B, kv, S, hd);
+                # the int8 tier's scale leaves carry a size-1 head axis —
+                # only genuinely kv-sized axes shard over tensor
                 axes = [None] * leaf.ndim
-                if shard_kv:
+                if shard_kv and leaf.shape[leaf.ndim - 3] == nkv:
                     axes[leaf.ndim - 3] = dist.TENSOR_AXIS
                 return NamedSharding(self.mesh, P_(*axes))
 
-            abstract = jax.eval_shape(lambda: self.module.init_cache(B, S))
+            abstract = jax.eval_shape(build)
             shardings = jax.tree_util.tree_map(spec_for, abstract)
             # cached: a fresh jit wrapper per call would retrace (+~0.7 s)
             # on EVERY generate
-            self._compiled[key] = jax.jit(lambda: self.module.init_cache(B, S),
-                                          out_shardings=shardings)
+            self._compiled[key] = jax.jit(build, out_shardings=shardings)
         with self.mesh:
             return self._compiled[key]()
 

@@ -182,6 +182,27 @@ class SlotKVCache:
     def max_live_len(self):
         return int(self.lengths.max()) if self.num_slots else 0
 
+    def bytes_per_token(self):
+        """HBM bytes backing ONE cache row (all layers, K+V, and — on the
+        int8 tier — the per-token scale leaves): every pool leaf keeps its
+        slot and row axes, so per-row bytes fall out of leaf sizes
+        generically for both the plain and quantized layouts. 0 when the
+        pool is host-bookkeeping-only (tests)."""
+        if self.pool is None:
+            return 0
+        denom = self.num_slots * self.max_len
+        return int(sum((leaf.size // denom) * leaf.dtype.itemsize
+                       for leaf in jax.tree_util.tree_leaves(self.pool)))
+
+    def capacity_bytes(self):
+        """Total HBM held by the fixed-shape pool."""
+        return self.bytes_per_token() * self.num_slots * self.max_len
+
+    def live_bytes(self):
+        """Bytes backing live + retained rows (the working set; the rest of
+        ``capacity_bytes`` is preallocated headroom)."""
+        return (self.live_tokens() + self.cached_tokens()) * self.bytes_per_token()
+
     def check_invariants(self):
         """Every slot is in exactly one state; the free list matches the
         state row; refs only on active/cached slots. Raises on drift (the

@@ -52,13 +52,38 @@ granularity (K=1 recovers pure iteration-level scheduling; results are
 identical for any K). EOS detection, admission, and eviction are host-side
 bookkeeping on the fetched block.
 
+**Self-speculative k-token decoding** (Leviathan et al. / prompt-lookup
+drafting, ``spec_tokens > 0``): each pure-decode sync first asks a host-side
+:class:`~deepspeed_tpu.inference.speculative.PromptLookupDrafter` for up to
+``spec_tokens`` continuation proposals per live slot, then verifies ALL of
+them in ONE fused span step — the same ``q_spans`` machinery chunked
+prefill rides, with the draft tokens as extra query columns. Every column
+is sampled with the request's own keys at its absolute step index and a
+draft commits only when it EQUALS the sampled token, so the emitted stream
+is bit-identical to non-speculative decode (greedy and sampled alike); the
+first mismatch truncates and the garbage KV rows past the accepted prefix
+sit beyond the write head until later writes reclaim them. A sync where no
+slot drafts falls back to the plain ``steps_per_sync`` decode program, so
+the drafter being dry costs nothing. Compiled programs gain only the spec
+variant at width ``1 + spec_tokens`` — O(1) in k and acceptance mix.
+
+**int8 paged KV** (``kv_cache_dtype: "int8"``): the slot pool stores
+group-quantized K/V (per-token-row fp16 scales, ``ops/quantizer``
+``quantize_kv_rows``); dequantization fuses into the paged Pallas kernels
+so bf16 KV never materializes in HBM — roughly doubling resident slots per
+chip at a small bounded logit error.
+
 Telemetry (PR-1 sink): gauges ``serving/slot_occupancy``,
 ``serving/batch_efficiency``, ``serving/kv_token_utilization``,
-``serving/prefix_cache_hit_rate``; counters ``serving/admitted``,
+``serving/prefix_cache_hit_rate``, ``serving/spec_acceptance_rate``,
+``serving/kv_bytes_per_token``, ``serving/kv_cache_capacity_bytes``,
+``serving/kv_bytes_live``; counters ``serving/admitted``,
 ``serving/evicted``, ``serving/decode_steps``, ``serving/decode_tokens``,
-``serving/prefix_cache_{hit,miss,evict}``; histograms ``serving/ttft_ms``,
-``serving/step_ms``, ``serving/tokens_per_step``,
-``serving/prefill_stall_ms``.
+``serving/prefix_cache_{hit,miss,evict}``, ``serving/spec_steps``,
+``serving/spec_draft_tokens``, ``serving/spec_accepted_tokens``;
+histograms ``serving/ttft_ms``, ``serving/step_ms``,
+``serving/tokens_per_step``, ``serving/prefill_stall_ms``,
+``serving/spec_tokens_per_step``.
 """
 
 import collections
@@ -69,6 +94,7 @@ import numpy as np
 
 from .engine import _round_up
 from .kv_cache import RadixPrefixCache, SlotKVCache, copy_slot, slot_slice, slot_update
+from .speculative import PromptLookupDrafter
 
 
 def _bucket_len(n, base, cap):
@@ -201,7 +227,8 @@ class DecodeScheduler:
 
     def __init__(self, engine, num_slots=8, max_len=None, prefill_bucket=64,
                  collect_logits=False, steps_per_sync=4, prefill_chunk=64,
-                 prefix_cache=True):
+                 prefix_cache=True, spec_tokens=0, spec_ngram_max=3,
+                 spec_ngram_min=1, kv_cache_dtype="auto"):
         self.engine = engine
         model = engine.module
         cfg = engine._config
@@ -235,8 +262,36 @@ class DecodeScheduler:
         # chunked prefill: clamp the chunk to the slot capacity (a chunk
         # wider than a slot could never land a full write)
         self.prefill_chunk = min(max(0, int(prefill_chunk)), S)
-        self.cache = SlotKVCache(engine._init_cache(int(num_slots), S),
+        # KV storage tier: "auto" rides the model compute dtype; "int8" is
+        # the group-quantized paged tier (3-leaf pool with joint per-token-
+        # row scales); explicit float names force that precision
+        kvd = str(kv_cache_dtype or "auto").lower()
+        if kvd in ("auto", "model", "none"):
+            kv_arg = None
+        elif kvd == "int8":
+            kv_arg = "int8"
+        else:
+            from .config import _DTYPE_MAP
+            if kvd not in _DTYPE_MAP or _DTYPE_MAP[kvd] == jnp.int8:
+                raise ValueError(f"kv_cache_dtype must be 'auto', 'int8', or a float "
+                                 f"dtype name, got {kv_cache_dtype!r}")
+            kv_arg = _DTYPE_MAP[kvd]
+        self.kv_quantized = kv_arg == "int8"
+        self.cache = SlotKVCache(engine._init_cache(int(num_slots), S, kv_dtype=kv_arg),
                                  int(num_slots), S, page_size=min(block, S))
+        # self-speculative decoding: spec_tokens drafted columns verified
+        # per pure-decode sync (clamped so a full verify block always fits
+        # one slot alongside at least one row of decode headroom)
+        self.spec_tokens = max(0, min(int(spec_tokens), max(0, S - 2)))
+        self._spec_width = 1 + self.spec_tokens
+        self.drafter = (PromptLookupDrafter(self.spec_tokens, spec_ngram_max,
+                                            spec_ngram_min)
+                        if self.spec_tokens > 0 else None)
+        self.spec_steps = 0       # spec verify dispatches
+        self.spec_row_steps = 0   # (live row, spec step) pairs
+        self.spec_drafted = 0     # draft tokens submitted to verification
+        self.spec_accepted = 0    # draft tokens that committed
+        self.spec_delivered = 0   # tokens delivered by spec steps
         # radix prefix cache: chunked-mode only — reuse rounds matches to
         # chunk boundaries so a hit replays the cold path's exact programs
         self.radix = (RadixPrefixCache(self.cache)
@@ -248,6 +303,12 @@ class DecodeScheduler:
         self._rid = 0
         self._steps = 0
         self.telemetry = engine.telemetry
+        if self.telemetry.enabled:
+            # the KV tier's HBM price tag: int8 should show ~half the bytes
+            # per resident token of an "auto" bf16 pool
+            self.telemetry.gauges([
+                ("serving/kv_bytes_per_token", self.cache.bytes_per_token(), None),
+                ("serving/kv_cache_capacity_bytes", self.cache.capacity_bytes(), None)])
 
     # ------------------------------------------------------------------ API
     def submit(self, prompt, max_new_tokens=64, eos_token_id=None, do_sample=False,
@@ -286,8 +347,11 @@ class DecodeScheduler:
             req.done = True
             return SchedulerHandle(self, req)
         # reserve for multi-step overshoot: the K-step program writes K rows
-        # per sync even when the budget ends mid-block
+        # per sync even when the budget ends mid-block; a speculative verify
+        # block likewise writes up to spec-width rows past the final token
         budget = _round_up(req.max_new_tokens, self.steps_per_sync)
+        if self.spec_tokens > 0:
+            budget = max(budget, req.max_new_tokens + self._spec_width - 1)
         if not self.cache.fits(req.prompt.size, budget):
             raise ValueError(
                 f"request needs {req.prompt.size + budget} cache rows > "
@@ -339,7 +403,10 @@ class DecodeScheduler:
         if fused:
             delivered, ksteps = self._fused_chunk_step()
         elif self.active:
-            delivered, ksteps = self._decode_step()
+            if self.drafter is not None:
+                delivered, ksteps = self._spec_decode_step()
+            else:
+                delivered, ksteps = self._decode_step()
         else:
             return 0
         if tel.enabled:
@@ -352,7 +419,8 @@ class DecodeScheduler:
                         ("serving/batch_efficiency",
                          delivered / (ksteps * self.cache.num_slots), None),
                         ("serving/kv_token_utilization", self.cache.token_utilization(),
-                         None)])
+                         None),
+                        ("serving/kv_bytes_live", self.cache.live_bytes(), None)])
         return delivered
 
     def _release_slot(self, slot):
@@ -629,6 +697,112 @@ class DecodeScheduler:
         toks_k, logits_k = self._fetch_block(out, collect, K)
         return self._deliver_block(live, toks_k, logits_k, K), K
 
+    # ------------------------------------------------------------------ speculative decode
+    def _spec_decode_step(self):
+        """One self-speculative verify sync: the prompt-lookup drafter
+        proposes up to ``spec_tokens`` continuation tokens per live row,
+        and ONE fused span dispatch (:meth:`_spec_fn`) verifies every
+        column — the same per-row ``q_spans`` machinery chunked prefill
+        rides, with draft tokens as the extra query columns. Each column is
+        sampled with the request's keys at its absolute step index; a draft
+        commits only when it EQUALS the sampled token, so accepted streams
+        are bit-identical to non-speculative decode and the first mismatch
+        truncates (its garbage KV rows sit past the write head until later
+        writes reclaim them). Rows advance by their own accepted count —
+        between 1 and ``1 + spec_tokens`` tokens per dispatch. A sync where
+        NO row drafts falls back to the K-step decode program, keeping its
+        dispatch amortization when the drafter is dry."""
+        eng = self.engine
+        N, W = self.cache.num_slots, self._spec_width
+        live = sorted(self.active.items())
+        drafts = {}
+        total_draft = 0
+        for slot, req in live:
+            # cap drafts at the remaining budget (a request one token from
+            # done gains nothing from verify columns) and the slot's KV
+            # headroom (the verify block writes span rows at the head)
+            cap = min(W - 1, req.max_new_tokens - len(req.out) - 1,
+                      self.max_len - int(self.cache.lengths[slot]) - 1)
+            d = (self.drafter.draft(
+                np.concatenate([req.prompt, np.asarray(req.out, np.int32)]), cap)
+                if cap > 0 else np.empty(0, np.int32))
+            drafts[slot] = d
+            total_draft += d.size
+        if total_draft == 0:
+            return self._decode_step()
+        ids = np.zeros((N, W), np.int32)
+        spans = np.zeros(N, np.int32)
+        lens = np.zeros(N, np.int32)
+        for slot, req in live:
+            d = drafts[slot]
+            ids[slot, 0] = req.out[-1]
+            if d.size:
+                ids[slot, 1:1 + d.size] = d
+            spans[slot] = 1 + d.size
+            lens[slot] = self.cache.lengths[slot]
+        (seeds, steps, flags, temps, topks, topps, sampling,
+         collect) = self._gather_sampling(live)
+        fn = self._spec_fn(sampling, collect, W)
+        with eng.mesh:
+            out = fn(eng.params, self.cache.pool, jnp.asarray(ids),
+                     jnp.asarray(lens), jnp.asarray(spans),
+                     jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+        if collect:
+            self.cache.pool, toks_k, logits_k = out
+            logits_k = np.asarray(jax.device_get(logits_k), np.float32)  # (W, N, V)
+        else:
+            self.cache.pool, toks_k = out
+            logits_k = None
+        toks_k = np.asarray(jax.device_get(toks_k)).reshape(W, N)
+        self._steps += 1
+        tel = self.telemetry
+        delivered = 0
+        accepted = 0
+        for slot, req in live:
+            span = int(spans[slot])
+            # acceptance walk: toks_k[j] is the sampled token FOLLOWING
+            # column j; column j+1's logits are valid only while the draft
+            # it was conditioned on equals the sampled token
+            m = 1
+            while m < span and toks_k[m - 1, slot] == ids[slot, m]:
+                m += 1
+            self.cache.lengths[slot] += m
+            row_delivered = 0
+            for j in range(m):
+                if req.done:
+                    break
+                if req.collect_logits and logits_k is not None:
+                    req.logits.append(logits_k[j, slot])
+                self._deliver(req, int(toks_k[j, slot]))
+                row_delivered += 1
+            # count only tokens that actually reached the stream: an EOS
+            # accepted mid-block truncates delivery, and counting the
+            # discarded tail would inflate the acceptance-rate signal the
+            # k-tuning docs tell operators to watch
+            delivered += row_delivered
+            accepted += max(0, row_delivered - 1)
+            if tel.enabled:
+                tel.histogram("serving/spec_tokens_per_step", row_delivered)
+        self.spec_steps += 1
+        self.spec_row_steps += len(live)
+        self.spec_drafted += total_draft
+        self.spec_accepted += accepted
+        self.spec_delivered += delivered
+        if tel.enabled:
+            tel.counter("serving/spec_steps")
+            tel.counter("serving/spec_draft_tokens", total_draft)
+            tel.counter("serving/spec_accepted_tokens", accepted)
+            tel.gauge("serving/spec_acceptance_rate",
+                      self.spec_accepted / max(1, self.spec_drafted))
+        return delivered, 1
+
+    def mean_spec_tokens_per_step(self):
+        """Mean tokens delivered per (live row, speculative sync) — > 1.0
+        means speculation is netting multi-token steps (the bench's
+        acceptance criterion)."""
+        return self.spec_delivered / self.spec_row_steps if self.spec_row_steps else 0.0
+
     # ------------------------------------------------------------------ fused chunk step
     def _fused_chunk_step(self):
         """One fixed-shape fused SYNC over ``(num_slots, prefill_chunk)``
@@ -801,6 +975,47 @@ class DecodeScheduler:
                 return pool, out_toks
 
             self._compiled[key] = jax.jit(fused, donate_argnums=(1, ))
+        return self._compiled[key]
+
+    def _spec_fn(self, sampling, collect, width):
+        """The speculative VERIFY program: one forward over a fixed
+        ``(num_slots, width)`` ids block through the span machinery (row
+        ``i``'s live columns = its last token + its drafts, per-row
+        ``q_spans``), then EVERY column sampled with its row's keys at the
+        column's absolute step index. Returns the (width, num_slots) token
+        block (+ (width, num_slots, V) logits when collected); the host
+        walks acceptance. Which rows draft, how many columns each carries,
+        and all sampling params are runtime data — compiled at most
+        (greedy/sampling) x logits-collection variants for the ONE
+        configured width, so the program count stays O(1) in k and in the
+        acceptance mix. Column 0's math is the decode program's math (same
+        span kernel, same sampling path, same key folding), which is what
+        makes accepted streams bit-identical to non-speculative decode."""
+        key = ("spec", sampling, collect, width)
+        if key not in self._compiled:
+            model = self.engine.module
+
+            def sample(l2, seeds, steps, flags, temps, topks, topps):
+                if sampling:
+                    return jax.vmap(_sample_slot)(seeds, steps, l2, flags,
+                                                  temps, topks, topps)
+                return jnp.argmax(l2, axis=-1).astype(jnp.int32)
+
+            def spec(params, pool, ids, lengths, spans, seeds, steps, flags,
+                     temps, topks, topps):
+                C = ids.shape[1]
+                pos = lengths[:, None] + jnp.arange(C)[None, :]
+                logits, pool = model.apply_with_cache(
+                    params, ids, pool, 0, position_ids=pos, write_index=lengths,
+                    q_spans=spans)
+                l = logits.astype(jnp.float32)  # (N, C, V)
+                toks = jnp.stack([sample(l[:, j], seeds, steps + j, flags,
+                                         temps, topks, topps) for j in range(C)])
+                if collect:
+                    return pool, toks, l.swapaxes(0, 1)
+                return pool, toks
+
+            self._compiled[key] = jax.jit(spec, donate_argnums=(1, ))
         return self._compiled[key]
 
     def _copy_fn(self):

@@ -633,30 +633,51 @@ class Attention(nn.Module):
             # the shape the Pallas decode kernel streams (reference KV-cache
             # arena: csrc/transformer/inference/includes/inference_context.h).
             # k/v are already bhtd, so the cache write needs no transpose.
-            ck, cv = kv_cache
+            #
+            # int8 paged KV tier: a 3-leaf cache (k, v, scale) stores
+            # group-quantized rows — ONE symmetric scale per written token
+            # row, shared by K and V across every head (group = the row),
+            # scale leaf (B, 1, S, 1) fp16. Fresh K/V quantize at write
+            # time; the paged Pallas kernels dequantize in-register (bf16
+            # KV never lands in HBM), the XLA fallback dequantizes before
+            # attending.
+            quant_kv = len(kv_cache) == 3
+            if quant_kv:
+                from ..ops.quantizer import dequantize_kv_rows, quantize_kv_rows
+                ck, cv, csc = kv_cache
+                kq, vq, sc_new = quantize_kv_rows(k, v)
+                writes = [(ck, kq), (cv, vq), (csc, sc_new)]
+            else:
+                ck, cv = kv_cache
+                writes = [(ck, k), (cv, v)]
             if write_index is not None and q_spans is not None:
                 # fused chunk/decode span write: column j of row i lands at
                 # row position write_index_i + j; columns past the row's live
                 # span target row S (out of range) and are DROPPED — padding
                 # never writes, so retained prefix slots and co-resident
-                # decode rows in the pool stay byte-stable
+                # decode rows in the pool stay byte-stable. The scale leaves
+                # share the tgt row indices (their S axis matches the KV S).
                 tgt = write_index[:, None] + jnp.arange(T)[None, :]
                 tgt = jnp.where(jnp.arange(T)[None, :] < q_spans[:, None], tgt,
                                 ck.shape[2])
                 upd = lambda c, kk, i: c.at[:, i, :].set(kk.astype(c.dtype), mode="drop")
-                ck = jax.vmap(upd)(ck, k, tgt)
-                cv = jax.vmap(upd)(cv, v, tgt)
+                written = [jax.vmap(upd)(c, kk, tgt) for c, kk in writes]
                 cache_index = write_index  # per-row causal window below
             elif write_index is not None:
                 # slot-pool decode: each row appends at its own position
-                upd = lambda c, kk, i: jax.lax.dynamic_update_slice_in_dim(c, kk, i, axis=1)
-                ck = jax.vmap(upd)(ck, k.astype(ck.dtype), write_index)
-                cv = jax.vmap(upd)(cv, v.astype(cv.dtype), write_index)
+                upd = lambda c, kk, i: jax.lax.dynamic_update_slice_in_dim(
+                    c, kk.astype(c.dtype), i, axis=1)
+                written = [jax.vmap(upd)(c, kk, write_index) for c, kk in writes]
                 cache_index = write_index  # per-row causal window below
             else:
-                ck = jax.lax.dynamic_update_slice_in_dim(ck, k.astype(ck.dtype), cache_index, axis=2)
-                cv = jax.lax.dynamic_update_slice_in_dim(cv, v.astype(cv.dtype), cache_index, axis=2)
-            if cfg.attention_impl == "flash" and T == 1 and alibi is None:
+                written = [jax.lax.dynamic_update_slice_in_dim(
+                    c, kk.astype(c.dtype), cache_index, axis=2) for c, kk in writes]
+            if quant_kv:
+                ck, cv, csc = written
+            else:
+                ck, cv = written
+            if (cfg.attention_impl == "flash" and T == 1 and alibi is None
+                    and (write_index is not None or not quant_kv)):
                 from ..ops.pallas.decode_attention import decode_attention, \
                     paged_decode_attention
                 if attn_mask is not None:
@@ -667,9 +688,11 @@ class Attention(nn.Module):
                     # a sliding window is just a raised start for one query
                     starts = jnp.maximum(starts, cache_index + 1 - window)
                 if write_index is not None:
-                    out = paged_decode_attention(q[:, :, 0], ck, cv, starts,
-                                                 write_index + 1,
-                                                 block_kv=cfg.decode_block_kv)[:, :, None]
+                    out = paged_decode_attention(
+                        q[:, :, 0], ck, cv, starts, write_index + 1,
+                        block_kv=cfg.decode_block_kv,
+                        k_scale=csc if quant_kv else None,
+                        v_scale=csc if quant_kv else None)[:, :, None]
                 else:
                     out = decode_attention(q[:, :, 0], ck, cv, starts, cache_index + 1,
                                            block_kv=cfg.decode_block_kv)[:, :, None]
@@ -685,7 +708,9 @@ class Attention(nn.Module):
                 else:
                     starts = jnp.zeros((B, ), jnp.int32)
                 out = paged_span_attention(q, ck, cv, starts, write_index,
-                                           block_kv=cfg.decode_block_kv)
+                                           block_kv=cfg.decode_block_kv,
+                                           k_scale=csc if quant_kv else None,
+                                           v_scale=csc if quant_kv else None)
             elif (cfg.attention_impl == "flash" and attn_mask is None and T >= 128
                   and isinstance(cache_index, int) and cache_index == 0 and alibi is None
                   and not window):
@@ -697,10 +722,16 @@ class Attention(nn.Module):
                                               block_q=cfg.attention_block_q,
                                               block_kv=cfg.attention_block_kv)
             else:
-                out = _cached_attention_xla(q, ck, cv, cache_index, attn_mask, cfg.dtype,
-                                            alibi=alibi, window=window)
+                if quant_kv:
+                    out = _cached_attention_xla(
+                        q, dequantize_kv_rows(ck, csc, dtype=cfg.dtype),
+                        dequantize_kv_rows(cv, csc, dtype=cfg.dtype),
+                        cache_index, attn_mask, cfg.dtype, alibi=alibi, window=window)
+                else:
+                    out = _cached_attention_xla(q, ck, cv, cache_index, attn_mask,
+                                                cfg.dtype, alibi=alibi, window=window)
             out = out.astype(cfg.dtype)
-            new_cache = (ck, cv)
+            new_cache = tuple(written)
         else:
             new_cache = None
             use_flash = (cfg.attention_impl == "flash" and T >= 128 and attn_mask is None
@@ -950,8 +981,10 @@ class CausalLM(nn.Module):
             caches = []
             for i in range(cfg.num_layers):
                 # per-layer tuple cache (init_cache, unrolled form); stacked
-                # arrays also index correctly for backward compatibility
-                layer_cache = None if kv_cache is None else (kv_cache[0][i], kv_cache[1][i])
+                # arrays also index correctly for backward compatibility.
+                # 2 components (k, v) or 3 (+ the int8 tier's scale leaf)
+                layer_cache = (None if kv_cache is None
+                               else tuple(comp[i] for comp in kv_cache))
                 blk = block(cfg, layer_idx=i, name=f"layer_{i}")
                 if ltd_active and i in ltd_layers:
                     y, c = ltd_apply(
@@ -965,7 +998,8 @@ class CausalLM(nn.Module):
                 x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
-                new_cache = (tuple(c[0] for c in caches), tuple(c[1] for c in caches))
+                new_cache = tuple(tuple(c[j] for c in caches)
+                                  for j in range(len(caches[0])))
 
         x = make_norm(cfg, name="final_norm")(x)
         if return_hidden:
@@ -1146,17 +1180,34 @@ class CausalLMModel:
                 out[k] = jax.tree_util.tree_map(to_dtype, v)
         return out
 
-    def init_cache(self, batch_size, max_len, dtype=None):
+    def init_cache(self, batch_size, max_len, dtype=None, quantized=False):
         """Preallocated KV cache — the analogue of the reference's inference
         workspace KV arena (``csrc/transformer/inference/includes/
         inference_context.h``). Scanned models carry one stacked
         (L, B, kv_heads, S, head_dim) pair; unrolled models carry per-layer
         tuples of (B, kv_heads, S, head_dim) — separate tensors alias
         IN-PLACE through the decode while-loop carry, where a scan's stacked
-        ys output is rebuilt (full cache copy) every token."""
+        ys output is rebuilt (full cache copy) every token.
+
+        ``quantized``: the int8 paged KV tier (serving ``kv_cache_dtype:
+        int8``) — each layer carries THREE leaves ``(k int8, v int8,
+        scale)``: one fp16 per-token-row scale shaped (B, 1, S, 1), shared
+        by K and V across every head. Scales init to 1 (rows past each
+        slot's end are never attended), and every leaf keeps its batch/slot
+        axis at ``ndim - 4`` so the slot pool's slice/update/copy programs
+        treat both layouts uniformly."""
         cfg = self.cfg
         dt = dtype or cfg.dtype
         shape = (batch_size, cfg.kv_heads, max_len, cfg.head_size)
+        sshape = (batch_size, 1, max_len, 1)
+        if quantized:
+            if cfg.scan_layers:
+                L = (cfg.num_layers, )
+                return (jnp.zeros(L + shape, jnp.int8), jnp.zeros(L + shape, jnp.int8),
+                        jnp.ones(L + sshape, jnp.float16))
+            return (tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
+                    tuple(jnp.zeros(shape, jnp.int8) for _ in range(cfg.num_layers)),
+                    tuple(jnp.ones(sshape, jnp.float16) for _ in range(cfg.num_layers)))
         if cfg.scan_layers:
             stacked = (cfg.num_layers, ) + shape
             return (jnp.zeros(stacked, dt), jnp.zeros(stacked, dt))

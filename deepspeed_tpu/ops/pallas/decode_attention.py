@@ -52,13 +52,22 @@ def _interpret():
     return jax.default_backend() == "cpu"
 
 
-def _decode_kernel(start_ref, end_ref, max_end_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_s, l_s, acc_s, *, scale, block_kv, B, nkv, g, D, span=1):
+def _decode_kernel(start_ref, end_ref, max_end_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, block_kv, B, nkv, g, D, span=1, quantized=False):
     """``g`` is the FOLDED query axis: head-groups x span columns. With
     ``span > 1`` the per-row ``end`` is the causal end of column 0 and each
     later column's window extends by its offset (column j of a row attends
     one more key than column j-1 — per-row mixed decode/prefill query
-    spans share this one kernel)."""
+    spans share this one kernel).
+
+    ``quantized``: the KV blocks are int8 with per-token-row scales (two
+    extra (B, block_kv) scale operands); dequantization is the in-register
+    multiply below — the bf16/f32 KV never exists in HBM, so the block
+    walk's DMA bytes stay int8-sized."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        o_ref, m_s, l_s, acc_s = rest
     j = pl.program_id(0)
     nj = pl.num_programs(0)
     max_end = max_end_ref[0]
@@ -75,8 +84,13 @@ def _decode_kernel(start_ref, end_ref, max_end_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(kv_start < max_end)
     def _block():
         q = q_ref[...].astype(jnp.float32).reshape(BH, g, D) * scale
-        k = k_ref[...].astype(jnp.float32).reshape(BH, block_kv, D)
-        v = v_ref[...].astype(jnp.float32).reshape(BH, block_kv, D)
+        k = k_ref[...].astype(jnp.float32)  # (B, nkv, bkv, D)
+        v = v_ref[...].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[...].astype(jnp.float32)[:, None, :, None]
+            v = v * vs_ref[...].astype(jnp.float32)[:, None, :, None]
+        k = k.reshape(BH, block_kv, D)
+        v = v.reshape(BH, block_kv, D)
         s = jax.lax.dot_general(q, k, (((2, ), (2, )), ((0, ), (0, ))),
                                 preferred_element_type=jnp.float32)  # (BH, g, bkv)
         # masking in 2-D folded form: Mosaic rejects lane-dim-1 vector
@@ -117,17 +131,20 @@ def _decode_kernel(start_ref, end_ref, max_end_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _decode_call(qg, k_cache, v_cache, start, ends, max_end, *, block_kv, scale,
-                 span=1):
+                 span=1, k_scale=None, v_scale=None):
     """Shared pallas_call builder: per-row windows [start_i, ends_i), with
     ``max_end`` (scalar) bounding the walked KV blocks. ``qg``: queries
     pre-folded to (B, nkv, g, D) where ``g`` = head-groups x ``span``
-    columns (span fastest)."""
+    columns (span fastest). ``k_scale``/``v_scale``: optional (B, S)
+    per-token-row dequant scales for int8 caches (walked in lockstep with
+    the KV blocks; the lane axis is S, so scale blocks stay lane-aligned)."""
     B, nkv, g, D = qg.shape
     S = k_cache.shape[2]
     scale = scale if scale is not None else 1.0 / (D**0.5)
     block_kv = min(block_kv, S)
     if S % block_kv:
         raise ValueError(f"cache length {S} must be a multiple of block_kv={block_kv}")
+    quantized = k_scale is not None
 
     start = start.astype(jnp.int32)
     ends = ends.astype(jnp.int32)
@@ -140,18 +157,29 @@ def _decode_call(qg, k_cache, v_cache, start, ends, max_end, *, block_kv, scale,
         last = jnp.maximum(max_end_r[0] - 1, 0) // block_kv
         return (0, 0, jnp.minimum(j, last), 0)
 
+    def sc_index(j, start_r, end_r, max_end_r):
+        last = jnp.maximum(max_end_r[0] - 1, 0) // block_kv
+        return (0, jnp.minimum(j, last))
+
+    in_specs = [
+        pl.BlockSpec((B, nkv, g, D), lambda j, *_: (0, 0, 0, 0)),
+        pl.BlockSpec((B, nkv, block_kv, D), kv_index),
+        pl.BlockSpec((B, nkv, block_kv, D), kv_index),
+    ]
+    operands = [qg, k_cache, v_cache]
+    if quantized:
+        in_specs += [pl.BlockSpec((B, block_kv), sc_index)] * 2
+        operands += [k_scale, v_scale]
+
     kernel = functools.partial(_decode_kernel, scale=scale, block_kv=block_kv,
-                               B=B, nkv=nkv, g=g, D=D, span=span)
+                               B=B, nkv=nkv, g=g, D=D, span=span,
+                               quantized=quantized)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=3,
             grid=(nj, ),
-            in_specs=[
-                pl.BlockSpec((B, nkv, g, D), lambda j, *_: (0, 0, 0, 0)),
-                pl.BlockSpec((B, nkv, block_kv, D), kv_index),
-                pl.BlockSpec((B, nkv, block_kv, D), kv_index),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((B, nkv, g, D), lambda j, *_: (0, 0, 0, 0)),
             scratch_shapes=[
                 pltpu.VMEM((B * nkv, g), jnp.float32),      # running max
@@ -162,7 +190,7 @@ def _decode_call(qg, k_cache, v_cache, start, ends, max_end, *, block_kv, scale,
         out_shape=jax.ShapeDtypeStruct((B, nkv, g, D), qg.dtype),
         compiler_params=_CompilerParams(dimension_semantics=("arbitrary", )),
         interpret=_interpret(),
-    )(start, ends, max_end_arr, qg, k_cache, v_cache)
+    )(start, ends, max_end_arr, *operands)
     return out
 
 
@@ -183,30 +211,45 @@ def decode_attention(q, k_cache, v_cache, start, end, *, block_kv=256, scale=Non
     return out.reshape(B, H, D)
 
 
-def paged_decode_attention(q, k_cache, v_cache, start, ends, *, block_kv=256, scale=None):
+def _row_scales(k_scale, v_scale, B, S):
+    """(B, 1, S, 1) stored per-token-row scale leaves -> the (B, S) layout
+    the kernel walks (lane axis = S, so scale blocks stay lane-aligned)."""
+    if k_scale is None:
+        return None, None
+    return k_scale.reshape(B, S), v_scale.reshape(B, S)
+
+
+def paged_decode_attention(q, k_cache, v_cache, start, ends, *, block_kv=256,
+                           scale=None, k_scale=None, v_scale=None):
     """Slot-pool variant: per-row ends. q: (B, H, D); k_cache/v_cache:
     (B, kv_heads, S, D) where B indexes cache SLOTS; ``ends``: (B,) int32 one
     past each slot's last written position (rows with ``ends == 0`` attend
     nothing — their output is unspecified; callers mask dead slots).
     The KV-block walk stops at ``max(ends)``, so compute and DMA
     scale with the longest LIVE context, not the pool capacity S.
+    ``k_scale``/``v_scale``: optional (B, 1, S, 1) per-token-row dequant
+    scales for int8 caches — dequantization fuses into the kernel.
     Returns (B, H, D)."""
     B, H, D = q.shape
     ends = ends.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, B, k_cache.shape[2])
     out = _decode_call(_group(q, k_cache.shape[1]), k_cache, v_cache, start, ends,
-                       jnp.max(ends), block_kv=block_kv, scale=scale)
+                       jnp.max(ends), block_kv=block_kv, scale=scale,
+                       k_scale=ks, v_scale=vs)
     return out.reshape(B, H, D)
 
 
 def paged_span_attention(q, k_cache, v_cache, start, base, *, block_kv=256,
-                         scale=None):
+                         scale=None, k_scale=None, v_scale=None):
     """Fused chunked-prefill/decode variant: per-row query SPANS. q:
     (B, H, T, D) — row ``i``'s query column ``j`` sits at absolute cache
     position ``base_i + j`` and attends keys in ``[start_i, base_i + j]``
     (its own freshly-written KV included). Decode rows put their one live
     token in column 0; the in-flight prefill row fills up to a chunk; columns
     past a row's live span compute garbage that the caller never reads.
-    ``base``: (B,) int32 per-row write heads (== column 0's position). The
+    ``base``: (B,) int32 per-row write heads (== column 0's position).
+    ``k_scale``/``v_scale``: optional (B, 1, S, 1) per-token-row dequant
+    scales for int8 caches — dequantization fuses into the kernel. The
     KV-block walk stops at ``max(base) + T``. Returns (B, H, T, D)."""
     B, H, T, D = q.shape
     nkv = k_cache.shape[1]
@@ -214,6 +257,8 @@ def paged_span_attention(q, k_cache, v_cache, start, base, *, block_kv=256,
     # kernel recovers the per-column causal offset from ``idx % span``
     qf = q.reshape(B, nkv, (H // nkv) * T, D)
     base = base.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, B, k_cache.shape[2])
     out = _decode_call(qf, k_cache, v_cache, start, base + 1, jnp.max(base) + T,
-                       block_kv=block_kv, scale=scale, span=T)
+                       block_kv=block_kv, scale=scale, span=T, k_scale=ks,
+                       v_scale=vs)
     return out.reshape(B, H, T, D)

@@ -10,6 +10,16 @@ in ``ops/pallas/quant_matmul.py``.
 Convention: per-group scales along the contraction (first) axis of a
 (K, N) weight; ``groups`` divides K. Symmetric: q = round(w / s),
 s = max|w| / (2^(b-1) - 1) per (group, column).
+
+4-bit values from :func:`quantize` come back one int8 PER VALUE (the
+convenient compute layout); :func:`pack_int4`/:func:`unpack_int4` fold two
+of them into one byte so a stored 4-bit tensor actually halves bytes.
+
+The serving KV-cache direction lives here too: :func:`quantize_kv_rows` /
+:func:`dequantize_kv_rows` group-quantize per TOKEN ROW (one symmetric
+scale shared by K and V across every head's values written at that cache
+position — the group is the row), the layout the int8 paged KV tier stores
+and the paged Pallas decode kernels dequantize in-register.
 """
 
 import jax.numpy as jnp
@@ -51,6 +61,71 @@ def dequantize(q, scale, zero=None, groups=None, dtype=jnp.bfloat16):
     qg = _group_reshape(jnp.asarray(q, jnp.float32), g)
     w = qg * scale if zero is None else qg * scale + zero
     return w.reshape(q.shape).astype(dtype)
+
+
+def pack_int4(q):
+    """Fold a 4-bit-valued int8 tensor (values in [-8, 7], e.g. from
+    ``quantize(bits=4)``) into half the bytes: consecutive pairs along the
+    FIRST (contraction) axis share one int8 — low nibble = even row, high
+    nibble = odd row. The first dim must be even (group quantization
+    already requires ``groups | K``, and any even K qualifies)."""
+    q = jnp.asarray(q, jnp.int8)
+    K = q.shape[0]
+    if K % 2:
+        raise ValueError(f"pack_int4 needs an even first dim, got {K}")
+    lo = q[0::2]
+    hi = q[1::2]
+    # two's-complement nibbles: keep only the low 4 bits of each value
+    return ((lo & 0x0F) | ((hi & 0x0F) << 4)).astype(jnp.int8)
+
+
+def unpack_int4(p):
+    """Inverse of :func:`pack_int4`: (K/2, ...) packed int8 -> (K, ...)
+    int8 values in [-8, 7] (sign-extended from each nibble)."""
+    p = jnp.asarray(p, jnp.int8)
+    lo = (p & 0x0F).astype(jnp.int8)
+    hi = ((p >> 4) & 0x0F).astype(jnp.int8)
+    # sign-extend the 4-bit two's-complement nibbles
+    lo = jnp.where(lo >= 8, lo - 16, lo)
+    hi = jnp.where(hi >= 8, hi - 16, hi)
+    K2 = p.shape[0]
+    out = jnp.empty((2 * K2, ) + p.shape[1:], jnp.int8)
+    out = out.at[0::2].set(lo)
+    out = out.at[1::2].set(hi)
+    return out
+
+
+def quantize_kv_rows(k, v, scale_dtype=jnp.float16):
+    """Joint per-token-row symmetric int8 quantization for the paged KV
+    tier.
+
+    ``k``/``v``: (B, heads, T, hd) fresh rows about to be written into the
+    cache. ONE scale per (batch row, token), shared by K and V across every
+    head: the quantization group is the full written row — the coarsest
+    grouping whose error stays bounded by one int8 step of the row's joint
+    absmax, and the narrowest scale storage (2 bytes/row total) that keeps
+    the int8 tier at >= 1.9x the resident rows of a bf16 pool even at small
+    head dims (separate per-tensor or per-head scales eat the savings
+    exactly where slots/chip matter). Returns ``(kq, vq, scales
+    (B, 1, T, 1))`` — the scale layout mirrors the KV cache row layout so
+    the same indexed-write path stores all three."""
+    kf = jnp.asarray(k, jnp.float32)
+    vf = jnp.asarray(v, jnp.float32)
+    amax = jnp.maximum(jnp.max(jnp.abs(kf), axis=(1, 3), keepdims=True),
+                       jnp.max(jnp.abs(vf), axis=(1, 3), keepdims=True))  # (B,1,T,1)
+    scale = jnp.maximum(amax / 127.0, 1e-8).astype(scale_dtype)
+    s32 = scale.astype(jnp.float32)
+    kq = jnp.clip(jnp.round(kf / s32), -127, 127).astype(jnp.int8)
+    vq = jnp.clip(jnp.round(vf / s32), -127, 127).astype(jnp.int8)
+    return kq, vq, scale
+
+
+def dequantize_kv_rows(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv_rows` for the non-kernel (XLA
+    fallback) attention path: ``q`` (B, heads, S, hd) int8, ``scale``
+    (B, 1, S, 1) -> float rows. The Pallas paged kernels do this multiply
+    in-register instead (bf16 KV never lands in HBM)."""
+    return (q.astype(jnp.float32) * scale.astype(jnp.float32)).astype(dtype)
 
 
 class Quantizer:

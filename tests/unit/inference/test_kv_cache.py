@@ -231,3 +231,36 @@ def test_radix_remove_prunes_empty_branches():
     radix.insert(a, [5])
     with pytest.raises(ValueError, match="already registered"):
         radix.insert(a, [6])
+
+
+# ------------------------------------------------------------ bytes accounting
+def test_bytes_accounting_host_only_pool():
+    """bytes_per_token is 0 (not a crash) on host-bookkeeping-only pools;
+    live_bytes tracks live + retained rows."""
+    kv = make_pool(num_slots=2, max_len=64)
+    assert kv.bytes_per_token() == 0 and kv.capacity_bytes() == 0
+    s = kv.alloc()
+    kv.lengths[s] = 10
+    assert kv.live_bytes() == 0  # no device pool -> no bytes to report
+
+
+def test_bytes_accounting_plain_vs_quantized_layout():
+    """Per-token bytes fall out of the leaf shapes generically: the int8
+    tier (k int8, v int8, joint fp16 row scale) lands >= 1.9x denser than a
+    bf16 pool of the same geometry."""
+    import jax.numpy as jnp
+    L, N, H, S, D = 2, 4, 2, 64, 16
+    bf16 = (jnp.zeros((L, N, H, S, D), jnp.bfloat16),
+            jnp.zeros((L, N, H, S, D), jnp.bfloat16))
+    q8 = (jnp.zeros((L, N, H, S, D), jnp.int8),
+          jnp.zeros((L, N, H, S, D), jnp.int8),
+          jnp.ones((L, N, 1, S, 1), jnp.float16))
+    kv_b = SlotKVCache(bf16, N, S)
+    kv_q = SlotKVCache(q8, N, S)
+    assert kv_b.bytes_per_token() == L * H * D * 2 * 2
+    assert kv_q.bytes_per_token() == L * H * D * 2 + L * 2
+    assert kv_b.bytes_per_token() / kv_q.bytes_per_token() >= 1.9
+    assert kv_b.capacity_bytes() == kv_b.bytes_per_token() * N * S
+    s = kv_q.alloc()
+    kv_q.lengths[s] = 7
+    assert kv_q.live_bytes() == 7 * kv_q.bytes_per_token()

@@ -1,0 +1,54 @@
+"""PromptLookupDrafter unit tests: n-gram matching, recency preference,
+fallback order, and proposal caps — the host half of speculative decoding
+(the verify half is covered end-to-end in test_scheduler.py)."""
+
+import numpy as np
+
+from deepspeed_tpu.inference.speculative import PromptLookupDrafter
+
+
+def test_drafts_continuation_of_most_recent_match():
+    d = PromptLookupDrafter(4, ngram_max=2, ngram_min=1)
+    # suffix (1, 2) occurs twice; the MOST RECENT occurrence is followed by
+    # 7, 8 — recency tracks the local pattern
+    ctx = [1, 2, 3, 4, 1, 2, 7, 8, 9, 1, 2]
+    out = d.draft(ctx)
+    assert out.tolist() == [7, 8, 9, 1]
+
+
+def test_falls_back_to_shorter_ngrams():
+    d = PromptLookupDrafter(3, ngram_max=3, ngram_min=1)
+    # no 3- or 2-gram recurrence of the suffix, but token 5 repeats
+    out = d.draft([5, 9, 8, 7, 5])
+    assert out.tolist() == [9, 8, 7]
+
+
+def test_no_match_returns_empty():
+    d = PromptLookupDrafter(4)
+    assert d.draft([1, 2, 3, 4, 5]).size == 0
+    assert d.draft([1]).size == 0
+    assert d.draft([]).size == 0
+
+
+def test_cap_limits_proposal_length():
+    d = PromptLookupDrafter(8, ngram_max=1, ngram_min=1)
+    ctx = [3, 1, 2, 4, 5, 6, 3]
+    # the proposal window runs to the end of context (the suffix token
+    # itself is a legal guess for the future)
+    assert d.draft(ctx).tolist() == [1, 2, 4, 5, 6, 3]
+    assert d.draft(ctx, max_tokens=2).tolist() == [1, 2]
+    assert d.draft(ctx, max_tokens=0).size == 0
+
+
+def test_min_ngram_gate_suppresses_weak_drafts():
+    # ngram_min=2: a single-token repeat is not evidence enough
+    d = PromptLookupDrafter(4, ngram_max=3, ngram_min=2)
+    assert d.draft([3, 1, 2, 4, 3]).size == 0
+    assert d.draft([1, 2, 9, 1, 2]).tolist() == [9, 1, 2]
+
+
+def test_draft_never_proposes_past_context_end():
+    d = PromptLookupDrafter(4, ngram_max=1, ngram_min=1)
+    # the only prior occurrence of the last token is immediately before the
+    # suffix: one follower exists
+    assert d.draft([7, 7]).tolist() == [7]

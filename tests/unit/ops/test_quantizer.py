@@ -11,7 +11,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.ops.quantizer import Quantizer, dequantize, quantize
+from deepspeed_tpu.ops.quantizer import (Quantizer, dequantize, dequantize_kv_rows,
+                                         pack_int4, quantize, quantize_kv_rows,
+                                         unpack_int4)
 from deepspeed_tpu.ops.pallas.quant_matmul import quant_matmul
 from deepspeed_tpu.runtime.weight_quantizer import WeightQuantization
 
@@ -38,6 +40,47 @@ def test_int4_range():
     w = jnp.asarray(np.random.default_rng(2).standard_normal((64, 16)), jnp.float32)
     q, s, _ = quantize(w, bits=4, groups=2)
     assert int(q.max()) <= 7 and int(q.min()) >= -8
+
+
+def test_int4_pack_roundtrip_halves_bytes():
+    """bits=4 quantization stores one int8 per value (compute layout);
+    pack_int4 must actually halve the bytes and round-trip exactly —
+    including every corner of the signed nibble range."""
+    w = jnp.asarray(np.random.default_rng(5).standard_normal((64, 16)), jnp.float32)
+    q, s, _ = quantize(w, bits=4, groups=4)
+    packed = pack_int4(q)
+    assert packed.shape == (32, 16) and packed.dtype == jnp.int8
+    assert packed.size * packed.dtype.itemsize == q.size * q.dtype.itemsize // 2
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)), np.asarray(q))
+    # dequantizing the unpacked values matches dequantizing the originals
+    np.testing.assert_array_equal(np.asarray(dequantize(unpack_int4(packed), s, dtype=jnp.float32)),
+                                  np.asarray(dequantize(q, s, dtype=jnp.float32)))
+
+
+def test_int4_pack_full_nibble_range_and_odd_dim():
+    vals = jnp.asarray(np.arange(-8, 8, dtype=np.int8).reshape(16, 1))
+    np.testing.assert_array_equal(np.asarray(unpack_int4(pack_int4(vals))),
+                                  np.asarray(vals))
+    with pytest.raises(ValueError, match="even first dim"):
+        pack_int4(jnp.zeros((3, 2), jnp.int8))
+
+
+def test_kv_row_quant_roundtrip_error_bound():
+    """Joint per-token-row KV quantization: one scale per row shared by K
+    and V, scale layout mirrors the cache row layout, and the round-trip
+    error stays under one quantization step of the row's joint absmax."""
+    r = np.random.default_rng(6)
+    k = jnp.asarray(r.standard_normal((2, 4, 8, 16)) * 3.0, jnp.float32)
+    v = jnp.asarray(r.standard_normal((2, 4, 8, 16)) * 0.5, jnp.float32)
+    kq, vq, s = quantize_kv_rows(k, v)
+    assert kq.shape == k.shape and kq.dtype == jnp.int8 and vq.dtype == jnp.int8
+    assert s.shape == (2, 1, 8, 1) and s.dtype == jnp.float16
+    amax = np.maximum(np.abs(np.asarray(k)).max(axis=(1, 3), keepdims=True),
+                      np.abs(np.asarray(v)).max(axis=(1, 3), keepdims=True))
+    # one int8 step of the joint row absmax, plus the fp16 scale's rounding
+    bound = amax / 127.0 * (1.0 + 2.0**-10) + 1e-6
+    assert np.all(np.abs(np.asarray(dequantize_kv_rows(kq, s)) - np.asarray(k)) <= bound)
+    assert np.all(np.abs(np.asarray(dequantize_kv_rows(vq, s)) - np.asarray(v)) <= bound)
 
 
 def test_quantizer_facade():

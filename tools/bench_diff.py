@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Diff a fresh BENCH_*.json against the prior round's and flag regressions.
+
+The growth loop records one ``BENCH_r0N.json`` per round (driver-wrapped:
+``{"n", "rc", "tail", "parsed": {metric, value, unit, vs_baseline, extra}}``)
+— but nothing compared rounds, so a perf regression only surfaced when a
+human eyeballed two JSON files. This tool walks every numeric leaf shared by
+two rounds and prints the delta, flagging moves past a threshold in the
+metric's BAD direction (lower-is-better names — ms/latency/stall/error —
+regress upward; everything else regresses downward).
+
+    python tools/bench_diff.py NEW.json [OLD.json] [--threshold 0.05] [--strict]
+
+``OLD`` defaults to the highest-numbered ``BENCH_r*.json`` in the repo root
+other than ``NEW`` itself. Accepts driver-wrapped files, raw bench JSON
+lines (the ``python bench.py`` stdout), and files whose last line is the
+JSON (mixed logs). Exit code is 0 unless ``--strict`` is given and a
+regression was flagged — the default mode is ADVISORY (ci_check.sh runs it
+that way: a slow leg should be seen, not block unrelated work).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# underscore-tokens marking lower-is-better metrics; everything else is
+# higher-is-better. Tokenized (not substring) matching: "_s" as a substring
+# would misfile tokens_per_sec_chip. "p95"/"p50" alone are ambiguous
+# (ttft_ms_p95 carries "ms" anyway), so direction keys on unit-ish tokens.
+_LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
+                 "shed", "evict", "evictions", "miss", "misses", "s", "seconds",
+                 "loss", "ppl", "perplexity"}
+
+
+def _lower_better(path):
+    leaf = path.split(".")[-1].lower()
+    if "bytes_per_token" in leaf:
+        return True
+    return any(tok in _LOWER_TOKENS for tok in leaf.split("_"))
+
+
+def _load(path):
+    """Driver-wrapped, raw JSON, or last-JSON-line log -> the bench record
+    {metric, value, unit, vs_baseline, extra}."""
+    with open(path) as f:
+        text = f.read().strip()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+        for line in reversed(text.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    doc = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        if doc is None:
+            raise ValueError(f"{path}: no JSON object found")
+    if isinstance(doc, dict) and "parsed" in doc:
+        # driver wrapper: parsed == null means the round crashed before
+        # printing its JSON line — say so instead of diffing wrapper fields
+        if not isinstance(doc["parsed"], dict):
+            raise ValueError(f"{path}: round recorded no parsed metrics "
+                             f"(rc={doc.get('rc')}) — the bench crashed; "
+                             f"nothing to compare")
+        doc = doc["parsed"]
+    return doc
+
+
+def _numeric_leaves(node, prefix=""):
+    """Flatten to {dotted.path: float}; skips bools (flags aren't metrics)
+    and non-numeric leaves."""
+    out = {}
+    if isinstance(node, dict):
+        for k, v in node.items():
+            out.update(_numeric_leaves(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(node, bool):
+        pass
+    elif isinstance(node, (int, float)):
+        out[prefix] = float(node)
+    return out
+
+
+def _default_old(new_path):
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rounds = []
+    for p in glob.glob(os.path.join(root, "BENCH_r*.json")):
+        if os.path.abspath(p) == os.path.abspath(new_path):
+            continue
+        m = re.search(r"BENCH_r(\d+)", os.path.basename(p))
+        if m:
+            rounds.append((int(m.group(1)), p))
+    if not rounds:
+        return None
+    return max(rounds)[1]
+
+
+def diff(old, new, threshold=0.05):
+    """Compare two bench records; returns (rows, regressions) where rows are
+    (path, old, new, rel_delta, flag) over the shared numeric leaves."""
+    a = _numeric_leaves(old)
+    b = _numeric_leaves(new)
+    rows = []
+    regressions = []
+    for path in sorted(set(a) & set(b)):
+        va, vb = a[path], b[path]
+        if va == vb:
+            continue
+        rel = (vb - va) / abs(va) if va else float("inf") * (1 if vb > 0 else -1)
+        worse = rel > 0 if _lower_better(path) else rel < 0
+        flag = worse and abs(rel) >= threshold
+        rows.append((path, va, vb, rel, flag))
+        if flag:
+            regressions.append((path, va, vb, rel))
+    return rows, regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("new", help="fresh bench JSON (driver-wrapped or raw line)")
+    ap.add_argument("old", nargs="?", default=None,
+                    help="prior round (default: latest BENCH_r*.json in repo root)")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative move flagged as a regression (default 0.05)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any regression is flagged (default: advisory)")
+    args = ap.parse_args(argv)
+
+    old_path = args.old or _default_old(args.new)
+    if old_path is None:
+        print("bench_diff: no prior BENCH_r*.json found; nothing to compare")
+        return 0
+    try:
+        old, new = _load(old_path), _load(args.new)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}; skipping comparison")
+        return 0
+    if old.get("skipped") or new.get("skipped"):
+        which = "old" if old.get("skipped") else "new"
+        print(f"bench_diff: {which} round was a structured skip "
+              f"({(old if which == 'old' else new).get('reason', '?')}); "
+              f"no comparable numbers")
+        return 0
+
+    if old.get("metric") != new.get("metric"):
+        # different headline metrics (e.g. train MFU vs serving tok/s):
+        # top-level value/vs_baseline are not comparable — diff extra.* only
+        print(f"bench_diff: headline metrics differ ({old.get('metric')!r} vs "
+              f"{new.get('metric')!r}); comparing extra.* leaves only")
+        old = {"extra": old.get("extra", {})}
+        new = {"extra": new.get("extra", {})}
+    print(f"bench_diff: {os.path.basename(old_path)} -> "
+          f"{os.path.basename(args.new)} (threshold {args.threshold:.0%})")
+    rows, regressions = diff(old, new, args.threshold)
+    if not rows:
+        print("  no shared numeric metrics changed")
+        return 0
+    for path, va, vb, rel, flag in rows:
+        improved = rel < 0 if _lower_better(path) else rel > 0
+        mark = "REGRESSION" if flag else ("improved" if improved
+                                          else "worse (under threshold)")
+        print(f"  {'!! ' if flag else '   '}{path}: {va:g} -> {vb:g} "
+              f"({rel:+.1%}) {mark}")
+    if regressions:
+        print(f"bench_diff: {len(regressions)} metric(s) regressed past "
+              f"{args.threshold:.0%}")
+        if args.strict:
+            return 1
+    else:
+        print("bench_diff: no regressions past threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

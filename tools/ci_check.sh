@@ -6,6 +6,7 @@
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
+#   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
 # pass count the growth driver tracks (ROADMAP.md "Tier-1 verify").
@@ -24,6 +25,7 @@ guards() {
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/unit/inference/test_scheduler.py \
     tests/unit/inference/test_kv_cache.py \
+    tests/unit/inference/test_speculative.py \
     tests/unit/serving/test_gateway.py \
     "tests/unit/inference/test_inference.py::test_paged_decode_kernel_vs_reference" \
     "tests/unit/inference/test_inference.py::test_decode_kernel_vs_reference" \
@@ -39,6 +41,25 @@ offload_lane() {
   # (BENCH_OFFLOAD_STREAM JSON: depth 0 vs 2 step time + overlap_efficiency).
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/unit/test_offload_stream.py -q -p no:cacheprovider
+}
+
+bench_diff() {
+  echo "== bench diff (advisory) =="
+  # diff the given fresh bench JSON (or the latest committed round) against
+  # the prior BENCH_r0*.json and print per-metric deltas with regression
+  # flags. ADVISORY: regressions print loudly but never fail CI — a slow
+  # bench leg should be seen, not block unrelated work (pass --strict to
+  # tools/bench_diff.py directly to gate on it).
+  local new="${1:-}"
+  if [ -z "$new" ]; then
+    new=$(ls BENCH_r*.json 2>/dev/null | sort | tail -1)
+  fi
+  if [ -z "$new" ]; then
+    echo "no BENCH_r*.json to diff; skipping"
+    return 0
+  fi
+  python tools/bench_diff.py "$new" || true
+  return 0
 }
 
 gateway_smoke() {
@@ -61,6 +82,10 @@ if [ "${1:-}" = "--offload" ]; then
   offload_lane
   exit $?
 fi
+if [ "${1:-}" = "--bench-diff" ]; then
+  bench_diff "${2:-}"
+  exit $?
+fi
 
 echo "== tier-1 core lane =="
 rm -f /tmp/_t1.log
@@ -80,5 +105,8 @@ o_rc=$?
 
 gateway_smoke
 gw_rc=$?
+
+# advisory: surfaces last round's bench regressions, never fails the build
+bench_diff
 
 [ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ]
