@@ -19,6 +19,7 @@ it below 1% of the measurement.
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -237,11 +238,13 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
     gaps = (rng.exponential(1.0 / arrival_rate, n_requests) if arrival_rate
             else np.zeros(n_requests))
 
-    def make(continuous):
+    def make(continuous, telemetry=None):
         _comm._state["mesh"] = None
         cfg = {"dtype": dtype, "max_out_tokens": 512, "kernel_inject": kernel_inject,
                "continuous_batching": {"enabled": continuous, "num_slots": num_slots,
                                        "steps_per_sync": steps_per_sync}}
+        if telemetry:
+            cfg["telemetry"] = telemetry
         return deepspeed_tpu.init_inference(model_name, config=cfg)
 
     results = {}
@@ -339,7 +342,46 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
                lambda: _speculative_bench(make, num_slots, n_requests, max_new, seed))
     _guard_leg(results, "kv_int8",
                lambda: _kv_int8_bench(make, num_slots, max_new, seed))
+    _guard_leg(results, "observability",
+               lambda: _observability_bench(make, max_new, seed))
     return results
+
+
+def _observability_bench(make, max_new, seed):
+    """Telemetry-overhead leg: one warmed decode request with the sink OFF
+    vs ON (full request tracing + windowed histograms + flight recorder +
+    SLO engine idle), reporting the per-request tax — the number the
+    observability lane's CI guard bounds — plus proof the artifacts
+    (trace.json, flight dump) actually land."""
+    from deepspeed_tpu.telemetry import set_sink
+    rng = np.random.default_rng(seed)
+    prompt = rng.integers(0, 50257, 32).astype(np.int32)
+
+    def run(tel_cfg):
+        set_sink(None)
+        eng = make(True, telemetry=tel_cfg)
+        sched = eng.scheduler(num_slots=2)
+        sched.submit(prompt, max_new_tokens=16).result()  # warm the programs
+        t0 = time.perf_counter()
+        sched.submit(prompt, max_new_tokens=max_new).result()
+        return eng, time.perf_counter() - t0
+
+    try:
+        _, base_s = run(None)
+        tdir = tempfile.mkdtemp(prefix="bench_obs_")
+        eng, traced_s = run({"enabled": True, "output_path": tdir,
+                             "request_tracing": True})
+        dump = eng.telemetry.dump_flight("bench_probe")
+        eng.telemetry.close()  # forces trace rewrite + flight finalize
+        return {
+            "decode_s_untraced": round(base_s, 4),
+            "decode_s_traced": round(traced_s, 4),
+            "tracing_overhead_x": round(traced_s / max(base_s, 1e-9), 3),
+            "trace_json_written": os.path.exists(eng.telemetry.trace_path),
+            "flight_dump_written": bool(dump) and os.path.exists(dump),
+        }
+    finally:
+        set_sink(None)
 
 
 def _speculative_bench(make, num_slots, n_requests, max_new, seed, spec_tokens=4):

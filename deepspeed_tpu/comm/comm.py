@@ -20,13 +20,14 @@ translation (SURVEY §2.2/§5):
 """
 
 import os
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..utils.logging import logger
+from .overlap import CommOverlapTracker, get_overlap_tracker  # noqa: F401
 
 # ---------------------------------------------------------------------------
 # Canonical mesh axis names (process-group equivalents)
@@ -233,11 +234,23 @@ def get_process_count():
     return jax.process_count()
 
 
+def _tracked_host(op_name):
+    """Realized/exposed bracket for a synchronous host-context collective
+    (see ``comm/overlap.py``); a no-op context unless a telemetry sink is
+    live — the default-off path stays untouched."""
+    from ..telemetry import get_sink
+    sink = get_sink()
+    if sink is not None and sink.enabled:
+        return get_overlap_tracker().track_host(op_name)
+    return nullcontext()
+
+
 def barrier(group=None):
     """Cross-process barrier (host context)."""
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices("deepspeed_tpu_barrier")
+        with _tracked_host("barrier"):
+            multihost_utils.sync_global_devices("deepspeed_tpu_barrier")
 
 
 # ---------------------------------------------------------------------------
@@ -500,14 +513,17 @@ def host_broadcast(in_tree, src=0):
     if jax.process_count() == 1:
         return in_tree
     from jax.experimental import multihost_utils
-    return multihost_utils.broadcast_one_to_all(in_tree, is_source=jax.process_index() == src)
+    with _tracked_host("host_broadcast"):
+        return multihost_utils.broadcast_one_to_all(in_tree,
+                                                    is_source=jax.process_index() == src)
 
 
 def host_allgather(in_tree):
     if jax.process_count() == 1:
         return jax.tree_util.tree_map(lambda x: np.asarray(x)[None], in_tree)
     from jax.experimental import multihost_utils
-    return multihost_utils.process_allgather(in_tree)
+    with _tracked_host("host_allgather"):
+        return multihost_utils.process_allgather(in_tree)
 
 
 def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):

@@ -1,0 +1,169 @@
+"""Realized-time / overlap accounting for communication.
+
+PR 5's dispatch/realized/exposed machinery (``runtime/zero/param_offload.py``
+``LayerStreamExecutor``), generalized and applied to the comm layer as the
+ROADMAP's "sharded-training overlap" item asks: ``comm._record`` has counted
+BYTES per op since PR 1, but bytes say nothing about whether the transfer
+time hid behind compute. This tracker answers that for every
+host-observable communication flow:
+
+- **dispatch** — wall time spent *issuing* the transfer on the calling
+  thread (``jax.device_put`` returns long before the DMA lands on async
+  backends).
+- **realized** — dispatch -> completion, fenced via ``jax.block_until_ready``
+  on an observer pool and folded into a per-op **busy-interval union** (k
+  overlapping transfers count each wall second once — summing per-transfer
+  durations would bias overlap efficiency toward 1).
+- **exposed** — wall time the CALLING thread actually blocked on the
+  transfer (synchronous host collectives expose their full duration; an
+  async put that completes behind compute exposes none).
+
+``overlap_efficiency = 1 - exposed / realized`` over all tracked ops — the
+same definition the offload path reports, so ``offload/overlap_efficiency``
+and ``comm/overlap_efficiency`` read on one scale.
+
+What is (and is not) tracked: collectives traced INSIDE a compiled program
+(``all_reduce`` etc. under shard_map) have no host-observable per-op
+latency by design (see ``comm.py``) — they stay byte-counted only. The
+host-context flows are tracked for real: batch host->device placement
+(``runtime/engine.py::_shard_batch``), cross-process control-plane ops
+(``barrier``/``host_broadcast``/``host_allgather``), and anything else that
+calls :meth:`CommOverlapTracker.track_async`/:meth:`track_host`. The engine
+drains :meth:`collect` once per step into ``comm/{op}/realized_ms``,
+``comm/{op}/dispatch_ms`` and ``comm/overlap_efficiency`` gauges.
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from contextlib import contextmanager
+
+# one observer pool for completion fences (daemon: must never hold exit)
+_FENCE_POOL = ThreadPoolExecutor(max_workers=2,
+                                 thread_name_prefix="comm-fence")
+
+
+class CommOverlapTracker:
+    """Per-op dispatch/realized/exposed accounting with busy-interval
+    unions. Thread-safe; ``collect(reset=True)`` is the per-step drain."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fences = []
+        self._reset_locked()
+
+    def _reset_locked(self):
+        self._ops = {}   # op -> {"dispatch_s","exposed_s","calls"}
+        self._busy = {}  # op -> [accumulated_busy_s, last_span_end]
+
+    def _op(self, name):
+        ent = self._ops.get(name)
+        if ent is None:
+            ent = self._ops[name] = {"dispatch_s": 0.0, "exposed_s": 0.0,
+                                     "calls": 0}
+            self._busy[name] = [0.0, 0.0]
+        return ent
+
+    def _bump_busy(self, op, t0, t1):
+        """Fold span [t0, t1] into ``op``'s busy-interval union (spans
+        arrive roughly in completion order; a span ending before an already
+        counted end is fully inside the counted region)."""
+        with self._lock:
+            self._op(op)
+            acc, last = self._busy[op]
+            if t1 > last:
+                self._busy[op] = [acc + t1 - max(t0, last), t1]
+
+    # ------------------------------------------------------------------ producers
+    def track_async(self, op, value, t0=None):
+        """Account an already-ISSUED asynchronous transfer whose payload is
+        ``value`` (any pytree of jax/np arrays): the realized span runs from
+        ``t0`` (default: now — pass the pre-dispatch stamp for honest
+        dispatch accounting) to the completion fence, observed off-thread.
+        Exposes nothing — the caller did not block. Returns ``value``."""
+        now = time.perf_counter()
+        if t0 is None:
+            t0 = now
+        with self._lock:
+            ent = self._op(op)
+            ent["dispatch_s"] += now - t0
+            ent["calls"] += 1
+
+        def fence():
+            try:
+                import jax
+                jax.block_until_ready(value)
+            except Exception:  # noqa: BLE001 — a dead buffer ends the span, too
+                pass
+            self._bump_busy(op, t0, time.perf_counter())
+        fut = _FENCE_POOL.submit(fence)
+        with self._lock:
+            if len(self._fences) > 128:
+                self._fences = [f for f in self._fences if not f.done()]
+            self._fences.append(fut)
+        return value
+
+    @contextmanager
+    def track_host(self, op):
+        """Bracket a SYNCHRONOUS host-context communication (barrier,
+        host_broadcast, ...): its whole duration is dispatch, realized AND
+        exposed — the caller was blocked for all of it."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            t1 = time.perf_counter()
+            with self._lock:
+                ent = self._op(op)
+                ent["dispatch_s"] += t1 - t0
+                ent["exposed_s"] += t1 - t0
+                ent["calls"] += 1
+            self._bump_busy(op, t0, t1)
+
+    def add_exposed(self, op, dt):
+        """Fold explicitly-measured blocked time into ``op`` (e.g. a caller
+        that had to wait on a fence it issued earlier)."""
+        with self._lock:
+            self._op(op)["exposed_s"] += max(0.0, dt)
+
+    # ------------------------------------------------------------------ drain
+    def join(self):
+        """Block until every in-flight completion fence has landed (so a
+        step's collect sees its own transfers, not the next step's)."""
+        with self._lock:
+            fences, self._fences = self._fences, []
+        for f in fences:
+            f.result()
+
+    def collect(self, reset=True):
+        """Per-op accounting + the overall overlap efficiency. ``realized_s``
+        is each op's busy-interval union; efficiency is computed over the
+        sum of unions (ops are distinct flows)."""
+        self.join()
+        with self._lock:
+            ops = {}
+            realized_total = 0.0
+            exposed_total = 0.0
+            for op, ent in self._ops.items():
+                realized = self._busy[op][0]
+                ops[op] = {"dispatch_s": ent["dispatch_s"],
+                           "exposed_s": ent["exposed_s"],
+                           "realized_s": realized,
+                           "calls": ent["calls"]}
+                realized_total += realized
+                exposed_total += ent["exposed_s"]
+            if reset:
+                self._reset_locked()
+        efficiency = (max(0.0, min(1.0, 1.0 - exposed_total / realized_total))
+                      if realized_total > 0 else 0.0)
+        return {"ops": ops, "realized_s": realized_total,
+                "exposed_s": exposed_total,
+                "overlap_efficiency": efficiency}
+
+
+_tracker = CommOverlapTracker()
+
+
+def get_overlap_tracker():
+    """The process-global tracker (the engine drains it per step)."""
+    return _tracker

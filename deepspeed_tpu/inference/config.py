@@ -172,7 +172,8 @@ class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
         "layer: qkv->attention->o->mlp) when the int8 serving config allows it")
     telemetry = ConfigField(
         default=dict, help="unified telemetry sink section (same keys as the training "
-        "config's 'telemetry': enabled/output_path/flush_interval/trace_format); an "
+        "config's 'telemetry': enabled/output_path/flush_interval/trace_format/"
+        "hist_window_s/hist_max_samples/request_tracing/flight_recorder/slo); an "
         "already-installed global sink (e.g. the training engine's) takes precedence")
     continuous_batching = ConfigField(
         default=ContinuousBatchingConfig, aliases=("serving", ),

@@ -134,11 +134,11 @@ class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id", "do_sample",
                  "temperature", "top_k", "top_p", "seed", "slot", "out", "logits",
                  "done", "cancelled", "submit_ts", "first_token_ts", "collect_logits",
-                 "on_token")
+                 "on_token", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, seed, collect_logits, submit_ts,
-                 on_token=None):
+                 on_token=None, trace=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -159,6 +159,7 @@ class _Request:
         self.submit_ts = submit_ts
         self.first_token_ts = None
         self.on_token = on_token
+        self.trace = trace  # optional telemetry.tracing.RequestTrace
 
 
 class SchedulerHandle:
@@ -302,6 +303,13 @@ class DecodeScheduler:
         self._compiled = {}
         self._rid = 0
         self._steps = 0
+        # request tracing: per-sync "sched/step" spans (on the pump thread's
+        # track) collect flow ids minted by the request phases they executed
+        # — the connective tissue between one request's span tree and the
+        # shared iteration timeline. Active only while the sink is enabled
+        # AND request tracing is on.
+        self._iter = 0
+        self._iter_links = None  # list while a traced sync is in flight
         self.telemetry = engine.telemetry
         if self.telemetry.enabled:
             # the KV tier's HBM price tag: int8 should show ~half the bytes
@@ -313,9 +321,15 @@ class DecodeScheduler:
     # ------------------------------------------------------------------ API
     def submit(self, prompt, max_new_tokens=64, eos_token_id=None, do_sample=False,
                temperature=1.0, top_k=0, top_p=1.0, seed=0, collect_logits=None,
-               on_token=None):
+               on_token=None, trace=None):
         """Enqueue one request; returns a :class:`SchedulerHandle`. The
         request joins the decode batch as soon as a slot frees up.
+
+        ``trace`` is an OPTIONAL
+        :class:`~deepspeed_tpu.telemetry.tracing.RequestTrace`: the
+        scheduler records this request's phase tree on it (prefix-cache
+        probe, prefill chunks, decode, complete/cancel), flow-linked to the
+        shared per-iteration ``sched/step`` spans.
 
         ``on_token(token, done)`` is an OPTIONAL host-side streaming hook,
         called once per generated token from inside the scheduler loop (the
@@ -332,8 +346,10 @@ class DecodeScheduler:
         req = _Request(self._rid, prompt, max_new_tokens, eos_token_id, do_sample,
                        temperature, top_k, top_p, seed,
                        self.collect_logits if collect_logits is None else collect_logits,
-                       tel.now(), on_token=on_token)
+                       tel.now(), on_token=on_token, trace=trace)
         self._rid += 1
+        if trace is not None:
+            trace.attrs.setdefault("sched_rid", req.rid)
         # validate the PROMPT alone up front (before any early return): a
         # prompt that can never fit a slot must fail here with a clear
         # message, not deep inside a compiled prefill
@@ -379,6 +395,8 @@ class DecodeScheduler:
         else ``steps_per_sync`` decode steps."""
         tel = self.telemetry
         t0 = tel.now()
+        tracing = tel.enabled and getattr(tel, "trace_requests", False)
+        self._iter_links = [] if tracing else None
         self._reap_cancelled()
         admitted = 0
         if self.prefill_chunk > 0:
@@ -401,14 +419,19 @@ class DecodeScheduler:
             tel.counter("serving/admitted", admitted)
         fused = self._prefill is not None
         if fused:
+            kind = "fused"
             delivered, ksteps = self._fused_chunk_step()
         elif self.active:
             if self.drafter is not None:
+                kind = "spec"
                 delivered, ksteps = self._spec_decode_step()
             else:
+                kind = "decode"
                 delivered, ksteps = self._decode_step()
         else:
+            self._iter_links = None
             return 0
+        self._iter += 1
         if tel.enabled:
             dur_ms = (tel.now() - t0) * 1e3
             tel.counter("serving/decode_steps", ksteps)
@@ -421,7 +444,26 @@ class DecodeScheduler:
                         ("serving/kv_token_utilization", self.cache.token_utilization(),
                          None),
                         ("serving/kv_bytes_live", self.cache.live_bytes(), None)])
+        if tracing:
+            # the shared per-iteration span (pump-thread track): request
+            # phases that landed this sync flow-link to it via _iter_links
+            tel.record_span("sched/step", t0, tel.now() - t0,
+                            attrs={"iter": self._iter, "kind": kind,
+                                   "live": len(self.active),
+                                   "delivered": delivered},
+                            flow_out=self._iter_links or None)
+        self._iter_links = None
         return delivered
+
+    def _trace_link(self, trace):
+        """Mint a flow id binding a request phase to the sync currently in
+        flight (registered on this iteration's ``sched/step`` span); None
+        when tracing is off or no traced sync is active."""
+        if trace is None or self._iter_links is None or not trace.enabled:
+            return None
+        fid = trace.link()
+        self._iter_links.append(fid)
+        return fid
 
     def _release_slot(self, slot):
         """Return a finished/cancelled request's slot: retained (state
@@ -449,6 +491,9 @@ class DecodeScheduler:
                 self._release_slot(slot)
                 if tel.enabled:
                     tel.counter("serving/cancelled")
+                if req.trace is not None:
+                    req.trace.instant("cancelled", where="decode",
+                                      tokens=len(req.out))
         if self._prefill is not None and self._prefill.req.cancelled:
             req = self._prefill.req
             req.done = True
@@ -457,6 +502,8 @@ class DecodeScheduler:
             self._prefill = None
             if tel.enabled:
                 tel.counter("serving/cancelled")
+            if req.trace is not None:
+                req.trace.instant("cancelled", where="prefill")
 
     # ------------------------------------------------------------------ admit
     def _acquire_slot(self, req):
@@ -493,6 +540,10 @@ class DecodeScheduler:
         tel = self.telemetry
         req.slot = slot
         pos = 0
+        tr = req.trace
+        if tr is not None and tr.enabled:
+            tr.mark("prefill")  # phase closes at _finish_prefill
+            probe_t0 = tel.now()
         if self.radix is not None:
             m, donor = match
             m = min(m, req.prompt.size - 1)
@@ -521,6 +572,9 @@ class DecodeScheduler:
                     tel.counter("serving/prefix_cache_miss")
             if tel.enabled:
                 tel.gauge("serving/prefix_cache_hit_rate", self.radix.hit_rate())
+            if tr is not None and tr.enabled:
+                tr.phase("prefix_probe", start=probe_t0, slot=slot,
+                         cached_tokens=pos, prompt=int(req.prompt.size))
         self.cache.lengths[slot] = pos
         self._prefill = _PrefillState(req, pos)
 
@@ -537,6 +591,11 @@ class DecodeScheduler:
         if tel.enabled:
             tel.histogram("serving/ttft_ms", (req.first_token_ts - req.submit_ts) * 1e3)
             tel.gauge("serving/queue_depth", len(self.queue))
+        tr = req.trace
+        if tr is not None and tr.enabled:
+            tr.phase("prefill", prompt=int(req.prompt.size),
+                     ttft_ms=round((req.first_token_ts - req.submit_ts) * 1e3, 3))
+            tr.mark("decode")  # phase closes when the request finishes
         if req.collect_logits and last_logits is not None:
             req.logits.append(last_logits)
         self._deliver(req, tok)
@@ -582,6 +641,12 @@ class DecodeScheduler:
             tel.histogram("serving/prefill_stall_ms", (req.first_token_ts - t_pf) * 1e3)
             tel.histogram("serving/ttft_ms", (req.first_token_ts - req.submit_ts) * 1e3)
             tel.gauge("serving/queue_depth", len(self.queue))
+        tr = req.trace
+        if tr is not None and tr.enabled:
+            tr.phase("prefill", start=t_pf, prompt=int(req.prompt.size),
+                     monolithic=True,
+                     ttft_ms=round((req.first_token_ts - req.submit_ts) * 1e3, 3))
+            tr.mark("decode")
         self._deliver(req, tok)
 
     def _deliver(self, req, tok):
@@ -600,6 +665,20 @@ class DecodeScheduler:
             self._release_slot(req.slot)
             if self.telemetry.enabled:
                 self.telemetry.counter("serving/evicted")
+            tr = req.trace
+            if tr is not None and tr.enabled:
+                now = self.telemetry.now()
+                eos = req.eos_token_id is not None and tok == req.eos_token_id
+                n = len(req.out)
+                ttft = ((req.first_token_ts - req.submit_ts) * 1e3
+                        if req.first_token_ts is not None else 0.0)
+                itl = ((now - req.first_token_ts) * 1e3 / (n - 1)
+                       if req.first_token_ts is not None and n > 1 else 0.0)
+                fid = self._trace_link(tr)
+                tr.phase("decode", flow_in=[fid] if fid else None, tokens=n)
+                tr.instant("complete", reason="stop" if eos else "length",
+                           tokens=n, ttft_ms=round(ttft, 3),
+                           itl_ms=round(itl, 4))
         if req.on_token is not None:
             # after the done/eviction decision so the hook sees the final
             # state; a hook exception must not wedge the shared loop (the
@@ -864,6 +943,12 @@ class DecodeScheduler:
             # through the block fetch — jit dispatch alone returns before
             # the compute finishes on async backends
             tel.histogram("serving/prefill_stall_ms", (tel.now() - t0) * 1e3)
+        tr = preq.trace
+        if tr is not None and tr.enabled:
+            fid = self._trace_link(tr)
+            tr.phase("prefill_chunk", start=t0,
+                     flow_in=[fid] if fid else None,
+                     pos=int(pf.pos), take=int(take), final=bool(final))
         # live rows: column 0 + each substep appended one KV row
         delivered = self._deliver_block(live, toks_k, logits_k, K)
         pf.pos += take

@@ -97,6 +97,25 @@ class TelemetryConfig(DeepSpeedConfigModel):
     flush_interval = ConfigField(default=100)
     # "chrome" writes trace.json in Chrome-trace format; "none" disables it
     trace_format = ConfigField(default="chrome")
+    # histogram sliding window: percentiles always describe roughly the
+    # last hist_window_s seconds from a bounded chunked reservoir of
+    # hist_max_samples values (long-running serving never freezes on
+    # startup-era samples)
+    hist_window_s = ConfigField(default=300.0)
+    hist_max_samples = ConfigField(default=4096)
+    # per-request tracing (gateway/scheduler span trees + flow links);
+    # rides the enabled sink — flip off to keep only aggregate telemetry
+    request_tracing = ConfigField(default=True)
+    # anomaly flight recorder (telemetry/flight_recorder.py): always-on
+    # ring of recent full-resolution events, dumped around anomalies.
+    # Keys: enabled (true) / capacity (8192) / post_window_s (0.25) /
+    # min_interval_s (1.0)
+    flight_recorder = ConfigField(default=dict)
+    # SLO engine (telemetry/slo.py): objectives + multi-window burn-rate
+    # alerting. Keys: objectives (list of specs) / fast_window_s /
+    # slow_window_s / burn_threshold / eval_interval_s; the serving
+    # gateway falls back to its default objective slate when none given
+    slo = ConfigField(default=dict)
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

@@ -299,6 +299,13 @@ class DeepSpeedEngine:
         self._step_flops = None  # XLA cost-analysis FLOPs of one optimizer step
         self._last_step_dur = None  # seconds, measured around the last step
         self._grad_sync_bytes_cached = None
+        # SLO engine (telemetry.slo section): evaluated at the reporting
+        # interval so MFU/overlap-efficiency floors can burn-rate alert on
+        # the training side too (the serving gateway builds its own)
+        self._slo = None
+        if self.telemetry.enabled and self.telemetry.slo_config.get("objectives"):
+            from ..telemetry import SLOEngine
+            self._slo = SLOEngine(self.telemetry, self.telemetry.slo_config)
         self._fwd_since_step = 0  # facade micro-steps since the last step()
         self._facade_t0 = None
 
@@ -1056,10 +1063,12 @@ class DeepSpeedEngine:
         dp = [a for a in (dist.EXPERT_AXIS, dist.DATA_AXIS) if self.mesh.shape[a] > 1]
         seq_on = self.mesh.shape[dist.SEQ_AXIS] > 1
         batch_dim = 1 if leading_scan_dim else 0
-        if self.telemetry.enabled:
+        track = self.telemetry.enabled
+        if track:
             self.telemetry.counter(
                 "comm/host_to_device/bytes",
                 int(sum(np.asarray(x).nbytes for x in jax.tree_util.tree_leaves(batch))))
+            t_place = time.perf_counter()
 
         def place(x):
             x = np.asarray(x)
@@ -1082,7 +1091,15 @@ class DeepSpeedEngine:
                 return jax.make_array_from_process_local_data(sharding, x)
             return jax.device_put(x, sharding)
 
-        return jax.tree_util.tree_map(place, batch)
+        placed = jax.tree_util.tree_map(place, batch)
+        if track:
+            # dispatch/realized split for the batch placement: device_put is
+            # asynchronous, so the realized span (fence on the observer pool,
+            # busy-interval union — comm/overlap.py) separates DMA completion
+            # from the dispatch cost the hot loop actually paid
+            dist.get_overlap_tracker().track_async("host_to_device", placed,
+                                                   t0=t_place)
+        return placed
 
     def _next_microbatches(self, data_iter, n):
         batches = []
@@ -1142,6 +1159,7 @@ class DeepSpeedEngine:
                     ("offload/overlap_efficiency", pt.get("overlap_efficiency", 0.0),
                      self.global_samples),
                 ])
+                self._emit_comm_overlap()
             self._report(metrics)
             if self.lr_scheduler is not None:
                 self.lr_scheduler.last_batch_iteration = self.global_steps
@@ -1467,6 +1485,30 @@ class DeepSpeedEngine:
         if self._grad_sync_bytes_cached:
             tel.counter("comm/grad_sync/bytes", self._grad_sync_bytes_cached,
                         attrs={"estimate": "ring_all_reduce", "dp": self.dp_world_size()})
+        self._emit_comm_overlap()
+
+    def _emit_comm_overlap(self):
+        """Drain this step's comm realized/overlap accounting
+        (``comm/overlap.py`` — host->device batch placement, control-plane
+        collectives) into gauges: ``comm/{op}/realized_ms``,
+        ``comm/{op}/dispatch_ms``, ``comm/overlap_efficiency``. Same
+        realized-vs-exposed definition as ``offload/overlap_efficiency``
+        (PR 5), so the two read on one scale."""
+        tel = self.telemetry
+        if not tel.enabled:
+            return
+        stats = dist.get_overlap_tracker().collect(reset=True)
+        if not stats["ops"]:
+            return
+        gauges = []
+        for op, s in sorted(stats["ops"].items()):
+            gauges.append((f"comm/{op}/realized_ms", s["realized_s"] * 1e3,
+                           self.global_samples))
+            gauges.append((f"comm/{op}/dispatch_ms", s["dispatch_s"] * 1e3,
+                           self.global_samples))
+        gauges.append(("comm/overlap_efficiency", stats["overlap_efficiency"],
+                       self.global_samples))
+        tel.gauges(gauges)
 
     def _interval_gauges(self):
         """MFU + device/host memory watermark gauges for one logging
@@ -1519,6 +1561,8 @@ class DeepSpeedEngine:
                 scalars.append(("Train/Samples/grad_norm", norm, self.global_samples))
                 scalars.extend(self._interval_gauges())
             tel.gauges(scalars)
+            if self._slo is not None:
+                self._slo.maybe_evaluate()
 
     # ------------------------------------------------------------------ data
     def deepspeed_io(self, dataset, batch_size=None, route=None, data_sampler=None, collate_fn=None, num_local_io_workers=None):

@@ -69,6 +69,12 @@ def main(argv=None):
     gateway = Gateway(engine)
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: gateway.begin_drain())
+    if hasattr(signal, "SIGUSR1"):
+        # operator-forced flight-recorder dump (kill -USR1 <pid>): the
+        # handler only flags the request — the pump thread performs the
+        # dump (taking sink locks in signal context can self-deadlock)
+        signal.signal(signal.SIGUSR1,
+                      lambda *_: gateway.request_flight_dump("sigusr1"))
     return gateway.run()
 
 

@@ -24,6 +24,7 @@ maps to 429 + Retry-After (shed at the door, never an unbounded queue).
 
 import collections
 import threading
+import time
 
 
 class QueueFull(Exception):
@@ -37,7 +38,7 @@ class _Flow:
         self.key = key
         self.weight = weight
         self.deficit = 0.0
-        self.queue = collections.deque()  # (cost, item)
+        self.queue = collections.deque()  # (cost, item, enq_monotonic_ts)
 
 
 class FairQueue:
@@ -78,7 +79,7 @@ class FairQueue:
             if flow is None:
                 flow = self._flows[key] = _Flow(key, self._weight(tenant, priority))
                 self._rotation.append(flow)
-            flow.queue.append((cost, item))
+            flow.queue.append((cost, item, time.monotonic()))
             self._depth += 1
 
     def pop(self):
@@ -114,7 +115,7 @@ class FairQueue:
                     self._rotation.rotate(-1)
                     self._fresh_turn = True
                     continue
-                cost, item = flow.queue.popleft()
+                cost, item, _enq = flow.queue.popleft()
                 flow.deficit -= cost
                 self._depth -= 1
                 if not flow.queue:
@@ -130,3 +131,13 @@ class FairQueue:
         """{(tenant, priority): queued count} — introspection/metrics."""
         with self._lock:
             return {flow.key: len(flow.queue) for flow in self._flows.values()}
+
+    def oldest_wait_s(self):
+        """Age (seconds) of the longest-queued request across every flow —
+        the head-of-line-wait signal the SLO/metrics surface reads; 0.0
+        when empty."""
+        now = time.monotonic()
+        with self._lock:
+            oldest = min((flow.queue[0][2] for flow in self._flows.values()
+                          if flow.queue), default=None)
+        return round(now - oldest, 6) if oldest is not None else 0.0

@@ -10,10 +10,29 @@ no aiohttp/fastapi in the image, and none needed):
   speaks token ids, not text): ``POST /v1/completions`` with ``"stream":
   true`` SSE token streaming (``data: {chunk}\\n\\n`` ... ``data: [DONE]``),
   ``GET /healthz`` (process liveness), ``GET /readyz`` (serving readiness —
-  flips 503 during drain), ``GET /v1/metrics`` (gateway stats + the PR-1
-  telemetry sink's :meth:`snapshot`). Prompts are token-id lists (or
-  whitespace-separated decimal ids in a string); completions carry both
-  ``token_ids`` and a space-joined decimal ``text``.
+  flips 503 during drain), ``GET /v1/metrics`` (JSON gateway stats + the
+  telemetry sink's :meth:`snapshot`; Prometheus text exposition under
+  ``Accept: text/plain``/``openmetrics`` or ``?format=prometheus``, so
+  standard scrapers work), ``GET /v1/slo`` (the SLO engine's objective/
+  burn-rate state), ``GET /v1/debug/flight`` (force a flight-recorder
+  dump). Prompts are token-id lists (or whitespace-separated decimal ids in
+  a string); completions carry both ``token_ids`` and a space-joined
+  decimal ``text``.
+
+- **Request tracing**: an inbound W3C ``traceparent`` or ``x-request-id``
+  header names the request (minted otherwise, echoed back as
+  ``x-request-id``); with telemetry + request tracing on, every request
+  records a span tree (queued -> admitted -> prefix probe -> prefill
+  chunks -> decode -> complete/cancel/expire) on its own Perfetto track,
+  flow-linked to the scheduler's shared per-iteration spans
+  (``telemetry/tracing.py``).
+
+- **SLOs + flight recorder**: the ``telemetry.slo`` config section (or the
+  default serving slate — TTFT/queue-wait/ITL p95, shed+expiry rate) is
+  evaluated from the pump loop with multi-window burn rates; a burn-rate
+  trip, a backend step failure, or an unexpected post-warmup XLA recompile
+  dumps the telemetry flight recorder's ring of surrounding iterations to
+  a timestamped file.
 
 - **Admission control**: a bounded per-tenant fair queue
   (:class:`~deepspeed_tpu.serving.fair_queue.FairQueue`). Past
@@ -60,6 +79,9 @@ import time
 import numpy as np
 
 from ..inference.config import GatewayConfig
+from ..telemetry import (DEFAULT_SERVING_OBJECTIVES, RequestTrace, SLOEngine,
+                         extract_trace_context)
+from ..telemetry import prometheus as prom
 from ..utils.logging import logger
 from .fair_queue import FairQueue, QueueFull
 
@@ -78,11 +100,11 @@ class _GatewayRequest:
                  "temperature", "top_k", "top_p", "seed", "tenant", "priority",
                  "cost", "deadline", "stream", "loop", "events", "handle",
                  "cancel_requested", "cancel_reason", "finished", "enq_ts",
-                 "admit_ts", "n_tokens")
+                 "admit_ts", "n_tokens", "trace", "trace_id")
 
     def __init__(self, rid, prompt, *, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, seed, tenant, priority, deadline,
-                 stream, loop):
+                 stream, loop, trace=None, trace_id=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -106,6 +128,8 @@ class _GatewayRequest:
         self.enq_ts = time.monotonic()
         self.admit_ts = None
         self.n_tokens = 0
+        self.trace = trace          # RequestTrace (None when tracing is off)
+        self.trace_id = trace_id    # request identity echoed as x-request-id
 
 
 class Gateway:
@@ -162,6 +186,29 @@ class Gateway:
         self._loop_thread = None
         self._done_evt = threading.Event()   # fully drained + server closed
         self._force_stop = False
+        # SLO engine over the shared sink: the telemetry config's 'slo'
+        # section (or the default serving objective slate) evaluated from
+        # the pump loop; burn-rate trips dump the flight recorder
+        self.slo = None
+        if self.telemetry.enabled:
+            self.slo = SLOEngine(self.telemetry,
+                                 getattr(self.telemetry, "slo_config", None),
+                                 defaults=DEFAULT_SERVING_OBJECTIVES)
+            if not self.slo.enabled:
+                self.slo = None
+            else:
+                self.slo.on_alert.append(
+                    lambda state: self.telemetry.dump_flight(
+                        f"slo_burn_{state['name']}", state))
+        # unexpected-recompile watch: once the gateway has completed a
+        # request the scheduler's program set is considered warm; later
+        # growth is an anomaly worth a flight dump
+        self._compile_baseline = None
+        # operator flight-dump request (SIGUSR1): the signal handler only
+        # stores the reason — dump_flight takes sink locks and a handler
+        # interrupting a flush on the same thread would self-deadlock on
+        # the non-reentrant io lock; the pump thread performs the dump
+        self._flight_request = None
 
     # ------------------------------------------------------------------ lifecycle
     def start_background(self, timeout=120.0):
@@ -222,6 +269,15 @@ class Gateway:
             self._force_stop = True
             self._wake.set()
 
+    def request_flight_dump(self, reason):
+        """Async-signal-safe flight-dump request (a plain attribute store):
+        the pump thread performs the actual dump on its next turn. This is
+        what the ``SIGUSR1`` handler calls — a handler that invoked
+        ``dump_flight`` directly could interrupt a flush on its own thread
+        and deadlock on the sink's io lock."""
+        self._flight_request = str(reason)
+        self._wake.set()
+
     def wait_drained(self, timeout=None):
         """Block until drain completes (all admitted requests finished, the
         server closed). Returns False on timeout."""
@@ -274,8 +330,15 @@ class Gateway:
                     sched.step()
                 except Exception:  # noqa: BLE001 — fail requests, not the server
                     logger.exception("gateway: scheduler step failed")
+                    self.telemetry.dump_flight("backend_error")
                     self._fail_in_flight("scheduler step failed")
+                self._watch_recompiles()
             self._settle_done()
+            if self.slo is not None:
+                self.slo.maybe_evaluate()
+            if self._flight_request is not None:
+                reason, self._flight_request = self._flight_request, None
+                self.telemetry.dump_flight(reason)
             if not (sched.active or sched.queue or sched._prefill is not None):
                 if self.draining and not len(self._fair) and not self._active:
                     break
@@ -284,6 +347,26 @@ class Gateway:
         # force-stop: anything still in flight is failed, not silently dropped
         if self._force_stop:
             self._fail_in_flight("gateway shutdown")
+
+    def _watch_recompiles(self):
+        """Flight-dump on unexpected XLA recompiles: after the first
+        completed request the scheduler's compiled-program set is warm for
+        the serving mix — later growth (a stray shape, a new sampling
+        variant slipping past the O(1)-programs design) is exactly the
+        anomaly the recorder exists for."""
+        count = self.scheduler.compiled_program_count()
+        if self._compile_baseline is None:
+            if self.stats["completed"] >= 1:
+                self._compile_baseline = count
+        elif count > self._compile_baseline:
+            tel = self.telemetry
+            if tel.enabled:
+                tel.counter("gateway/unexpected_recompiles",
+                            count - self._compile_baseline)
+                tel.dump_flight("xla_recompile",
+                                {"programs": count,
+                                 "baseline": self._compile_baseline})
+            self._compile_baseline = count
 
     def _admit(self):
         """Move requests from the DRR queue into scheduler slots while
@@ -302,6 +385,8 @@ class Gateway:
             if tel.enabled:
                 tel.gauge("gateway/queue_depth", len(self._fair))
             if greq.cancel_requested:
+                if greq.trace is not None:
+                    greq.trace.instant("cancelled", where="queue")
                 self._post(greq, ("cancelled", greq.cancel_reason or "cancelled"))
                 continue
             now = time.monotonic()
@@ -309,6 +394,9 @@ class Gateway:
                 self.stats["deadline_expired"] += 1
                 if tel.enabled:
                     tel.counter("gateway/deadline_expired")
+                if greq.trace is not None:
+                    greq.trace.phase("queued", status="expired")
+                    greq.trace.instant("expired", where="queue")
                 self._post(greq, ("failed", 504, "deadline expired in queue"))
                 continue
             try:
@@ -317,13 +405,19 @@ class Gateway:
                     eos_token_id=greq.eos_token_id, do_sample=greq.do_sample,
                     temperature=greq.temperature, top_k=greq.top_k,
                     top_p=greq.top_p, seed=greq.seed,
-                    on_token=self._make_on_token(greq))
+                    on_token=self._make_on_token(greq), trace=greq.trace)
             except ValueError as e:
                 self.stats["rejected"] += 1
+                if greq.trace is not None:
+                    greq.trace.instant("rejected", error=str(e))
                 self._post(greq, ("failed", 400, str(e)))
                 continue
             greq.handle = handle
             greq.admit_ts = now
+            if greq.trace is not None:
+                greq.trace.phase("queued",
+                                 wait_ms=round((now - greq.enq_ts) * 1e3, 3))
+                greq.trace.instant("admitted")
             if tel.enabled:
                 tel.histogram("gateway/queue_wait_ms", (now - greq.enq_ts) * 1e3)
             if handle.done:  # zero-budget edge: finished with no tokens
@@ -340,9 +434,12 @@ class Gateway:
             if done:
                 reason = ("stop" if (greq.eos_token_id is not None
                                      and tok == greq.eos_token_id) else "length")
-            self._post(greq, ("token", int(tok), reason))
-            if done:
+                # account BEFORE posting the final token: the HTTP side
+                # responds the moment the event lands, and a client that
+                # reads the response then polls /v1/metrics must see its
+                # own completion counted (the reverse order raced)
                 self._finish(greq, None)
+            self._post(greq, ("token", int(tok), reason))
         return on_token
 
     def _finish(self, greq, event):
@@ -498,7 +595,7 @@ class Gateway:
                 pass
 
     async def _route(self, method, path, headers, body, reader, writer):
-        path = path.split("?", 1)[0]
+        path, _, query = path.partition("?")
         if method == "GET" and path == "/healthz":
             await self._json(writer, 200, {"status": "alive"})
         elif method == "GET" and path == "/readyz":
@@ -510,11 +607,59 @@ class Gateway:
                                   else "starting"},
                                  extra=[("Retry-After", str(self._retry_after()))])
         elif method == "GET" and path == "/v1/metrics":
-            await self._json(writer, 200, self._metrics())
+            # content negotiation: a Prometheus scraper's Accept leads with
+            # text/plain (or openmetrics); everyone else (curl */*,
+            # explicit JSON) keeps the structured JSON payload
+            accept = headers.get("accept", "")
+            want_prom = ("format=prometheus" in query
+                         or (("text/plain" in accept or "openmetrics" in accept)
+                             and _JSON not in accept))
+            if want_prom:
+                text = prom.render(self.telemetry.snapshot(),
+                                   extra_gauges=self._prom_extra()).encode()
+                writer.write(self._head(
+                    200, "text/plain; version=0.0.4; charset=utf-8",
+                    length=len(text)) + text)
+                await writer.drain()
+            else:
+                await self._json(writer, 200, self._metrics())
+        elif method == "GET" and path == "/v1/slo":
+            state = (self.slo.state() if self.slo is not None
+                     else {"enabled": False,
+                           "reason": "telemetry disabled or no objectives"})
+            await self._json(writer, 200, state)
+        elif method == "GET" and path == "/v1/debug/flight":
+            dump = self.telemetry.dump_flight("debug_endpoint")
+            if dump is None:
+                await self._json(writer, 503,
+                                 {"error": {"message": "flight recorder off, "
+                                            "or rate-limited"}})
+            else:
+                await self._json(writer, 200,
+                                 {"path": dump,
+                                  "note": "file lands after the recorder's "
+                                          "post-window elapses"})
         elif method == "POST" and path == "/v1/completions":
             await self._completions(headers, body, reader, writer)
         else:
             await self._json(writer, 404, {"error": {"message": f"no route {method} {path}"}})
+
+    def _prom_extra(self):
+        """Gateway/scheduler state the sink doesn't own, exposed as plain
+        gauges on the Prometheus surface so a scraper sees one coherent
+        endpoint."""
+        sched = self.scheduler
+        return {
+            "gateway/ready": 1.0 if (self.ready and not self.draining) else 0.0,
+            "gateway/queue_depth": float(len(self._fair)),
+            "gateway/active_requests": float(len(self._active)),
+            "gateway/oldest_queue_wait_s": self._fair.oldest_wait_s(),
+            "gateway/retry_after_s": float(self._retry_after()),
+            "scheduler/num_slots": float(sched.num_slots),
+            "scheduler/active_slots": float(sched.cache.active_slots),
+            "scheduler/slot_occupancy": float(sched.cache.occupancy()),
+            "scheduler/compiled_programs": float(sched.compiled_program_count()),
+        }
 
     def _metrics(self):
         sched = self.scheduler
@@ -527,7 +672,9 @@ class Gateway:
                         "queue_depth_per_flow": {"/".join(k): v
                                                  for k, v in self._fair.depths().items()},
                         "ema_service_s": self._ema_service_s,
+                        "oldest_queue_wait_s": self._fair.oldest_wait_s(),
                         "retry_after_s": self._retry_after()},
+            "slo": self.slo.state() if self.slo is not None else None,
             "scheduler": {"num_slots": sched.num_slots,
                           "active_slots": sched.cache.active_slots,
                           "queue_depth": len(sched.queue),
@@ -625,8 +772,25 @@ class Gateway:
             await self._json(writer, 400,
                              {"error": {"message": str(e), "type": "invalid_request"}})
             return
+        # request identity: accept an inbound W3C traceparent / x-request-id,
+        # else mint one; echoed back as x-request-id and used as the span
+        # tree's track id when request tracing is on
+        trace_id, parent, _ = extract_trace_context(headers)
+        trace = None
+        if tel.enabled and getattr(tel, "trace_requests", False):
+            trace = RequestTrace(tel, trace_id, parent,
+                                 tenant=kwargs["tenant"],
+                                 priority=kwargs["priority"])
+            trace.mark("queued")
         greq = _GatewayRequest(self._next_rid(), loop=asyncio.get_running_loop(),
-                               **kwargs)
+                               trace=trace, trace_id=trace_id, **kwargs)
+        if trace is not None:
+            trace.rid = greq.rid
+            # per-request track: a client may reuse an x-request-id across
+            # concurrent retries, and two requests must never share one
+            # async track (interleaved trees, colliding flow ids). The bare
+            # id is still what x-request-id echoes.
+            trace.track = f"{trace_id}:{greq.rid}"
         try:
             self._fair.push(greq, greq.tenant, greq.priority, cost=greq.cost)
         except QueueFull:
@@ -637,7 +801,8 @@ class Gateway:
                              {"error": {"message": "server overloaded: request "
                                         "queue is full, retry later",
                                         "type": "overloaded"}},
-                             extra=[("Retry-After", str(self._retry_after()))])
+                             extra=[("Retry-After", str(self._retry_after())),
+                                    ("x-request-id", greq.trace_id)])
             return
         if tel.enabled:
             tel.gauge("gateway/queue_depth", len(self._fair))
@@ -723,7 +888,8 @@ class Gateway:
                 if not headers_sent:
                     headers_sent = True
                     writer.write(self._head(200, "text/event-stream",
-                                            [("Cache-Control", "no-cache")]))
+                                            [("Cache-Control", "no-cache"),
+                                             ("x-request-id", greq.trace_id)]))
                     if tel.enabled:
                         tel.histogram("gateway/ttfb_ms",
                                       (time.monotonic() - greq.enq_ts) * 1e3)
@@ -778,7 +944,8 @@ class Gateway:
                     break
             if finish_reason == "deadline" and not toks:
                 await self._json(writer, 504,
-                                 {"error": {"message": "deadline expired"}})
+                                 {"error": {"message": "deadline expired"}},
+                                 extra=[("x-request-id", greq.trace_id)])
                 return
             if self.telemetry.enabled:
                 self.telemetry.histogram("gateway/ttfb_ms",
@@ -793,7 +960,7 @@ class Gateway:
                 "usage": {"prompt_tokens": int(len(greq.prompt)),
                           "completion_tokens": len(toks),
                           "total_tokens": int(len(greq.prompt)) + len(toks)},
-            })
+            }, extra=[("x-request-id", greq.trace_id)])
         except ConnectionError:
             self._client_gone(greq)
         finally:

@@ -1,0 +1,115 @@
+"""Prometheus text exposition for the telemetry snapshot.
+
+Renders :meth:`TelemetrySink.snapshot` (plus any extra scalar gauges the
+gateway wants to expose) in the Prometheus text format (version 0.0.4), so
+a standard scraper pointed at ``GET /v1/metrics`` with the usual
+``Accept: text/plain`` header works with zero glue. Mapping:
+
+- counters -> ``# TYPE ... counter`` with a ``_total`` suffix;
+  ``gateway/tenant/<t>/tokens`` and ``comm/<op>/<group>/bytes`` become
+  labeled series instead of a per-tenant/per-group metric-name explosion.
+- gauges   -> ``# TYPE ... gauge``.
+- histograms -> ``# TYPE ... summary`` (the sink keeps windowed quantiles,
+  not cumulative buckets): ``{quantile="0.5|0.95|0.99"}`` + ``_sum`` +
+  ``_count``.
+
+Everything is prefixed ``dstpu_`` and sanitized to the metric-name charset.
+Stdlib-only by design (same budget as the gateway).
+"""
+
+import re
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_TENANT_RE = re.compile(r"^gateway/tenant/(?P<tenant>.+)/tokens$")
+_COMM_RE = re.compile(r"^comm/(?P<op>[^/]+)/(?P<group>[^/]+)/bytes$")
+
+_PREFIX = "dstpu_"
+
+
+def _name(raw):
+    return _PREFIX + _NAME_RE.sub("_", raw.strip("/"))
+
+
+def _labels(pairs):
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+def _escape(value):
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(value):
+    value = float(value)
+    # the text format has non-finite literals; int(nan/inf) would raise —
+    # and a NaN loss gauge must not fail the whole scrape mid-incident
+    if value != value:
+        return "NaN"
+    if value in (float("inf"), float("-inf")):
+        return "+Inf" if value > 0 else "-Inf"
+    return repr(int(value)) if value == int(value) else repr(value)
+
+
+def _counter_series(raw_name):
+    """(metric_name, label_pairs) for one counter, folding the two
+    client/topology-cardinality families into labels."""
+    m = _TENANT_RE.match(raw_name)
+    if m:
+        return _PREFIX + "gateway_tenant_tokens_total", [("tenant", m.group("tenant"))]
+    m = _COMM_RE.match(raw_name)
+    if m:
+        return _PREFIX + "comm_bytes_total", [("op", m.group("op")),
+                                              ("group", m.group("group"))]
+    return _name(raw_name) + "_total", []
+
+
+def render(snapshot, extra_gauges=None):
+    """Prometheus text body from a sink snapshot dict. ``extra_gauges``:
+    ``{raw_name: scalar}`` appended as plain gauges (the gateway passes its
+    queue/occupancy stats so scrapers see one coherent surface)."""
+    lines = []
+    typed = set()
+
+    def header(name, kind):
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    # group counter samples by RESOLVED metric name first: the text format
+    # requires all samples of one metric to form a single contiguous group,
+    # and sorting by raw name would interleave the labeled families
+    # (comm/<op>/<group>/bytes) with unlabeled comm/* counters
+    counter_groups = {}
+    for raw, c in sorted(snapshot.get("counters", {}).items()):
+        name, labels = _counter_series(raw)
+        counter_groups.setdefault(name, []).append((labels, c["total"]))
+    for name in sorted(counter_groups):
+        header(name, "counter")
+        for labels, total in counter_groups[name]:
+            lines.append(f"{name}{_labels(labels)} {_fmt(total)}")
+
+    all_gauges = dict(snapshot.get("gauges", {}))
+    for raw, value in (extra_gauges or {}).items():
+        if value is not None:
+            all_gauges[raw] = value
+    for raw, value in sorted(all_gauges.items()):
+        name = _name(raw)
+        header(name, "gauge")
+        lines.append(f"{name} {_fmt(value)}")
+
+    for raw, h in sorted(snapshot.get("histograms", {}).items()):
+        name = _name(raw)
+        header(name, "summary")
+        for q in ("0.5", "0.95", "0.99"):
+            key = "p" + q[2:].ljust(2, "0")  # 0.5 -> p50, 0.95 -> p95, 0.99 -> p99
+            lines.append(f'{name}{{quantile="{q}"}} {_fmt(h[key])}')
+        lines.append(f"{name}_sum {_fmt(h['sum'])}")
+        lines.append(f"{name}_count {_fmt(h['count'])}")
+
+    uptime = snapshot.get("uptime_s")
+    if uptime is not None:
+        header(_PREFIX + "uptime_seconds", "gauge")
+        lines.append(f"{_PREFIX}uptime_seconds {_fmt(uptime)}")
+    return "\n".join(lines) + "\n"

@@ -2,10 +2,11 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
+#   tools/ci_check.sh --observability  # tracing/SLO/flight-recorder lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -41,6 +42,18 @@ offload_lane() {
   # (BENCH_OFFLOAD_STREAM JSON: depth 0 vs 2 step time + overlap_efficiency).
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/unit/test_offload_stream.py -q -p no:cacheprovider
+}
+
+observability_lane() {
+  echo "== observability lane =="
+  # request tracing / SLO burn-rate / flight recorder / Prometheus
+  # exposition guards, plus the telemetry-overhead contract: the
+  # default-off sink stays zero-allocation on the hot path and enabled
+  # per-token tracing overhead stays bounded on the CPU decode smoke
+  # (test_tracing_overhead_bounded in test_observability.py)
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/test_telemetry.py \
+    tests/unit/test_observability.py -q -p no:cacheprovider
 }
 
 bench_diff() {
@@ -82,6 +95,10 @@ if [ "${1:-}" = "--offload" ]; then
   offload_lane
   exit $?
 fi
+if [ "${1:-}" = "--observability" ]; then
+  observability_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -106,7 +123,10 @@ o_rc=$?
 gateway_smoke
 gw_rc=$?
 
+observability_lane
+ob_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ]

@@ -10,10 +10,17 @@ against a trace scp'd off a pod.
 
 Usage:
     python tools/trace_summary.py <telemetry.jsonl>
+    python tools/trace_summary.py <telemetry.jsonl> --requests [K] [--sort ttft|itl]
+
+``--requests`` switches to the per-request view: request span trees are
+reconstructed from the gateway/scheduler trace events (``req/*`` spans
+keyed by their ``track`` id) and the top-K slowest requests print with
+their TTFT/ITL and phase breakdown (queued / prefill / decode ms).
 
 Event schema: see benchmarks/OBSERVABILITY.md.
 """
 
+import argparse
 import json
 import sys
 from collections import OrderedDict
@@ -110,15 +117,82 @@ def format_summary(summary):
     return "\n".join(lines)
 
 
+def summarize_requests(events):
+    """Reconstruct per-request span trees from the ``req/*`` trace events
+    (spans/instants carrying a ``track`` id — see telemetry/tracing.py).
+    Returns {track_id: request dict}."""
+    reqs = OrderedDict()
+    for ev in events:
+        track = ev.get("track")
+        name = ev.get("name", "")
+        if track is None or not name.startswith("req/"):
+            continue
+        req = reqs.setdefault(track, {"track": track, "phases": OrderedDict(),
+                                      "tenant": None, "tokens": 0,
+                                      "ttft_ms": None, "itl_ms": None,
+                                      "reason": None, "start": None})
+        attrs = ev.get("attrs") or {}
+        if req["tenant"] is None and attrs.get("tenant"):
+            req["tenant"] = attrs["tenant"]
+        phase = name[4:]
+        if ev.get("type") == "span":
+            req["phases"][phase] = req["phases"].get(phase, 0.0) + float(ev.get("dur", 0.0))
+            if req["start"] is None or ev["ts"] < req["start"]:
+                req["start"] = ev["ts"]
+        if phase in ("complete", "expired", "cancelled", "rejected"):
+            req["reason"] = attrs.get("reason", phase)
+            req["tokens"] = attrs.get("tokens", req["tokens"])
+            if attrs.get("ttft_ms") is not None:
+                req["ttft_ms"] = attrs["ttft_ms"]
+            if attrs.get("itl_ms") is not None:
+                req["itl_ms"] = attrs["itl_ms"]
+        # prefill spans record ttft for requests that never reach complete
+        if phase == "prefill" and attrs.get("ttft_ms") is not None and req["ttft_ms"] is None:
+            req["ttft_ms"] = attrs["ttft_ms"]
+    return reqs
+
+
+def format_requests(reqs, top=10, sort="ttft"):
+    key = {"ttft": lambda r: r["ttft_ms"] or 0.0,
+           "itl": lambda r: r["itl_ms"] or 0.0}[sort]
+    ordered = sorted(reqs.values(), key=key, reverse=True)[:top]
+    lines = [f"top {len(ordered)} requests by {sort} (of {len(reqs)} traced):",
+             f"{'request':<20s} {'tenant':<10s} {'tok':>4s} {'ttft ms':>9s} "
+             f"{'itl ms':>8s} {'queued':>8s} {'prefill':>8s} {'decode':>8s}  reason"]
+    for r in ordered:
+        ph = r["phases"]
+        lines.append(
+            f"{r['track'][:18]:<20s} {str(r['tenant'] or '-')[:10]:<10s} "
+            f"{r['tokens'] or 0:>4d} "
+            f"{(r['ttft_ms'] or 0.0):>9.1f} {(r['itl_ms'] or 0.0):>8.2f} "
+            f"{ph.get('queued', 0.0) * 1e3:>8.1f} "
+            f"{ph.get('prefill', 0.0) * 1e3:>8.1f} "
+            f"{ph.get('decode', 0.0) * 1e3:>8.1f}  {r['reason'] or '?'}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
-    argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1 or argv[0] in ("-h", "--help"):
-        print(__doc__.strip(), file=sys.stderr)
-        return 2
-    events = load_events(argv[0])
+    p = argparse.ArgumentParser(
+        description="Summarize a deepspeed_tpu telemetry.jsonl")
+    p.add_argument("jsonl", help="path to telemetry.jsonl")
+    p.add_argument("--requests", nargs="?", const=10, default=None, type=int,
+                   metavar="K", help="per-request view: top-K slowest "
+                   "requests with phase breakdown (default K=10)")
+    p.add_argument("--sort", choices=("ttft", "itl"), default="ttft",
+                   help="per-request sort key (with --requests)")
+    args = p.parse_args(sys.argv[1:] if argv is None else argv)
+    events = load_events(args.jsonl)
     if not events:
-        print(f"no telemetry events in {argv[0]}", file=sys.stderr)
+        print(f"no telemetry events in {args.jsonl}", file=sys.stderr)
         return 1
+    if args.requests is not None:
+        reqs = summarize_requests(events)
+        if not reqs:
+            print("no traced requests (enable telemetry.request_tracing and "
+                  "submit through the gateway/scheduler)", file=sys.stderr)
+            return 1
+        print(format_requests(reqs, top=args.requests, sort=args.sort))
+        return 0
     print(format_summary(summarize(events)))
     return 0
 
