@@ -884,6 +884,154 @@ def offload_stream_main():
     }))
 
 
+def _rlhf_bench(model_name="tiny", n_prompts=16, prompt_len=96, max_new=32,
+                cycles=2, num_slots=8, seed=0):
+    """RLHF hybrid-engine benchmark: in-memory weight publication vs the
+    checkpoint round-trip it replaces, and rollout throughput through the
+    continuous-batching scheduler vs the legacy stub's raw static-batch
+    ``generate()``. Every leg is fault-isolated via ``_guard_leg``."""
+    import tempfile as _tf
+
+    import jax
+    import jax.numpy as jnp
+    import flax.serialization
+
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm
+    from deepspeed_tpu.models import get_model
+
+    comm._state["mesh"] = None
+    model = get_model(model_name, dtype=jnp.float32, max_seq_len=256)
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "steps_per_print": 100000,
+           "telemetry": _telemetry_cfg(),
+           "hybrid_engine": {"enabled": True, "max_out_tokens": 256,
+                             "rollout": {"num_slots": num_slots}}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    rng = np.random.default_rng(seed)
+    # RLHF prompt sets share a long task template with mixed-length user
+    # tails — the radix cache's case (template > prefill_chunk so matches
+    # survive the chunk-multiple rounding)
+    template = list(rng.integers(1, 200, max(prompt_len - 16, 1)))
+    prompts = [template + list(rng.integers(1, 200, 1 + int(rng.integers(0, 16))))
+               for _ in range(n_prompts)]
+    batch = {"input_ids": rng.integers(0, 256, (8, 64)).astype(np.int32)}
+
+    results = {"model": model_name, "n_prompts": n_prompts,
+               "prompt_len": prompt_len, "max_new_tokens": max_new,
+               "num_slots": num_slots, "cycles": cycles}
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        out = fn()
+        return out, time.perf_counter() - t0
+
+    def run_publish():
+        # warm cycle compiles the cast + step programs; then measure the
+        # steady-state cycle the RLHF loop actually pays every step
+        engine.rlhf_step(prompts, max_new_tokens=max_new)
+        sched = engine.rollout_scheduler()
+        n_programs_warm = sched.compiled_program_count()
+        per_cycle = []
+        for _ in range(cycles):
+            engine.train_batch(batch=batch)
+            _, dt = timed(engine.publish_weights)
+            per_cycle.append(dt * 1e3)
+        return {"publish_ms_min": round(min(per_cycle), 3),
+                "publish_ms": [round(x, 3) for x in per_cycle],
+                "weights_version": sched.weights_version,
+                "new_scheduler_programs_after_warm":
+                    sched.compiled_program_count() - n_programs_warm}
+
+    def run_checkpoint_roundtrip():
+        # the legacy handoff this subsystem deletes: serialize the full
+        # tree, hit disk, read it back, install + materialize on device
+        pub_params = engine._infer.params
+        per_cycle = []
+        for _ in range(cycles):
+            t0 = time.perf_counter()
+            host = jax.device_get(pub_params)
+            blob = flax.serialization.to_bytes(host)
+            with _tf.NamedTemporaryFile(delete=False) as f:
+                f.write(blob)
+                path = f.name
+            with open(path, "rb") as f:
+                blob2 = f.read()
+            restored = flax.serialization.from_bytes(host, blob2)
+            placed = jax.device_put(restored)
+            jax.block_until_ready(placed)  # whole tree: async backends land leaves independently
+            per_cycle.append((time.perf_counter() - t0) * 1e3)
+            os.unlink(path)
+        return {"roundtrip_ms_min": round(min(per_cycle), 3),
+                "roundtrip_ms": [round(x, 3) for x in per_cycle],
+                "bytes": len(blob)}
+
+    def run_rollout_throughput():
+        # scheduler-served rollouts (chunked prefill + radix hits on the
+        # shared template) vs the seed-era stub's raw static generate
+        engine.publish_weights()
+        engine.collect_rollouts(prompts, max_new_tokens=max_new)  # warm
+        buf, dt = timed(lambda: engine.collect_rollouts(prompts,
+                                                        max_new_tokens=max_new))
+        sched_tok_s = buf.total_tokens() / dt
+        engine._infer.generate(prompts, max_new_tokens=max_new)  # warm
+        out, dt_raw = timed(lambda: engine._infer.generate(prompts,
+                                                           max_new_tokens=max_new))
+        raw_tok_s = sum(len(r) for r in out) / dt_raw
+        sched = engine.rollout_scheduler()
+        return {"scheduler_tok_s": round(sched_tok_s, 1),
+                "legacy_generate_tok_s": round(raw_tok_s, 1),
+                "speedup_vs_legacy": round(sched_tok_s / max(raw_tok_s, 1e-9), 3),
+                "prefix_cache_hit_rate": round(sched.radix.hit_rate(), 3)
+                if sched.radix is not None else 0.0}
+
+    _guard_leg(results, "publish", run_publish)
+    _guard_leg(results, "checkpoint_roundtrip", run_checkpoint_roundtrip)
+    _guard_leg(results, "rollout", run_rollout_throughput)
+    pub = results.get("publish", {})
+    rt = results.get("checkpoint_roundtrip", {})
+    if "publish_ms_min" in pub and "roundtrip_ms_min" in rt:
+        results["roundtrip_over_publish"] = round(
+            rt["roundtrip_ms_min"] / max(pub["publish_ms_min"], 1e-9), 2)
+    return results
+
+
+def rlhf_main():
+    """`python bench.py rlhf`: one BENCH_RLHF JSON line — in-memory weight
+    publication vs checkpoint round-trip wall time, and scheduler-served
+    rollout tok/s vs the legacy raw generate (graceful structured skip on
+    backend failure)."""
+    global _HEADLINE, _UNIT
+    model = os.environ.get("BENCH_RLHF_MODEL", "tiny")
+    _HEADLINE = f"rlhf: in-memory publish vs checkpoint round-trip ({model})"
+    _UNIT = "ms/publish"
+    if _ensure_backend() is None:
+        return
+    try:
+        res = _rlhf_bench(
+            model_name=model,
+            n_prompts=int(os.environ.get("BENCH_RLHF_PROMPTS", "16")),
+            prompt_len=int(os.environ.get("BENCH_RLHF_PROMPT_LEN", "96")),
+            max_new=int(os.environ.get("BENCH_RLHF_MAX_NEW", "32")),
+            cycles=int(os.environ.get("BENCH_RLHF_CYCLES", "2")),
+            num_slots=int(os.environ.get("BENCH_RLHF_SLOTS", "8")))
+    except Exception as e:  # noqa: BLE001 — a failed leg must yield structured JSON
+        _emit_skipped(f"rlhf bench failed: "
+                      f"{type(e).__name__}: {e}".splitlines()[0][:500],
+                      bench_error=True)
+        return
+    value = res.get("publish", {}).get("publish_ms_min", 0.0)
+    print(json.dumps({
+        "metric": _HEADLINE,
+        "value": value,
+        "unit": _UNIT,
+        # >1.0 means the in-memory swap beat the checkpoint round-trip
+        "vs_baseline": res.get("roundtrip_over_publish", 0.0),
+        "extra": res,
+    }))
+
+
 def main():
     devices = _ensure_backend()
     if devices is None:
@@ -1018,5 +1166,7 @@ if __name__ == "__main__":
         gateway_main()
     elif len(sys.argv) > 1 and sys.argv[1] == "offload_stream":
         offload_stream_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "rlhf":
+        rlhf_main()
     else:
         main()

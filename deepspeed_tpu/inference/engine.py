@@ -63,6 +63,26 @@ class InferenceEngine:
     """Wraps a zoo model (or preset name) for TP-sharded generation."""
 
     def __init__(self, model, config=None, params=None):
+        self._construct(model, config, params, materialize=True)
+
+    @classmethod
+    def from_shared_params(cls, model, config=None, params=None):
+        """Supported constructor for engines whose weights are OWNED AND
+        PUBLISHED EXTERNALLY (the RLHF hybrid engine's
+        :class:`~deepspeed_tpu.rlhf.WeightPublisher`): runs the full
+        ``__init__`` path — config validation, dtype/kernel overrides, mesh
+        and sharding setup, telemetry wiring — but installs ``params``
+        as-is (possibly ``None`` until the first publication) instead of
+        loading a checkpoint or initializing random weights.
+
+        This replaces the old ``InferenceEngine.__new__`` + field-poking
+        pattern, which silently skipped config validation and every
+        invariant later ``__init__`` revisions added."""
+        eng = cls.__new__(cls)
+        eng._construct(model, config, params, materialize=False)
+        return eng
+
+    def _construct(self, model, config, params, materialize):
         self._config = config if isinstance(config, DeepSpeedInferenceConfig) else \
             DeepSpeedInferenceConfig(dict(config or {}))
         cfg = self._config
@@ -79,6 +99,10 @@ class InferenceEngine:
         # dequant-GEMM serving): the memory-bound decode loop reads half
         # the HBM bytes through the Pallas quant matmul.
         self._int8_weights = cfg.dtype == jnp.int8
+        if self._int8_weights and not materialize:
+            raise ValueError("from_shared_params does not support dtype=int8: the "
+                             "int8 tier quantizes at materialization, but shared "
+                             "params are published post-hoc in the compute layout")
         compute_dtype = jnp.bfloat16 if self._int8_weights else cfg.dtype
         overrides = {"dtype": compute_dtype, "decode_block_kv": cfg.decode_block_kv}
         if self._int8_weights and hasattr(model.cfg, "int8_weights"):
@@ -117,7 +141,9 @@ class InferenceEngine:
 
         self.planner = ShardingPlanner(self.mesh, None, tp_rules=self.module.tp_rules(),
                                        expert_pattern=self.module.expert_pattern())
-        self.params = self._materialize_params(params)
+        # shared-params engines never materialize: the publisher installs
+        # (and later swaps) the compute-layout tree
+        self.params = self._materialize_params(params) if materialize else params
         self._compiled = {}
         self._cache_pool = {}  # (B, S) -> reusable KV cache buffers
         # telemetry: reuse an already-installed global sink (e.g. the

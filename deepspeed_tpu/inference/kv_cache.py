@@ -33,6 +33,14 @@ prefills the suffix. Cached slots are reclaimed LRU-first when the free
 list runs dry. Reference counts (`refs`) track trie registrations per slot;
 a slot is only reclaimable once the trie drops its last reference.
 
+Weights versioning (RLHF hybrid engine, ``deepspeed_tpu/rlhf/``): KV rows
+are only valid against the weights that computed them, so every slot is
+stamped with the pool's ``weights_version`` at :meth:`SlotKVCache.alloc`
+and every trie registration carries it too. A weight publication bumps the
+version (``DecodeScheduler.swap_weights``), after which retaining a
+stale-version slot or matching a stale registration is a hard error —
+cross-version KV reuse is impossible STRUCTURALLY, not by convention.
+
 Host-side state lives here; the compiled prefill/decode programs that read
 and write the pool live in :mod:`deepspeed_tpu.inference.scheduler`.
 """
@@ -69,6 +77,11 @@ class SlotKVCache:
         self._owner = [None] * self.num_slots  # request id per slot (debugging)
         self.total_allocs = 0
         self.total_frees = 0
+        # weights versioning: rows are only meaningful against the weights
+        # that computed them; slots are stamped at alloc and a bump
+        # (weight publication) makes every pre-bump row untrustworthy
+        self.weights_version = 0
+        self.slot_version = np.zeros(self.num_slots, np.int64)
 
     # ------------------------------------------------------------------ alloc
     def alloc(self, owner=None):
@@ -83,6 +96,7 @@ class SlotKVCache:
         self.lengths[slot] = 0
         self.state[slot] = "active"
         self._owner[slot] = owner
+        self.slot_version[slot] = self.weights_version
         self.total_allocs += 1
         return slot
 
@@ -107,6 +121,12 @@ class SlotKVCache:
             raise ValueError(f"retain of non-active slot {slot} (state {self.state[slot]})")
         if self.refs[slot] <= 0:
             raise ValueError(f"retain of slot {slot} with no trie reference")
+        if self.slot_version[slot] != self.weights_version:
+            raise ValueError(
+                f"retain of slot {slot} stamped weights_version "
+                f"{int(self.slot_version[slot])} under pool version "
+                f"{self.weights_version}: KV computed under stale weights must "
+                f"never be retained for reuse (swap_weights invalidates first)")
         self.state[slot] = "cached"
         self._owner[slot] = None
         self.total_frees += 1
@@ -125,6 +145,20 @@ class SlotKVCache:
     def fits(self, prompt_len, max_new_tokens):
         """Would a request of this shape ever fit a slot?"""
         return prompt_len + max_new_tokens <= self.max_len
+
+    def bump_weights_version(self):
+        """New weights published: every row computed so far is stale. The
+        caller (``DecodeScheduler.swap_weights``) must have already emptied
+        the active/cached states — a bump with retained rows would leave
+        registrations whose version can never match again, which
+        :meth:`check_invariants` treats as corruption."""
+        for i, s in enumerate(self.state):
+            if s != "free":
+                raise ValueError(
+                    f"bump_weights_version with slot {i} still {self.state[i]}: "
+                    f"drain live requests and invalidate retained prefixes first")
+        self.weights_version += 1
+        return self.weights_version
 
     # ------------------------------------------------------------------ stats
     @property
@@ -217,6 +251,11 @@ class SlotKVCache:
                 raise AssertionError(f"free slot {i} holds rows/refs")
             if s == "cached" and self.refs[i] <= 0:
                 raise AssertionError(f"cached slot {i} holds no reference")
+            if s == "cached" and self.slot_version[i] != self.weights_version:
+                raise AssertionError(
+                    f"cached slot {i} carries weights_version "
+                    f"{int(self.slot_version[i])} != pool version "
+                    f"{self.weights_version} (stale-weights KV retained)")
             if self.refs[i] < 0:
                 raise AssertionError(f"negative refcount on slot {i}")
         if self.active_slots + self.cached_slots + self.free_slots != self.num_slots:
@@ -285,11 +324,13 @@ class RadixPrefixCache:
         self.root = _RadixNode()
         self._slot_node = {}   # slot -> registration node
         self._slot_len = {}    # slot -> retained prefix length
+        self._slot_version = {}  # slot -> weights_version at registration
         self._lru = {}         # slot -> last-use tick (monotonic)
         self._tick = 0
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        self.invalidations = 0  # whole-trie drops (weight swaps)
 
     # ------------------------------------------------------------------ core
     def _touch(self, slot):
@@ -307,9 +348,18 @@ class RadixPrefixCache:
     def insert(self, slot, tokens):
         """Register ``slot`` as holding KV for the full ``tokens`` prefix.
         One registration per slot (re-registering raises: a slot must be
-        evicted/freed before it can carry a different prefix)."""
+        evicted/freed before it can carry a different prefix). The
+        registration is tagged with the pool's current ``weights_version``
+        — registering rows stamped under older weights raises, so a stale
+        prefix can never ENTER the trie, let alone be served from it."""
         if slot in self._slot_node:
             raise ValueError(f"slot {slot} already registered in the prefix trie")
+        if self.kv.slot_version[slot] != self.kv.weights_version:
+            raise ValueError(
+                f"slot {slot} holds KV stamped weights_version "
+                f"{int(self.kv.slot_version[slot])} but the pool is at "
+                f"{self.kv.weights_version}: stale-weights rows cannot register "
+                f"as reusable prefixes")
         tokens = tuple(int(t) for t in tokens)
         node, depth = self.root, 0
         while depth < len(tokens):
@@ -333,6 +383,7 @@ class RadixPrefixCache:
         node.slots.add(slot)
         self._slot_node[slot] = node
         self._slot_len[slot] = len(tokens)
+        self._slot_version[slot] = self.kv.weights_version
         self.kv.refs[slot] += 1
         self._touch(slot)
 
@@ -360,12 +411,19 @@ class RadixPrefixCache:
         return min(depth, self._slot_len[donor]), donor
 
     def _best_slot(self, node):
-        """Most-recently-used slot registered in ``node``'s subtree."""
+        """Most-recently-used slot registered in ``node``'s subtree whose
+        registration matches the pool's current weights version (stale
+        registrations only exist transiently between a version bump and
+        :meth:`invalidate_all`; skipping them here is the belt to that
+        braces)."""
         best, best_tick = None, -1
         stack = [node]
         while stack:
             n = stack.pop()
             for s in n.slots:
+                if (self._slot_version.get(s) != self.kv.weights_version
+                        or self.kv.slot_version[s] != self.kv.weights_version):
+                    continue
                 if self._lru.get(s, 0) > best_tick:
                     best, best_tick = s, self._lru.get(s, 0)
             stack.extend(n.children.values())
@@ -384,6 +442,7 @@ class RadixPrefixCache:
             return False
         node.slots.discard(slot)
         del self._slot_len[slot]
+        self._slot_version.pop(slot, None)
         self._lru.pop(slot, None)
         self.kv.refs[slot] -= 1
         # prune childless, slotless nodes up the path
@@ -417,6 +476,26 @@ class RadixPrefixCache:
         """Token length of ``slot``'s registered prefix (0 if unregistered)
         — the rows still useful for reuse once the slot's request ends."""
         return self._slot_len.get(slot, 0)
+
+    def invalidate_all(self):
+        """Drop EVERY registration and reclaim every cached slot — the
+        weight-swap path (``DecodeScheduler.swap_weights``): KV computed
+        under the outgoing weights must never be served against the new
+        ones. Registrations pinned by LIVE slots raise (the scheduler
+        flushes in-flight work first). Returns the number of retained KV
+        tokens invalidated (the ``rlhf/kv_invalidated_tokens`` telemetry)."""
+        live = [s for s in self._slot_node if self.kv.state[s] == "active"]
+        if live:
+            raise ValueError(f"invalidate_all with live registered slots {live}: "
+                             f"flush in-flight requests before swapping weights")
+        dropped_tokens = 0
+        for slot in list(self._slot_node):
+            dropped_tokens += int(self.kv.lengths[slot])
+            self.remove(slot)
+            if self.kv.state[slot] == "cached":
+                self.kv.reclaim(slot)
+        self.invalidations += 1
+        return dropped_tokens
 
     # ------------------------------------------------------------------ stats
     def hit_rate(self):

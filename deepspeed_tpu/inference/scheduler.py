@@ -73,6 +73,14 @@ group-quantized K/V (per-token-row fp16 scales, ``ops/quantizer``
 so bf16 KV never materializes in HBM — roughly doubling resident slots per
 chip at a small bounded logit error.
 
+**Weight-swap protocol** (RLHF hybrid engine, ``deepspeed_tpu/rlhf/``):
+``pause()`` gates admission, ``flush()`` drains in-flight rows under the
+weights that prefilled them, ``swap_weights(params)`` invalidates the radix
+trie and ALL retained KV (weights-version stamps make cross-version reuse a
+structural error) and installs the new tree, ``resume()`` re-opens
+admission. All host bookkeeping on the scheduler thread; zero new XLA
+programs per cycle. See ``benchmarks/RLHF.md``.
+
 Telemetry (PR-1 sink): gauges ``serving/slot_occupancy``,
 ``serving/batch_efficiency``, ``serving/kv_token_utilization``,
 ``serving/prefix_cache_hit_rate``, ``serving/spec_acceptance_rate``,
@@ -303,6 +311,11 @@ class DecodeScheduler:
         self._compiled = {}
         self._rid = 0
         self._steps = 0
+        # weight-swap protocol (RLHF hybrid engine): pause gates ADMISSION
+        # only — in-flight rows keep decoding under the weights that
+        # prefilled them until flush() drains the pool
+        self._paused = False
+        self.published_version = None  # publisher's tag for the live weights
         # request tracing: per-sync "sched/step" spans (on the pump thread's
         # track) collect flow ids minted by the request phases they executed
         # — the connective tissue between one request's span tree and the
@@ -387,6 +400,64 @@ class DecodeScheduler:
     def num_slots(self):
         return self.cache.num_slots
 
+    @property
+    def weights_version(self):
+        """Monotonic weights generation of the slot pool: every KV row and
+        trie registration is stamped with the version that computed it."""
+        return self.cache.weights_version
+
+    # ------------------------------------------------------------------ weight swap
+    # The publish protocol (deepspeed_tpu/rlhf/publisher.py drives it):
+    #   pause() -> flush() -> swap_weights(params) -> resume()
+    # All four are host bookkeeping on the single scheduler thread — the
+    # swap itself adds ZERO XLA programs (the step programs take params as
+    # an argument, and the new tree has the same treedef/shapes/dtypes).
+    def pause(self):
+        """Stop admitting new work (queued requests stay queued; in-flight
+        rows keep decoding). Idempotent."""
+        self._paused = True
+
+    def resume(self):
+        """Re-open admission after a swap. Idempotent."""
+        self._paused = False
+
+    def flush(self):
+        """Drive the loop until nothing is in flight (active rows and any
+        mid-prefill row run to completion under the CURRENT weights). With
+        admission paused this terminates even when requests are queued —
+        they stay parked for the post-swap weights."""
+        while self.active or self._prefill is not None:
+            self.step()
+
+    def swap_weights(self, params, version=None):
+        """Install a new parameter tree as THE weights every subsequent
+        dispatch reads, and invalidate all retained KV: drop every radix
+        registration, reclaim every cached slot, and bump the pool's
+        ``weights_version`` so a stale row can never re-register (enforced
+        by the version stamps in :mod:`~deepspeed_tpu.inference.kv_cache`,
+        not by convention). Requires nothing in flight — call
+        :meth:`pause` + :meth:`flush` first (or use the publisher, which
+        does). Returns the number of retained KV tokens invalidated.
+
+        ``params`` must match the engine's current parameter tree in
+        structure/shapes/dtypes (same model, new values) — that is what
+        keeps the swap recompile-free; ``version`` is the publisher's tag
+        for telemetry/bookkeeping."""
+        if self.active or self._prefill is not None:
+            raise ValueError(
+                f"swap_weights with {len(self.active)} active slots"
+                f"{' + an in-flight prefill' if self._prefill is not None else ''}: "
+                f"pause() and flush() the scheduler first")
+        invalidated = self.radix.invalidate_all() if self.radix is not None else 0
+        self.cache.bump_weights_version()
+        self.engine.params = params  # identity-keyed _fast_tree_cache re-keys itself
+        self.published_version = version
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("rlhf/weight_swaps")
+            tel.counter("rlhf/kv_invalidated_tokens", invalidated)
+        return invalidated
+
     # ------------------------------------------------------------------ loop
     def step(self):
         """One scheduler iteration: settle cancellations, admit (chunked: at
@@ -399,7 +470,9 @@ class DecodeScheduler:
         self._iter_links = [] if tracing else None
         self._reap_cancelled()
         admitted = 0
-        if self.prefill_chunk > 0:
+        if self._paused:
+            pass  # swap protocol: no admission; in-flight work still advances
+        elif self.prefill_chunk > 0:
             while self.queue and self.queue[0].cancelled:
                 self.queue.popleft().done = True
             if self._prefill is None and self.queue:

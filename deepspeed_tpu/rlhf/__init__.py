@@ -1,0 +1,30 @@
+"""RLHF hybrid-engine subsystem: in-memory train↔generate weight handoff
+through the continuous-batching scheduler.
+
+TPU-native analogue of the reference ``DeepSpeedHybridEngine``
+(``runtime/hybrid_engine.py:32``, the DeepSpeed-Chat actor pattern where
+rollout generation alternates with PPO updates every step), rebuilt on the
+modern serving stack instead of a raw cast-and-generate:
+
+- :class:`WeightPublisher` snapshots the training engine's parameters into
+  the inference compute layout as a versioned, generation-tagged
+  :class:`Publication` (cast + reshard ONCE per publication, compiled once
+  per layout) and installs it through the scheduler's
+  ``pause -> flush -> swap_weights -> resume`` protocol — an in-memory swap
+  with zero checkpoint round-trips and zero new XLA programs per cycle.
+- :class:`RolloutCollector` runs prompt batches through
+  ``DecodeScheduler.submit()``, so rollouts get everything serving has:
+  chunked prefill, radix prefix-cache hits on the shared prompt template,
+  speculative decoding, and per-request traces — and returns
+  token/logprob/reward sequences into a :class:`RolloutBuffer`.
+- ``runtime/hybrid_engine.DeepSpeedHybridEngine`` orchestrates the
+  train -> generate -> train loop (N rollout rounds per publication, M
+  PPO-shaped updates per rollout buffer, pluggable reward fn and update
+  hook).
+
+See ``benchmarks/RLHF.md`` for the loop shape, swap semantics, and the
+staleness-vs-throughput tuning notes.
+"""
+
+from .publisher import Publication, WeightPublisher  # noqa: F401
+from .rollout import RolloutBuffer, RolloutCollector, RolloutSample  # noqa: F401

@@ -1,0 +1,163 @@
+"""Weight publication: training masters -> inference compute layout, as a
+versioned in-memory swap.
+
+The reference hybrid engine flips between ZeRO-3 training modules and
+kernel-injected inference containers that share weight storage
+(``create_inference_module`` :298); DeepSpeed-Chat pays a gather/scatter
+bookkeeping pass around every rollout phase. Here both modes are pure
+functions over parameter pytrees, so a publication is ONE compiled
+cast+reshard program: merge LoRA adapters (unless already fused), cast the
+fp32 masters to the inference compute dtype, restack/unstack to the
+inference module's layer layout, and land the result in the inference
+sharding — all inside a single jit whose output is an OWNED tree (no leaf
+aliases live training state, so the publication stays frozen while training
+steps on).
+
+Publications are generation-tagged: each fresh snapshot gets a monotonically
+increasing ``version`` and records the training step it was cut at, and the
+snapshot is cached against ``(global_steps, lora_fused)`` so back-to-back
+rollouts between updates reuse the same tree (the identity-keyed
+``_fast_tree_cache`` and the scheduler's step programs then see literally
+the same object — nothing recompiles, nothing re-casts).
+
+Installing a publication goes through the scheduler's swap protocol
+(``pause -> flush -> swap_weights -> resume``): in-flight decode rows finish
+under the weights that prefilled them, every retained prefix and radix
+registration is invalidated (KV computed under stale weights must never be
+served against new weights — enforced by the version stamps in
+``inference/kv_cache.py``), and the new tree becomes the one every
+subsequent dispatch reads. The whole cycle adds ZERO new XLA programs after
+the first publication: the cast program is cached, and the step programs
+take params as an argument.
+"""
+
+import time
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Publication(NamedTuple):
+    """One published weight generation."""
+    version: int   # monotonic publication number (the KV version tag's peer)
+    step: int      # training global step the snapshot was cut at
+    params: Any    # device tree in the inference engine's compute layout
+
+
+class WeightPublisher:
+    """Snapshots a training :class:`DeepSpeedEngine`'s parameters into an
+    inference engine's compute layout and installs them via the scheduler
+    swap protocol. One publisher per (train engine, inference engine) pair;
+    NOT thread-safe — drive it from the thread that pumps the scheduler."""
+
+    def __init__(self, train_engine, infer_engine):
+        self.train = train_engine
+        self.infer = infer_engine
+        self.version = 0          # last snapshot's tag; 0 = nothing published
+        self.live = None          # Publication currently installed (or None)
+        self._snap = None         # (cache_key, Publication) of the last snapshot
+        self._compiled = {}       # (path, fused) -> compiled cast program
+        self.telemetry = train_engine.telemetry
+
+    # ------------------------------------------------------------------ snapshot
+    def _lora(self):
+        from ..runtime.lora import LoRAModel
+        m = self.train.module
+        return m if isinstance(m, LoRAModel) else None
+
+    def _build_cast(self, fused, src):
+        """The ONE cast+reshard program for this (source path, LoRA-fusion)
+        combination: merge adapters -> cast to the inference compute dtype
+        -> adapt the layer layout (stacked <-> unrolled) — out-shardings are
+        the inference planner's, so XLA inserts whatever resharding
+        collectives the layouts require. ``src`` is the already-gathered
+        master tree (eval_shape only reads shapes, so the expensive
+        param_stream host assembly is NOT repeated here)."""
+        infer = self.infer
+        dtype = infer.model_config.dtype
+        lora = self._lora()
+
+        def fn(p):
+            if lora is not None:
+                p = p["base"] if fused else lora.merge(p)
+            p = jax.tree_util.tree_map(lambda x: jnp.asarray(x, dtype), p)
+            return infer._adapt_layout(p)
+
+        abstract = jax.eval_shape(fn, src)
+        shardings = infer.planner.shardings(infer.planner.master_specs(abstract))
+        return jax.jit(fn, out_shardings=shardings)
+
+    def _masters(self, path):
+        if path == "param_stream":
+            # ZeRO-Infinity: masters live in host blocks; get_params_tree
+            # assembles an OWNED fp32 host copy (PR 5 contract)
+            return self.train.param_stream.get_params_tree(np.float32)
+        return self.train.state.params
+
+    def snapshot(self):
+        """A :class:`Publication` of the CURRENT training weights. Cached
+        against ``(global_steps, lora_fused)``: repeated rollouts between
+        optimizer updates reuse the same tree (identity-stable, so nothing
+        downstream re-keys); the next update cuts a fresh version."""
+        train = self.train
+        fused = bool(getattr(train, "_lora_fused", False))
+        path = "param_stream" if train.param_stream is not None else "device"
+        key = (int(train.global_steps), fused)
+        if self._snap is not None and self._snap[0] == key:
+            return self._snap[1]
+        src = self._masters(path)  # gathered ONCE (param_stream assembly is a full host copy)
+        ckey = (path, fused)
+        if ckey not in self._compiled:
+            self._compiled[ckey] = self._build_cast(fused, src)
+        with train.mesh:
+            params = self._compiled[ckey](src)
+        self.version += 1
+        pub = Publication(self.version, key[0], params)
+        self._snap = (key, pub)
+        return pub
+
+    # ------------------------------------------------------------------ publish
+    def publish(self, scheduler=None):
+        """Snapshot + install: drive the scheduler's
+        ``pause -> flush -> swap_weights -> resume`` protocol (or a plain
+        assignment when no scheduler exists yet). A publication that is
+        already live is a no-op — ``generate()``-per-rollout callers pay
+        nothing between updates. Returns the live :class:`Publication`."""
+        tel = self.telemetry
+        t0 = time.perf_counter()
+        pub = self.snapshot()
+        sched = scheduler if scheduler is not None else self.infer._scheduler
+        if (self.live is not None and pub is self.live
+                and (sched is None or sched.published_version == pub.version)):
+            return pub  # already live AND the scheduler's bookkeeping agrees
+        # a scheduler built AFTER a pre-scheduler publish (legacy generate()
+        # first) re-installs the live publication through the swap protocol
+        # so published_version/weights_version stay in lockstep with it
+        if sched is not None:
+            sched.pause()
+            try:
+                sched.flush()
+                sched.swap_weights(pub.params, version=pub.version)
+            finally:
+                sched.resume()
+        else:
+            self.infer.params = pub.params
+        self.live = pub
+        if tel.enabled:
+            dur = time.perf_counter() - t0
+            tel.histogram("rlhf/publish_ms", dur * 1e3)
+            tel.counter("rlhf/publications")
+            tel.record_span("rlhf/publish", tel.now() - dur, dur,
+                            attrs={"version": pub.version, "step": pub.step})
+            tel.gauge("rlhf/staleness_steps", self.staleness_steps())
+        return pub
+
+    def staleness_steps(self):
+        """Optimizer steps taken since the live publication was cut — the
+        off-policy gap rollouts currently decode under (0 right after a
+        publish; grows by M across each update phase)."""
+        if self.live is None:
+            return 0
+        return int(self.train.global_steps) - self.live.step

@@ -1,0 +1,212 @@
+"""Rollout collection through the continuous-batching scheduler.
+
+The seed-era hybrid stub generated rollouts with the static-batch
+``generate()`` program — one compiled shape per (batch, prompt-bucket),
+no cross-request batching, no prefix reuse. The collector instead submits
+every prompt through ``DecodeScheduler.submit()``, so rollouts ride the
+full serving stack: iteration-level continuous batching, chunked prefill,
+radix prefix-cache hits on the shared prompt template (RLHF prompt sets
+share long system/task prefixes — exactly the radix cache's best case),
+speculative decoding when configured, and per-request traces.
+
+Each finished request becomes a :class:`RolloutSample` carrying the chosen
+tokens, their log-probabilities under the weights that generated them (the
+PPO "old logprobs", computed from the scheduler's collected per-step
+logits and tagged with the publication version), and a scalar reward from
+the pluggable reward fn. Samples accumulate in a :class:`RolloutBuffer`
+that shapes PPO-style update batches.
+"""
+
+import time
+
+import numpy as np
+
+
+def _logprobs_of(logits, tokens):
+    """Per-step log P(token) from a (T, V) float32 logits block — the
+    numerically-stable log-softmax row-gather."""
+    if len(tokens) == 0:
+        return np.zeros(0, np.float32)
+    l = logits[:len(tokens)].astype(np.float64)
+    l = l - l.max(axis=-1, keepdims=True)
+    lse = np.log(np.exp(l).sum(axis=-1))
+    rows = np.arange(len(tokens))
+    return (l[rows, np.asarray(tokens)] - lse).astype(np.float32)
+
+
+class RolloutSample:
+    """One prompt -> completion rollout, frozen at collection time."""
+
+    __slots__ = ("prompt", "tokens", "logprobs", "reward", "version", "rid")
+
+    def __init__(self, prompt, tokens, logprobs, reward, version, rid=None):
+        self.prompt = np.asarray(prompt, np.int32)
+        self.tokens = np.asarray(tokens, np.int32)
+        self.logprobs = np.asarray(logprobs, np.float32)
+        self.reward = float(reward)
+        self.version = version  # weights publication the rollout decoded under
+        self.rid = rid
+
+    def __len__(self):
+        return int(self.tokens.size)
+
+
+class RolloutBuffer:
+    """Accumulates :class:`RolloutSample`\\ s across collect rounds and
+    shapes PPO-style update batches."""
+
+    def __init__(self):
+        self.samples = []
+
+    def add(self, sample):
+        self.samples.append(sample)
+
+    def __len__(self):
+        return len(self.samples)
+
+    def clear(self):
+        self.samples = []
+
+    def total_tokens(self):
+        return int(sum(len(s) for s in self.samples))
+
+    def versions(self):
+        """Distinct publication versions represented in the buffer (a
+        single-version buffer is fully on-policy w.r.t. its publication)."""
+        return sorted({s.version for s in self.samples})
+
+    def ppo_batch(self, batch_size, pad_token_id=0, start=0, bucket=64,
+                  max_len=None):
+        """One PPO-shaped update batch of exactly ``batch_size`` rows
+        (cycling through the buffer from ``start`` when it is smaller):
+
+        - ``input_ids`` (B, T): prompt + completion, right-padded,
+        - ``labels`` (B, T): pre-shifted next-token targets with ``-100``
+          on padding (the stock LM loss ignores them — the default update
+          must never spend gradient learning to emit the pad token),
+        - ``loss_mask`` (B, T): 1.0 on completion tokens (the only
+          positions a policy-gradient loss should touch),
+        - ``old_logprobs`` (B, T): log P(token) under the generating
+          publication, 0 off-completion,
+        - ``rewards`` (B,), ``advantages`` (B,): sequence reward and its
+          group-mean-baselined advantage (the minimal PPO shape — swap in
+          a learned critic via a custom update hook).
+
+        ``T`` rounds the batch's longest row up to a power-of-two bucket
+        (floor ``bucket``, capped at ``max_len``) so rotating prompt sets
+        and per-epoch row windows reuse ONE compiled train-step program
+        per bucket instead of retracing on every distinct length — the
+        same geometric-bucket trick the serving prefill path uses.
+        ``bucket=0``/``None`` pads to the exact max row length.
+        """
+        if not self.samples:
+            raise ValueError("ppo_batch on an empty RolloutBuffer")
+        rows = [self.samples[(start + i) % len(self.samples)]
+                for i in range(batch_size)]
+        raw = max(len(r.prompt) + len(r.tokens) for r in rows)
+        T = raw
+        if bucket:
+            T = int(bucket)
+            while T < raw:
+                T *= 2
+        if max_len is not None:
+            if raw > max_len:
+                raise ValueError(f"rollout rows of {raw} tokens exceed max_len {max_len}")
+            T = min(T, int(max_len))
+        ids = np.full((batch_size, T), pad_token_id, np.int32)
+        labels = np.full((batch_size, T), -100, np.int32)
+        mask = np.zeros((batch_size, T), np.float32)
+        oldlp = np.zeros((batch_size, T), np.float32)
+        rewards = np.zeros(batch_size, np.float32)
+        for i, r in enumerate(rows):
+            p, g = len(r.prompt), len(r.tokens)
+            ids[i, :p] = r.prompt
+            ids[i, p:p + g] = r.tokens
+            labels[i, :p + g - 1] = ids[i, 1:p + g]
+            mask[i, p:p + g] = 1.0
+            oldlp[i, p:p + g] = r.logprobs
+            rewards[i] = r.reward
+        return {"input_ids": ids, "labels": labels, "loss_mask": mask,
+                "old_logprobs": oldlp, "rewards": rewards,
+                "advantages": rewards - rewards.mean()}
+
+
+class RolloutCollector:
+    """Submits prompt batches through the shared scheduler and harvests
+    token/logprob/reward sequences. ``reward_fn(prompt, tokens) -> float``
+    is pluggable (default 0.0 — reward models hang off here)."""
+
+    def __init__(self, engine, reward_fn=None):
+        self.engine = engine
+        self.reward_fn = reward_fn
+        self.telemetry = engine.telemetry
+        self.total_tokens = 0
+        self.total_requests = 0
+
+    def collect(self, prompts, max_new_tokens=64, eos_token_id=None,
+                do_sample=False, temperature=1.0, top_k=0, top_p=1.0,
+                seed=0, buffer=None, reward_fn=None, version=None):
+        """One rollout round: every prompt through
+        ``DecodeScheduler.submit(collect_logits=True)``, results into
+        ``buffer`` (a fresh :class:`RolloutBuffer` when None). Old
+        logprobs come from the per-step logits the scheduler already
+        collects — bit-identical to what any serving client would see,
+        because they ARE the serving path's logits."""
+        sched = self.engine.scheduler()
+        reward_fn = reward_fn if reward_fn is not None else self.reward_fn
+        if version is None:
+            version = (sched.published_version
+                       if sched.published_version is not None
+                       else sched.weights_version)
+        buf = buffer if buffer is not None else RolloutBuffer()
+        tel = self.telemetry
+        # PR 8 request tracing covers rollouts too: each one gets its own
+        # req/* span tree (prefix_probe -> prefill chunks -> decode ->
+        # complete), flow-linked to the shared sched/step iterations
+        tracing = tel.enabled and getattr(tel, "trace_requests", False)
+        t0 = time.perf_counter()
+        handles = []
+        try:
+            for i, prompt in enumerate(prompts):
+                trace = None
+                if tracing:
+                    from ..telemetry import RequestTrace
+                    trace = RequestTrace(tel, rollout=True, version=version)
+                handles.append(
+                    (prompt, sched.submit(prompt, max_new_tokens=max_new_tokens,
+                                          eos_token_id=eos_token_id,
+                                          do_sample=do_sample,
+                                          temperature=temperature, top_k=top_k,
+                                          top_p=top_p, seed=seed + i,
+                                          collect_logits=True, trace=trace)))
+        except Exception:
+            for _, h in handles:  # don't orphan already-queued rollouts
+                h.cancel()
+            raise
+        n_tokens = 0
+        try:
+            for prompt, h in handles:
+                tokens = h.result()
+                logits = h.result_logits()
+                lp = _logprobs_of(logits, tokens)
+                reward = float(reward_fn(prompt, tokens)) if reward_fn else 0.0
+                buf.add(RolloutSample(prompt, tokens, lp, reward, version,
+                                      rid=h._req.rid))
+                n_tokens += len(tokens)
+        except Exception:
+            # a mid-harvest failure (reward_fn raised, one request errored)
+            # must not leave the REST of the round occupying slots on the
+            # shared scheduler: the propagating traceback pins this frame's
+            # `handles`, so __del__-based cancellation would never fire
+            for _, h in handles:
+                if not h.done:
+                    h.cancel()
+            raise
+        dur = max(time.perf_counter() - t0, 1e-9)
+        self.total_tokens += n_tokens
+        self.total_requests += len(handles)
+        if tel.enabled:
+            tel.gauge("rlhf/rollout_tok_s", n_tokens / dur)
+            tel.counter("rlhf/rollout_tokens", n_tokens)
+            tel.counter("rlhf/rollout_requests", len(handles))
+        return buf

@@ -2,40 +2,72 @@
 
 TPU-native analogue of reference ``runtime/hybrid_engine.py:32``
 (``DeepSpeedHybridEngine``): ONE engine that both trains (ZeRO) and serves
-``generate()`` for the RLHF actor — the DeepSpeed-Chat pattern where rollout
-generation alternates with PPO updates every step.
+rollout generation for the RLHF actor — the DeepSpeed-Chat pattern where
+rollout generation alternates with PPO updates every step.
 
-Design translation: the reference flips between ZeRO-3 training modules and
-kernel-injected inference containers that share weight storage
-(``create_inference_module`` :298, ``_zero3_forward`` :333). Here both modes
-are pure functions over the same logical parameter pytree, so "sharing"
-is the identity: ``generate()`` casts the fp32 master params to the compute
-dtype inside jit (out-shardings = the inference layout) and runs the
-KV-cache generation program; XLA inserts whatever resharding collectives the
-ZeRO/TP layouts require — the reference's gather/scatter bookkeeping
-(``fuse_lora_weight`` :129, container weight aliasing) has no equivalent to
-maintain.
+Design translation (rebuilt on the modern serving stack — see
+``deepspeed_tpu/rlhf/`` and ``benchmarks/RLHF.md``): the reference flips
+between ZeRO-3 training modules and kernel-injected inference containers
+that share weight storage (``create_inference_module`` :298,
+``_zero3_forward`` :333). Here the two sides are pure functions over
+parameter pytrees, so "sharing" is a versioned in-memory publication: a
+:class:`~deepspeed_tpu.rlhf.WeightPublisher` casts+reshards the fp32
+masters into the inference compute layout ONCE per optimizer update (cached
+against the training step, so repeated rollouts between updates reuse the
+copy — the seed-era stub's step-keyed cache idea, now done through the
+scheduler's swap protocol so the identity-keyed ``_fast_tree_cache`` and
+the radix prefix cache stay coherent), and rollout generation runs through
+the continuous-batching :class:`DecodeScheduler` — chunked prefill, prefix
+cache over the shared prompt template, speculative decoding, per-request
+traces — instead of the static-batch ``generate()`` program.
 
-The cast+reshard runs once per generate() call and is cached against
-``state.step``, so repeated rollouts between updates reuse the copy.
+Config (``hybrid_engine`` section)::
+
+    "hybrid_engine": {
+        "enabled": true,
+        "max_out_tokens": 2048,     # inference-side cache budget
+        "kernel_inject": false,     # Pallas decode path (default: model's)
+        "gen_steps": 1,             # N rollout collect rounds per publication
+        "ppo_epochs": 1,            # M update passes per rollout buffer
+        "pad_token_id": 0,
+        "rollout": {"num_slots": 8, ...}   # continuous_batching overrides
+    }
 """
 
 import jax
 import jax.numpy as jnp
 
-from ..inference.config import DeepSpeedInferenceConfig
 from ..inference.engine import InferenceEngine
+from ..rlhf import RolloutBuffer, RolloutCollector, WeightPublisher
 from ..utils.logging import log_dist
 from .engine import DeepSpeedEngine
 
 
+def default_ppo_update(engine, batch):
+    """The minimal PPO-shaped update hook: one ``train_batch`` on the
+    rollout sequences (language-model loss over prompt+completion — the
+    DeepSpeed-Chat actor's pretraining-mix step). ``labels`` carries the
+    pre-shifted targets with ``-100`` on padding, so ragged rollouts never
+    spend gradient learning to emit the pad token. The full PPO-shaped
+    batch (``loss_mask``/``old_logprobs``/``rewards``/``advantages``) is
+    on ``batch`` for custom hooks that implement a clipped policy-gradient
+    objective."""
+    return engine.train_batch(batch={"input_ids": batch["input_ids"],
+                                     "labels": batch["labels"]})
+
+
 class DeepSpeedHybridEngine(DeepSpeedEngine):
-    """Training engine + shared-weight generation (reference :32)."""
+    """Training engine + shared-weight rollout generation (reference :32)."""
 
     def __init__(self, model, **kwargs):
         super().__init__(model, **kwargs)
         hcfg = dict(self._config.raw_config.get("hybrid_engine", {}))
         hcfg.pop("enabled", None)
+        self.gen_steps = int(hcfg.pop("gen_steps", 1))
+        self.ppo_epochs = int(hcfg.pop("ppo_epochs", 1))
+        self.pad_token_id = int(hcfg.pop("pad_token_id", 0))
+        rollout = dict(hcfg.pop("rollout", {}))
+        rollout.setdefault("enabled", True)
         # inference side runs on the SAME mesh; tp degree is the mesh's
         infer_cfg = {
             "dtype": "bfloat16" if self.compute_dtype == jnp.bfloat16 else
@@ -44,33 +76,20 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
             "kernel_inject": hcfg.pop("kernel_inject",
                                       getattr(getattr(model, "cfg", None), "attention_impl", "xla")
                                       == "flash"),
+            "continuous_batching": rollout,
         }
-        self._infer = InferenceEngine.__new__(InferenceEngine)  # shared-weight construction below
-        self._init_shared_inference(model, infer_cfg)
-        self._gen_params_step = None
-        self._in_train_mode = True
-        log_dist("HybridEngine ready: train + shared-weight generate() on one mesh", [0])
-
-    def _init_shared_inference(self, model, infer_cfg):
-        """Build the inference engine around the live training params instead
-        of letting it materialize its own."""
-        import dataclasses
-        from .lora import LoRAModel
-        inf = self._infer
-        inf._config = DeepSpeedInferenceConfig(infer_cfg)
-        overrides = {"dtype": self.compute_dtype}
-        if inf._config.kernel_inject:
-            overrides["attention_impl"] = "flash"
         # generation always runs the INNER model over merged/fused weights;
         # the LoRA wrapper only matters on the training side
+        from .lora import LoRAModel
         inner = model.inner if isinstance(model, LoRAModel) else model
-        inf.module = type(inner)(dataclasses.replace(inner.cfg, **overrides))
-        inf.model_config = inf.module.cfg
-        inf.mesh = self.mesh
-        inf.planner = self.planner
-        inf.params = None  # refreshed per generate()
-        inf._compiled = {}
-        inf._cache_pool = {}
+        # the supported shared-params construction path: full config
+        # validation + engine setup, weights installed by the publisher
+        self._infer = InferenceEngine.from_shared_params(inner, infer_cfg)
+        self.publisher = WeightPublisher(self, self._infer)
+        self.collector = RolloutCollector(self._infer)
+        self._in_train_mode = True
+        log_dist("HybridEngine ready: train + scheduler-served rollouts with "
+                 "in-memory weight publication on one mesh", [0])
 
     # ------------------------------------------------------------------ modes
     def eval(self):
@@ -83,55 +102,100 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         return self
 
     # ------------------------------------------------------------------ weights
-    def _refresh_generation_params(self):
-        """Cast master -> compute dtype in the inference layout (merging LoRA
-        adapters unless they are already fused into base); cached until the
-        next optimizer step changes the weights."""
-        step = int(self.state.step)
-        fused = getattr(self, "_lora_fused", False)
-        if self._gen_params_step == (step, fused) and self._infer.params is not None:
-            return
-        lora = self._lora()
-        cast = lambda t: jax.tree_util.tree_map(
-            lambda x: jnp.asarray(x, self.compute_dtype), t)
-        if self.offload_optimizer and lora is None:
-            # compute params ARE the live weights already
-            self._infer.params = self.state.params
-        else:
-            key = "hybrid_cast_fused" if fused else "hybrid_cast"
-            if key not in self._compiled:
-                if lora is None:
-                    fn = cast
-                elif fused:
-                    fn = lambda p: cast(p["base"])
-                else:
-                    fn = lambda p: cast(lora.merge(p))
-                abstract = jax.eval_shape(fn, self.state.params)
-                shardings = self.planner.shardings(self.planner.master_specs(abstract))
-                self._compiled[key] = jax.jit(fn, out_shardings=shardings)
-            with self.mesh:
-                self._infer.params = self._compiled[key](self.state.params)
-        self._gen_params_step = (step, fused)
+    def publish_weights(self):
+        """Publish the current training weights to the inference side — an
+        in-memory cast+reshard installed through the scheduler's
+        ``pause/flush/swap/resume`` protocol (no checkpoint round-trip, no
+        new XLA programs after the first cycle, all retained KV and prefix
+        registrations invalidated). No-op while the live publication is
+        already current. Returns the live
+        :class:`~deepspeed_tpu.rlhf.Publication`."""
+        # build the scheduler first so even the FIRST publication lands
+        # through the swap protocol (published_version tagged from cycle 1)
+        return self.publisher.publish(self._infer.scheduler())
+
+    # ------------------------------------------------------------------ rollouts
+    def rollout_scheduler(self, **overrides):
+        """The inference side's continuous-batching scheduler (built from
+        ``hybrid_engine.rollout`` on first use). The live weights are
+        (re-)published through it on first use, so a bare ``submit()``
+        never dispatches against an empty shared-params engine and the
+        scheduler's version bookkeeping can't desync from a publication
+        installed before the scheduler existed (legacy ``generate()``
+        first). Publishing NEW weights stays explicit
+        (:meth:`publish_weights` / :meth:`rlhf_step`) — this only repairs
+        a missing install."""
+        sched = self._infer.scheduler(**overrides)
+        if (self.publisher.live is None
+                or sched.published_version != self.publisher.live.version):
+            self.publisher.publish(sched)
+        return sched
+
+    def collect_rollouts(self, prompts, buffer=None, reward_fn=None, **gen_kwargs):
+        """One rollout round under the CURRENT weights: publish (cached),
+        then every prompt through the scheduler — chunked prefill, radix
+        hits on shared prompt prefixes, speculation if configured — into a
+        :class:`~deepspeed_tpu.rlhf.RolloutBuffer` with old-logprob capture
+        at the publication version."""
+        pub = self.publish_weights()
+        buf = self.collector.collect(prompts, buffer=buffer, reward_fn=reward_fn,
+                                     version=pub.version, **gen_kwargs)
+        if self.telemetry.enabled:
+            self.telemetry.gauge("rlhf/staleness_steps",
+                                 self.publisher.staleness_steps())
+        return buf
+
+    def rlhf_step(self, prompts, reward_fn=None, update_fn=None, gen_steps=None,
+                  ppo_epochs=None, seed=0, **gen_kwargs):
+        """One full train -> generate -> train cycle (the DeepSpeed-Chat
+        alternation): publish the current weights, run ``gen_steps`` rollout
+        rounds over ``prompts`` through the scheduler, then ``ppo_epochs``
+        update passes over the collected buffer via ``update_fn(engine,
+        ppo_batch)`` (default: :func:`default_ppo_update`). Returns
+        ``(buffer, losses)``; the NEXT call publishes the updated weights,
+        so staleness is bounded by ``ppo_epochs`` optimizer steps."""
+        n = self.gen_steps if gen_steps is None else int(gen_steps)
+        m = self.ppo_epochs if ppo_epochs is None else int(ppo_epochs)
+        pub = self.publish_weights()
+        buf = RolloutBuffer()
+        for i in range(n):
+            self.collector.collect(prompts, buffer=buf, reward_fn=reward_fn,
+                                   version=pub.version,
+                                   seed=seed + i * len(prompts), **gen_kwargs)
+        update = default_ppo_update if update_fn is None else update_fn
+        bs = self.train_batch_size() // jax.process_count()
+        mc = getattr(self.module, "cfg", None) or \
+            getattr(getattr(self.module, "inner", None), "cfg", None)
+        losses = []
+        for i in range(m):
+            batch = buf.ppo_batch(bs, pad_token_id=self.pad_token_id, start=i * bs,
+                                  max_len=getattr(mc, "max_seq_len", None))
+            losses.append(float(update(self, batch)))
+        if self.telemetry.enabled:
+            self.telemetry.gauge("rlhf/staleness_steps",
+                                 self.publisher.staleness_steps())
+        return buf, losses
 
     # ------------------------------------------------------------------ generate
     def generate(self, input_ids, **kwargs):
         """RLHF rollout generation against the current training weights
         (reference ``generate`` :168). Accepts the InferenceEngine.generate
-        signature."""
-        self._refresh_generation_params()
+        signature; batch-shaped legacy path — :meth:`collect_rollouts` is
+        the scheduler-served loop."""
+        self.publisher.publish()
         return self._infer.generate(input_ids, **kwargs)
 
     def infer_forward(self, input_ids, attention_mask=None):
         """Inference-mode logits over full sequences (scoring/reward paths)."""
-        self._refresh_generation_params()
+        self.publisher.publish()
         return self._infer.forward(input_ids, attention_mask)
 
     # ------------------------------------------------------------------ LoRA
     # Reference fuse_lora_weight :129: DeepSpeed-Chat bakes the adapters into
     # the base weights around the rollout phase so generation pays no per-call
     # merge. Here the module is a runtime.lora.LoRAModel and fusing rewrites
-    # state.params["base"] in place (donated jit); generate() then skips the
-    # per-call merge by handing the INNER model the fused base directly.
+    # state.params["base"] in place (donated jit); the publisher's snapshot
+    # key includes the fusion flag, so the next publish re-casts.
     def _lora(self):
         from .lora import LoRAModel
         return self.module if isinstance(self.module, LoRAModel) else None
@@ -151,7 +215,6 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         with self.mesh:
             self.state = self.state._replace(params=self._compiled["lora_fuse"](self.state.params))
         self._lora_fused = True
-        self._gen_params_step = None  # generation cache now stale
         return None
 
     def unfuse_lora_weight(self, quantize=False):
@@ -161,5 +224,4 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         with self.mesh:
             self.state = self.state._replace(params=self._compiled["lora_unfuse"](self.state.params))
         self._lora_fused = False
-        self._gen_params_step = None
         return None

@@ -1,0 +1,214 @@
+"""The train -> generate -> train loop: orchestration, telemetry, and the
+zero-new-XLA-programs-per-publish-cycle guard."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.models import get_model
+from deepspeed_tpu.rlhf import RolloutBuffer
+from deepspeed_tpu.rlhf.rollout import _logprobs_of
+
+PROMPTS = [list(range(1, 9)), list(range(3, 11)), [7, 8, 9], [1, 2, 3, 4, 5]]
+
+
+def make_hybrid(telemetry=None, rollout=None, **hybrid_over):
+    comm._state["mesh"] = None
+    from deepspeed_tpu.telemetry import set_sink
+    set_sink(None)
+    model = get_model("tiny", dtype=jnp.float32, max_seq_len=256)
+    hybrid = {"enabled": True, "max_out_tokens": 256,
+              "rollout": dict(rollout or {"num_slots": 4})}
+    hybrid.update(hybrid_over)
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000,
+           "hybrid_engine": hybrid}
+    if telemetry:
+        cfg["telemetry"] = telemetry
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    return engine
+
+
+def train_batch(seed=0, B=8, T=64):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, (B, T)).astype(np.int32)}
+
+
+def test_rlhf_step_train_generate_train():
+    """The DeepSpeed-Chat alternation on the modern stack: each cycle
+    publishes, collects rollouts through the scheduler, and updates; the
+    next cycle's rollouts decode under the UPDATED weights (new
+    publication version), and old logprobs ride each sample."""
+    engine = make_hybrid(gen_steps=2, ppo_epochs=2)
+    rewards = []
+
+    def reward_fn(prompt, toks):
+        r = float(len(set(int(t) for t in toks)))
+        rewards.append(r)
+        return r
+
+    buf1, losses1 = engine.rlhf_step(PROMPTS, reward_fn=reward_fn, max_new_tokens=8)
+    assert len(buf1) == 2 * len(PROMPTS) and buf1.versions() == [1]
+    assert len(losses1) == 2 and all(np.isfinite(l) for l in losses1)
+    assert len(rewards) == len(buf1)
+    assert all(len(s.logprobs) == len(s.tokens) == 8 for s in buf1.samples)
+    assert engine.global_steps == 2
+    assert engine.publisher.staleness_steps() == 2  # M updates since publish
+
+    buf2, losses2 = engine.rlhf_step(PROMPTS, reward_fn=reward_fn, max_new_tokens=8)
+    assert buf2.versions() == [2]  # rollouts decode under the new publication
+    assert engine.rollout_scheduler().published_version == 2
+    assert engine.publisher.live.step == 2
+
+
+def test_rollouts_ride_the_scheduler_stack():
+    """Rollouts get the serving stack: shared prompt templates land radix
+    prefix hits across collect rounds within one publication."""
+    engine = make_hybrid()
+    shared = list(range(1, 100))
+    prompts = [shared + [200 + i] for i in range(4)]
+    engine.collect_rollouts(prompts, max_new_tokens=4)
+    sched = engine.rollout_scheduler()
+    assert sched.radix is not None and sched.radix.hits > 0
+    assert sched.cache.total_allocs >= len(prompts)
+
+
+def test_custom_update_hook_sees_ppo_shape():
+    """A custom update hook receives the PPO-shaped batch (masked old
+    logprobs, rewards, group-baselined advantages)."""
+    engine = make_hybrid()
+    seen = []
+
+    def hook(eng, batch):
+        seen.append(batch)
+        assert set(batch) == {"input_ids", "labels", "loss_mask",
+                              "old_logprobs", "rewards", "advantages"}
+        B, T = batch["input_ids"].shape
+        assert B == 8
+        assert batch["loss_mask"].shape == (B, T)
+        # logprobs live exactly on completion tokens and are negative
+        on = batch["loss_mask"] > 0
+        assert (batch["old_logprobs"][on] < 0).all()
+        assert (batch["old_logprobs"][~on] == 0).all()
+        assert abs(float(batch["advantages"].mean())) < 1e-5
+        # labels are pre-shifted and mask ALL padding (no pad-token learning)
+        ids, labels = batch["input_ids"], batch["labels"]
+        for i in range(B):
+            real = int((labels[i] >= 0).sum())
+            np.testing.assert_array_equal(labels[i, :real], ids[i, 1:real + 1])
+            assert (labels[i, real:] == -100).all()
+        return eng.train_batch(batch={"input_ids": ids, "labels": labels})
+
+    engine.rlhf_step(PROMPTS, reward_fn=lambda p, t: float(t[0]),
+                     update_fn=hook, max_new_tokens=6)
+    assert len(seen) == 1
+
+
+def test_rlhf_telemetry_rows(tmp_path):
+    """rlhf/{publish_ms,rollout_tok_s,staleness_steps,kv_invalidated_tokens}
+    reach the sink snapshot (the PR 1/8 pipeline)."""
+    engine = make_hybrid(telemetry={"enabled": True, "output_path": str(tmp_path)})
+    engine.rlhf_step(PROMPTS, max_new_tokens=6)
+    engine.rlhf_step(PROMPTS, max_new_tokens=6)
+    snap = engine.telemetry.snapshot()
+    assert snap["counters"]["rlhf/publications"]["count"] == 2
+    assert snap["counters"]["rlhf/weight_swaps"]["count"] == 2
+    # cycle 2's swap invalidated cycle 1's retained rollout prefixes
+    assert snap["counters"]["rlhf/kv_invalidated_tokens"]["total"] > 0
+    assert snap["counters"]["rlhf/rollout_tokens"]["total"] == 2 * len(PROMPTS) * 6
+    assert snap["gauges"]["rlhf/rollout_tok_s"] > 0
+    assert snap["gauges"]["rlhf/staleness_steps"] == 1.0  # one update per cycle
+    assert snap["histograms"]["rlhf/publish_ms"]["count"] == 2
+    engine.telemetry.close()
+    # rollouts ride PR 8 request tracing: per-rollout req/* span trees and
+    # the rlhf/publish span land in the JSONL stream
+    import glob
+    jsonl = ""
+    for f in glob.glob(str(tmp_path / "**" / "telemetry.jsonl"), recursive=True):
+        with open(f) as fh:
+            jsonl += fh.read()
+    assert '"req/decode"' in jsonl and '"rollout": true' in jsonl
+    assert '"rlhf/publish"' in jsonl
+
+
+_XLA_COMPILES = []  # registered once: jax.monitoring listeners can't detach
+
+
+def _count_xla_compiles():
+    if not _XLA_COMPILES:
+        _XLA_COMPILES.append("registered")
+        jax.monitoring.register_event_duration_secs_listener(
+            lambda name, *a, **kw: _XLA_COMPILES.append(name)
+            if name == "/jax/core/compile/backend_compile_duration" else None)
+    return _XLA_COMPILES
+
+
+def test_publish_cycle_compile_count_zero_after_warmup():
+    """The swap protocol's whole point of staying in the scheduler's
+    compiled-program regime: after the first full publish cycle, further
+    train -> publish -> rollout cycles add ZERO new XLA programs (the cast
+    program is cached, the step programs take params as an argument, and
+    the swap itself is host bookkeeping)."""
+    engine = make_hybrid()
+    # warm cycle: compiles the train step, cast program, scheduler programs
+    engine.rlhf_step(PROMPTS, max_new_tokens=6)
+    sched = engine.rollout_scheduler()
+    n_sched_programs = sched.compiled_program_count()
+    compiles = _count_xla_compiles()
+    n_before = len(compiles)
+    for _ in range(2):
+        engine.rlhf_step(PROMPTS, max_new_tokens=6)
+    n_new = len(compiles) - n_before
+    assert n_new == 0, f"publish cycles compiled {n_new} new XLA programs"
+    assert sched.compiled_program_count() == n_sched_programs
+    assert sched.weights_version == 3  # and the swaps really happened
+
+
+# ---------------------------------------------------------------- units
+def test_logprobs_of_matches_log_softmax():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(5, 11)).astype(np.float32)
+    toks = rng.integers(0, 11, 5)
+    got = _logprobs_of(logits, toks)
+    ref = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+    ref = np.asarray(ref)[np.arange(5), toks]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    assert _logprobs_of(logits, np.zeros(0, np.int32)).shape == (0, )
+
+
+def test_rollout_buffer_cycles_and_pads():
+    buf = RolloutBuffer()
+    from deepspeed_tpu.rlhf import RolloutSample
+    buf.add(RolloutSample([1, 2], [3, 4, 5], [-0.1, -0.2, -0.3], 1.0, 1))
+    buf.add(RolloutSample([9], [8], [-0.5], 3.0, 1))
+    b = buf.ppo_batch(4, pad_token_id=0, bucket=None)  # exact-length padding
+    assert b["input_ids"].shape == (4, 5)
+    np.testing.assert_array_equal(b["input_ids"][0], [1, 2, 3, 4, 5])
+    np.testing.assert_array_equal(b["input_ids"][1], [9, 8, 0, 0, 0])
+    np.testing.assert_array_equal(b["input_ids"][2], b["input_ids"][0])  # cycles
+    # labels: pre-shifted, -100 everywhere past the real tokens
+    np.testing.assert_array_equal(b["labels"][0], [2, 3, 4, 5, -100])
+    np.testing.assert_array_equal(b["labels"][1], [8, -100, -100, -100, -100])
+    assert b["rewards"].tolist() == [1.0, 3.0, 1.0, 3.0]
+    assert abs(float(b["advantages"].mean())) < 1e-6
+    assert buf.total_tokens() == 4 and buf.versions() == [1]
+    with pytest.raises(ValueError, match="empty"):
+        RolloutBuffer().ppo_batch(2)
+
+
+def test_ppo_batch_buckets_lengths():
+    """Row lengths round up to pow2 buckets (one compiled train program per
+    bucket across rotating prompt sets), capped at max_len."""
+    from deepspeed_tpu.rlhf import RolloutSample
+    buf = RolloutBuffer()
+    buf.add(RolloutSample(list(range(40)), [1, 2, 3], [-0.1] * 3, 0.0, 1))
+    assert buf.ppo_batch(2)["input_ids"].shape == (2, 64)        # floor bucket
+    buf.add(RolloutSample(list(range(70)), [1, 2, 3], [-0.1] * 3, 0.0, 1))
+    assert buf.ppo_batch(2)["input_ids"].shape == (2, 128)       # next pow2
+    assert buf.ppo_batch(2, max_len=100)["input_ids"].shape == (2, 100)  # cap
+    with pytest.raises(ValueError, match="exceed max_len"):
+        buf.ppo_batch(2, max_len=64)

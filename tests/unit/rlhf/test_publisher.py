@@ -1,0 +1,322 @@
+"""Weight-publication correctness: the RLHF hybrid engine's in-memory
+publish must be indistinguishable from loading the same weights into a
+fresh engine (bit-identical rollouts), never write a checkpoint, and never
+let KV computed under one weights version serve another."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.inference.engine import InferenceEngine
+from deepspeed_tpu.inference.kv_cache import RadixPrefixCache, SlotKVCache
+from deepspeed_tpu.models import get_model
+
+PROMPTS = [list(range(1, 9)), list(range(3, 11)), [7, 8, 9], [1, 2, 3, 4, 5]]
+
+
+def make_hybrid(rollout=None, **over):
+    comm._state["mesh"] = None
+    from deepspeed_tpu.telemetry import set_sink
+    set_sink(None)  # sink hermeticity across tests
+    model = get_model("tiny", dtype=jnp.float32, max_seq_len=256)
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "steps_per_print": 1000,
+           "hybrid_engine": {"enabled": True, "max_out_tokens": 256,
+                             "rollout": dict(rollout or {"num_slots": 4})}}
+    cfg.update(over)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg, rng_seed=0)
+    return engine
+
+
+def train_batch(seed=0, B=8, T=64):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, 256, (B, T)).astype(np.int32)}
+
+
+def fresh_reference_engine(params, rollout=None):
+    """A from-scratch InferenceEngine loaded with ``params`` — the
+    checkpoint-round-trip baseline a publication must match bit-for-bit."""
+    comm._state["mesh"] = None
+    from deepspeed_tpu.telemetry import set_sink
+    set_sink(None)
+    model = get_model("tiny", dtype=jnp.float32, max_seq_len=256)
+    cb = {"enabled": True, "num_slots": 4}
+    cb.update(rollout or {})
+    return deepspeed_tpu.init_inference(
+        model, config={"dtype": "float32", "max_out_tokens": 256,
+                       "continuous_batching": cb}, params=params)
+
+
+def rollout_stream(sched, *, sampled):
+    """A mixed greedy/sampled request stream with per-request seeds;
+    returns (tokens, logits) lists in submit order."""
+    handles = []
+    for i, p in enumerate(PROMPTS):
+        handles.append(sched.submit(
+            p, max_new_tokens=8, do_sample=sampled and i % 2 == 0,
+            temperature=0.8, top_k=12, seed=100 + i, collect_logits=True))
+    return ([h.result() for h in handles],
+            [h.result_logits() for h in handles])
+
+
+@pytest.mark.parametrize("rollout,sampled", [
+    ({"num_slots": 4}, False),                      # radix on (default)
+    ({"num_slots": 4}, True),                       # sampled mix, radix on
+    ({"num_slots": 4, "prefix_cache": False}, False),   # radix off
+    ({"num_slots": 4, "spec_tokens": 3}, True),     # speculation on
+])
+def test_publish_bit_identical_to_fresh_engine(rollout, sampled):
+    """Generate-after-publish == generate from a fresh engine loaded with
+    the same params: tokens AND per-step logits, greedy and sampled, with
+    and without radix/speculation."""
+    engine = make_hybrid(rollout=rollout)
+    for i in range(2):
+        engine.train_batch(batch=train_batch(i))
+    pub = engine.publish_weights()
+    toks_h, logits_h = rollout_stream(engine.rollout_scheduler(), sampled=sampled)
+
+    ref = fresh_reference_engine(engine._infer.params, rollout=rollout)
+    toks_r, logits_r = rollout_stream(ref.scheduler(), sampled=sampled)
+    for a, b in zip(toks_h, toks_r):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(logits_h, logits_r):
+        assert a.dtype == b.dtype and (a == b).all()
+    assert pub.version == 1 and pub.step == 2
+
+
+def test_publish_is_in_memory_no_checkpoint_files(tmp_path, monkeypatch):
+    """The whole publish cycle writes NOTHING to disk (the point of the
+    subsystem: zero checkpoint round-trips)."""
+    monkeypatch.chdir(tmp_path)
+    engine = make_hybrid()
+    engine.train_batch(batch=train_batch(0))
+    engine.publish_weights()
+    engine.collect_rollouts(PROMPTS, max_new_tokens=6)
+    engine.train_batch(batch=train_batch(1))
+    engine.publish_weights()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_publication_cached_until_weights_move():
+    """The snapshot is step-keyed: rollouts between updates reuse the SAME
+    tree (identity — nothing re-casts or re-keys downstream); an optimizer
+    step cuts a fresh version."""
+    engine = make_hybrid()
+    p1 = engine.publish_weights()
+    p1b = engine.publish_weights()
+    assert p1 is p1b and engine._infer.params is p1.params
+    engine.train_batch(batch=train_batch(0))
+    p2 = engine.publish_weights()
+    assert p2.version == p1.version + 1
+    assert p2.params is not p1.params
+    # published values equal the new masters cast to compute dtype
+    m = jax.tree_util.tree_leaves(engine.state.params)[0]
+    g = jax.tree_util.tree_leaves(engine._infer.params)[0]
+    np.testing.assert_allclose(np.asarray(m, np.float32), np.asarray(g, np.float32),
+                               rtol=1e-6)
+
+
+def test_prefix_cache_never_crosses_weights_version():
+    """A prefix registered under version v must NOT be reused after a swap
+    to v+1: the trie is invalidated, the re-submitted identical prompt
+    misses, and the pool invariants (version stamps included) hold."""
+    engine = make_hybrid()
+    sched = engine.rollout_scheduler()
+    assert sched.radix is not None
+    shared = list(range(1, 80))  # > prefill_chunk so a hit would be visible
+    sched.submit(shared, max_new_tokens=4).result()
+    assert sched.radix.registered_slots()  # prefix retained for reuse
+    hits_before = sched.radix.hits
+    # same prompt again WITHOUT a swap: the radix hit must land (sanity)
+    sched.submit(shared, max_new_tokens=4).result()
+    assert sched.radix.hits == hits_before + 1
+
+    engine.train_batch(batch=train_batch(0))
+    v_before = sched.weights_version
+    invalidated_before = sched.radix.invalidations
+    engine.publish_weights()  # pause -> flush -> swap -> resume
+    assert sched.weights_version == v_before + 1
+    assert sched.radix.invalidations == invalidated_before + 1
+    assert sched.radix.registered_slots() == []  # nothing survived the swap
+    hits_after_swap = sched.radix.hits
+    sched.submit(shared, max_new_tokens=4).result()
+    # the stale prefix was NOT reused: this admission was a miss
+    assert sched.radix.hits == hits_after_swap
+    assert sched.radix.misses > 0
+    sched.cache.check_invariants()
+
+
+def test_swap_mid_stream_flushes_then_swaps():
+    """publish() during an in-flight stream: pause gates admission, flush
+    completes the live rows under the OLD weights, the swap lands, and the
+    queued rows then decode under the NEW weights."""
+    engine = make_hybrid(rollout={"num_slots": 2})
+    sched = engine.rollout_scheduler()
+    handles = [sched.submit(PROMPTS[i % len(PROMPTS)], max_new_tokens=6)
+               for i in range(5)]
+    sched.step()  # some rows in flight, some queued
+    assert sched.active or sched._prefill is not None
+    engine.train_batch(batch=train_batch(0))
+    engine.publish_weights()
+    assert not sched._paused  # resume() ran
+    # queued rows still complete (under the new weights)
+    for h in handles:
+        assert h.result().size == 6
+    sched.cache.check_invariants()
+
+
+def test_swap_weights_requires_flush():
+    """swap_weights with live rows is a hard error — the protocol, not
+    convention, prevents serving mixed-weights KV."""
+    engine = make_hybrid()
+    sched = engine.rollout_scheduler()
+    sched.submit(list(range(1, 70)), max_new_tokens=32)
+    sched.step()
+    assert sched.active or sched._prefill is not None
+    with pytest.raises(ValueError, match="pause\\(\\) and flush\\(\\)"):
+        sched.swap_weights(engine._infer.params)
+    sched.flush()
+    sched.swap_weights(engine._infer.params)  # now legal
+    sched.resume()
+
+
+def test_scheduler_built_after_legacy_generate_resyncs_versions():
+    """Legacy path first: generate() publishes before any scheduler exists
+    (plain assignment). A scheduler built afterwards must re-install the
+    live publication through the swap protocol so its version bookkeeping
+    matches the publisher's — rollouts can't get tagged version 0 while
+    publication 1 is live."""
+    engine = make_hybrid()
+    engine.generate([list(range(1, 9))], max_new_tokens=2)  # pre-scheduler publish
+    assert engine.publisher.live is not None and engine._infer._scheduler is None
+    sched = engine.rollout_scheduler()
+    assert sched.published_version == engine.publisher.live.version == 1
+    buf = engine.collect_rollouts([PROMPTS[0]], max_new_tokens=4)
+    assert buf.versions() == [1]
+    engine.train_batch(batch=train_batch(0))
+    engine.publish_weights()
+    assert sched.published_version == 2
+
+
+def test_collect_failure_cancels_remaining_rollouts():
+    """A reward_fn that raises mid-harvest must not strand the rest of the
+    round in slots on the shared scheduler."""
+    engine = make_hybrid()
+    sched = engine.rollout_scheduler()
+
+    def bad_reward(prompt, toks):
+        raise RuntimeError("reward model down")
+
+    with pytest.raises(RuntimeError, match="reward model down"):
+        engine.collect_rollouts([PROMPTS[i % len(PROMPTS)] for i in range(6)],
+                                reward_fn=bad_reward, max_new_tokens=4)
+    sched.step()  # one pump reaps the cancelled requests
+    assert sched.cache.active_slots == 0 and not sched.queue
+    sched.cache.check_invariants()
+    # the scheduler is still serviceable
+    out = sched.submit(PROMPTS[0], max_new_tokens=3).result()
+    assert out.size == 3
+
+
+def test_publish_from_param_stream_masters():
+    """ZeRO-Infinity offload path: masters live in host blocks (PR 5's
+    owned ``get_params_tree``); the publication assembles + casts them and
+    scheduler rollouts work — still with no checkpoint round-trip."""
+    comm._state["mesh"] = None
+    from deepspeed_tpu.telemetry import set_sink
+    set_sink(None)
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3, "offload_param": {"device": "cpu"}},
+           "steps_per_print": 1000,
+           "hybrid_engine": {"enabled": True, "max_out_tokens": 128,
+                             "rollout": {"num_slots": 2}}}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=get_model("tiny"),
+                                               config=cfg, rng_seed=0)
+    assert engine.param_stream is not None
+    engine.train_batch(batch=train_batch(0, T=16))
+    buf = engine.collect_rollouts([[1, 2, 3], [4, 5, 6]], max_new_tokens=4)
+    assert len(buf) == 2 and all(len(s) == 4 for s in buf.samples)
+    # the publication equals the host masters cast to the compute dtype
+    host = engine.param_stream.get_params_tree()
+    h = jax.tree_util.tree_leaves(host)[0]
+    g = jax.tree_util.tree_leaves(engine._infer.params)[0]
+    np.testing.assert_allclose(np.asarray(h, np.float32),
+                               np.asarray(g, np.float32), rtol=1e-2, atol=1e-2)
+
+
+# ---------------------------------------------------------------- structural
+def host_cache(n=4):
+    return SlotKVCache(None, n, 64)
+
+
+def test_version_stamps_structural():
+    """The version tags make cross-version reuse impossible at the data-
+    structure layer: stale retain raises, stale trie insert raises, stale
+    registrations are unmatchable, and bumping with resident rows raises."""
+    kv = host_cache()
+    radix = RadixPrefixCache(kv)
+    s = kv.alloc()
+    kv.lengths[s] = 8
+    radix.insert(s, list(range(8)))
+    # bump with a live slot: refused
+    with pytest.raises(ValueError, match="drain"):
+        kv.bump_weights_version()
+    # stale retain: simulate a version bump racing a live slot
+    kv.slot_version[s] = -1
+    with pytest.raises(ValueError, match="stale"):
+        kv.retain(s)
+    # stale registration is never matched
+    assert radix.match(list(range(8))) == (0, None)
+    # a stale slot cannot (re-)register
+    radix.remove(s)
+    with pytest.raises(ValueError, match="stale"):
+        radix.insert(s, list(range(8)))
+    kv.free(s)
+    v = kv.bump_weights_version()
+    s2 = kv.alloc()
+    assert kv.slot_version[s2] == v  # fresh alloc stamps the new version
+    kv.lengths[s2] = 4
+    radix.insert(s2, [1, 2, 3, 4])
+    kv.retain(s2)  # current-version retain is fine
+    kv.check_invariants()
+
+
+def test_invalidate_all_counts_and_reclaims():
+    kv = host_cache()
+    radix = RadixPrefixCache(kv)
+    for i, toks in enumerate(([1, 2, 3], [1, 2, 4, 5])):
+        s = kv.alloc()
+        kv.lengths[s] = len(toks)
+        radix.insert(s, toks)
+        kv.retain(s)
+    live = kv.alloc()
+    kv.lengths[live] = 2
+    radix.insert(live, [9, 9])
+    with pytest.raises(ValueError, match="live"):
+        radix.invalidate_all()  # live registration pins the trie
+    radix.remove(live)
+    kv.free(live)
+    assert radix.invalidate_all() == 7  # 3 + 4 retained tokens dropped
+    assert kv.cached_slots == 0 and kv.free_slots == kv.num_slots
+    kv.bump_weights_version()
+    kv.check_invariants()
+
+
+def test_from_shared_params_validates_config():
+    """The supported shared-params constructor runs full config validation
+    (the __new__ hack silently skipped it)."""
+    comm._state["mesh"] = None
+    model = get_model("tiny", dtype=jnp.float32, max_seq_len=256)
+    with pytest.raises(ValueError, match="Invalid inference dtype"):
+        InferenceEngine.from_shared_params(model, {"dtype": "float13"})
+    with pytest.raises(ValueError, match="int8"):
+        InferenceEngine.from_shared_params(model, {"dtype": "int8"})
+    eng = InferenceEngine.from_shared_params(model, {"dtype": "float32",
+                                                     "max_out_tokens": 128})
+    assert eng.params is None  # nothing materialized until a publication
+    assert eng.telemetry is not None and eng._scheduler is None

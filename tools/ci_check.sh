@@ -2,11 +2,12 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
 #   tools/ci_check.sh --observability  # tracing/SLO/flight-recorder lane only
+#   tools/ci_check.sh --rlhf     # RLHF hybrid-engine lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -42,6 +43,20 @@ offload_lane() {
   # (BENCH_OFFLOAD_STREAM JSON: depth 0 vs 2 step time + overlap_efficiency).
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/unit/test_offload_stream.py -q -p no:cacheprovider
+}
+
+rlhf_lane() {
+  echo "== rlhf hybrid-engine lane =="
+  # weight-publication guards: generate-after-publish bit-identical to a
+  # fresh engine on the same params (greedy + sampled, radix/spec on/off),
+  # no KV/prefix reuse across a weights version (structural version tags),
+  # in-memory publish writes no checkpoint files, and the publish cycle
+  # adds ZERO new XLA programs after warmup
+  # (test_publish_cycle_compile_count_zero_after_warmup). The matching
+  # perf leg is `python bench.py rlhf` (BENCH_RLHF JSON: publish vs
+  # checkpoint round-trip + scheduler rollout tok/s).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/rlhf tests/unit/test_hybrid_engine.py -q -p no:cacheprovider
 }
 
 observability_lane() {
@@ -99,6 +114,10 @@ if [ "${1:-}" = "--observability" ]; then
   observability_lane
   exit $?
 fi
+if [ "${1:-}" = "--rlhf" ]; then
+  rlhf_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -126,7 +145,10 @@ gw_rc=$?
 observability_lane
 ob_rc=$?
 
+rlhf_lane
+rl_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ]
