@@ -338,6 +338,10 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
     _guard_leg(results, "shared_prefix",
                lambda: _shared_prefix_bench(make, num_slots, n_requests, max_new,
                                             seed, prefill_chunk))
+    _guard_leg(results, "replicas",
+               lambda: _replicas_bench(make, num_slots, max_new, seed,
+                                       n_replicas=int(os.environ.get(
+                                           "BENCH_SERVING_REPLICAS", "2"))))
     _guard_leg(results, "speculative",
                lambda: _speculative_bench(make, num_slots, n_requests, max_new, seed))
     _guard_leg(results, "kv_int8",
@@ -433,6 +437,117 @@ def _speculative_bench(make, num_slots, n_requests, max_new, seed, spec_tokens=4
         out["speculative"]["tokens_per_sec"]
         / max(out["baseline"]["tokens_per_sec"], 1e-9), 3)
     out["spec_tokens"] = spec_tokens
+    return out
+
+
+def _replicas_bench(make, num_slots, max_new, seed, n_replicas=2):
+    """Replica-scaling leg: the same prompt-family stream served by 1
+    scheduler replica vs ``n_replicas`` behind the ReplicaSet's dispatch
+    (prefix-sticky + least-loaded), single-threaded closed-loop pump.
+
+    The stream is built so its FAMILY working set (long shared prefixes,
+    cyclic access — LRU's worst case) overflows one replica's slot pool but
+    fits the fleet's: on this serial-CPU smoke the replica win is therefore
+    aggregate KV capacity — sticky routing keeps each replica's families
+    radix-RESIDENT, so prefill compute (the dominant cost at these prompt
+    lengths) collapses to prefix copies. On a pod each replica is its own
+    tensor-sharded chip group stepping in parallel (the gateway runs one
+    pump thread per replica), so compute scales on top of the capacity win
+    measured here. Reports per-leg tok/s, TTFT p95, aggregate prefix-cache
+    hit rate, the fleet speedup, and per-chip-style scaling efficiency."""
+    from deepspeed_tpu.serving import ReplicaSet
+
+    chunk = 16
+    # working set sized to overflow ONE pool (families ~= slots, plus the
+    # live rows competing for them) while a fleet of n holds families/n
+    # comfortably resident per replica
+    families = max(num_slots, 2 * n_replicas)
+    rounds = 3
+    out = {"replica_counts": sorted({1, n_replicas}), "families": families,
+           "rounds": rounds}
+    prompts = None
+    for n in sorted({1, n_replicas}):
+        eng = make(True)
+        rs = ReplicaSet.build(eng, n, num_slots=num_slots, prefill_chunk=chunk)
+        sched = rs.primary
+        if sched.radix is None or sched.prefill_chunk == 0:
+            return {"skipped": "replica leg needs the chunked radix path"}
+        budget = 2 * sched.steps_per_sync
+        cap = sched.max_len - max_new - budget
+        n_chunks = min(5, (cap - 8) // sched.prefill_chunk)
+        if n_chunks < 2:
+            return {"skipped": f"slot capacity {sched.max_len} too small for a "
+                               f"multi-chunk family prefix at max_new={max_new}"}
+        if prompts is None:
+            rng = np.random.default_rng(seed + 11)
+            V = eng.model_config.vocab_size
+            pre_len = n_chunks * sched.prefill_chunk
+            sfx_cap = min(8, cap - pre_len)
+            prefixes = [rng.integers(0, V, pre_len).astype(np.int32)
+                        for _ in range(families)]
+            # cyclic family order: each round revisits every family —
+            # exactly the access pattern that defeats one pool's LRU while
+            # a resident fleet serves it from the trie
+            prompts = [np.concatenate([prefixes[f % families],
+                                       rng.integers(0, V, int(rng.integers(2, sfx_cap)))
+                                       .astype(np.int32)])
+                       for f in range(families * rounds)]
+            out["prefix_tokens"] = int(pre_len)
+        # warm the program set on replica 0 (shared by every replica): one
+        # cold request + one repeat for the copy program, off the sticky map
+        warm = np.concatenate([np.full(pre_len, 3, np.int32), [7, 8, 9]])
+        sched.submit(warm, max_new_tokens=budget + 2).result()
+        sched.submit(warm, max_new_tokens=budget + 2).result()
+        for rep in rs:
+            if rep.scheduler.radix is not None:
+                rep.scheduler.radix.hits = rep.scheduler.radix.misses = 0
+                rep.scheduler.radix.evictions = 0
+        # closed-loop pump at the SAME offered concurrency for every leg
+        # (2 clients per FLEET-SIZED replica count): the single-replica leg
+        # serves the whole client population from one pool — live rows and
+        # retained prefixes fight for its slots — while the fleet spreads
+        # ~2 clients per replica and keeps families resident
+        live_cap = 2 * n_replicas
+        handles = []
+        i = 0
+        t0 = time.perf_counter()
+        while i < len(prompts) or any(not h.done for h in handles):
+            while (i < len(prompts)
+                   and sum(1 for h in handles if not h.done) < live_cap):
+                rep, h = rs.dispatch(prompts[i], max_new_tokens=max_new)
+                if h is None:
+                    break
+                handles.append(h)
+                i += 1
+            progressed = False
+            for rep in rs:
+                if not rep.idle():
+                    rep.step()
+                    progressed = True
+            if not progressed and i >= len(prompts):
+                break
+        dt = time.perf_counter() - t0
+        toks = sum(len(h.result()) for h in handles)
+        ttfts = sorted((h._req.first_token_ts - h._req.submit_ts) * 1e3
+                       for h in handles if h._req.first_token_ts is not None)
+        hits = sum(r.scheduler.radix.hits for r in rs)
+        misses = sum(r.scheduler.radix.misses for r in rs)
+        out[f"replicas{n}"] = {
+            "tokens_per_sec": round(toks / dt, 1),
+            "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 2) if ttfts else None,
+            "ttft_ms_p95": round(float(np.percentile(ttfts, 95)), 2) if ttfts else None,
+            "aggregate_hit_rate": round(hits / max(1, hits + misses), 3),
+            "evictions": sum(r.scheduler.radix.evictions for r in rs),
+            "dispatched_per_replica": [r.dispatched for r in rs],
+            "compiled_programs": rs.compiled_program_count(),
+        }
+    lo = out.get("replicas1", {})
+    hi = out.get(f"replicas{n_replicas}", {})
+    if lo.get("tokens_per_sec") and hi.get("tokens_per_sec"):
+        out["speedup"] = round(hi["tokens_per_sec"] / lo["tokens_per_sec"], 3)
+        out["scaling_efficiency"] = round(out["speedup"] / n_replicas, 3)
+        if lo.get("ttft_ms_p95") and hi.get("ttft_ms_p95"):
+            out["ttft_p95_speedup"] = round(lo["ttft_ms_p95"] / hi["ttft_ms_p95"], 3)
     return out
 
 

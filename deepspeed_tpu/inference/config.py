@@ -98,6 +98,13 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
                                  "~1.9x the resident slots per HBM byte at a small "
                                  "bounded logit error; 'bf16'/'fp32' force a plain "
                                  "cache at that precision")
+    replicas = ConfigField(default=1, help="data-parallel scheduler replicas behind "
+                           "the gateway (serving/replica.py): N independent slot "
+                           "pools (each tp-sharded per the mesh) sharing ONE "
+                           "compiled program set and one weight tree, with "
+                           "least-loaded + radix-prefix-sticky dispatch and "
+                           "per-replica drain/health; aggregate KV capacity and "
+                           "throughput scale with N at zero extra XLA programs")
 
 
 class GatewayConfig(DeepSpeedConfigModel):

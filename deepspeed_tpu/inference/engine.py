@@ -94,6 +94,20 @@ class InferenceEngine:
             raise ValueError("init_inference expects a deepspeed_tpu model (CausalLMModel or preset "
                              f"name); got {type(model)}")
 
+        # the mesh decides the EFFECTIVE tensor parallelism (a pre-existing
+        # mesh with tensor>1 shards serving even when the config left
+        # tp_size at 1), so resolve it BEFORE the model-config overrides
+        # that depend on it (int8 fused-qkv gating, the bitwise-TP layout)
+        tp = cfg.tensor_parallel.tp_size
+        if dist.has_mesh():
+            self.mesh = dist.get_mesh()
+            if self.mesh.shape[dist.TENSOR_AXIS] != tp and tp > 1:
+                raise ValueError(f"existing mesh has tensor={self.mesh.shape[dist.TENSOR_AXIS]}, "
+                                 f"config asks tp_size={tp}")
+        else:
+            self.mesh = dist.initialize_mesh(tensor=tp)
+        tp_eff = self.mesh.shape[dist.TENSOR_AXIS]
+
         # dtype + kernel selection are model-config switches. dtype 'int8'
         # means INT8 WEIGHTS + bf16 compute (reference csrc int8
         # dequant-GEMM serving): the memory-bound decode loop reads half
@@ -105,15 +119,45 @@ class InferenceEngine:
                              "params are published post-hoc in the compute layout")
         compute_dtype = jnp.bfloat16 if self._int8_weights else cfg.dtype
         overrides = {"dtype": compute_dtype, "decode_block_kv": cfg.decode_block_kv}
+        # serving bitwise-TP layout (see TransformerConfig.bitwise_tp): only
+        # column-parallel shards + activation re-replication before the
+        # row-parallel matmuls, so tp>1 logits stay bit-identical to tp=1.
+        # Head-divisibility gate: unevenly-sharded head axes make GSPMD pad
+        # shards and re-split contractions (measured ulp drift), so when the
+        # head counts don't divide the tensor degree serving falls back to
+        # FULLY REPLICATED weights — tp>1 either shards bit-identically or
+        # replicates loudly, never drifts silently.
+        nh = getattr(model.cfg, "num_heads", None)
+        nkv = getattr(model.cfg, "kv_heads", nh) or nh
+        heads_divide = nh is None or (nh % tp_eff == 0 and nkv % tp_eff == 0)
+        self._tp_replicated_fallback = tp_eff > 1 and not heads_divide
+        if self._tp_replicated_fallback:
+            logger.warning(
+                f"init_inference: mesh tensor={tp_eff} but head counts "
+                f"(num_heads={nh}, kv_heads={nkv}) don't divide it — serving "
+                f"REPLICATED (uneven head shards would cost bit-identity); "
+                f"choose a tensor degree dividing the kv head count to shard")
+        overrides["bitwise_tp"] = tp_eff > 1 and heads_divide
+        self._int8_fused_note = None
         if self._int8_weights and hasattr(model.cfg, "int8_weights"):
             overrides["int8_weights"] = True
             if hasattr(model.cfg, "int8_fused_qkv"):
                 # fused [q;k;v] matmul: fewer/larger pallas calls per decode
-                # step; tp>1 FORCES split projections (the fused N axis
-                # concatenates [q;k;v], so a plain column shard would split
-                # across component boundaries and quantize_params' qkv_q
-                # matches no tp_rules pattern)
-                overrides["int8_fused_qkv"] = cfg.tensor_parallel.tp_size == 1
+                # step; tp>1 (by the MESH, not just the config knob) FORCES
+                # split projections: the fused N axis concatenates [q;k;v],
+                # so a plain column shard would split across component
+                # boundaries, and quantize_params' qkv_q matches no tp_rules
+                # pattern (it would silently replicate). The split q/k/v
+                # kernels shard column-wise per tp_rules instead.
+                overrides["int8_fused_qkv"] = tp_eff == 1
+                if tp_eff > 1:
+                    self._int8_fused_note = (
+                        f"tensor={tp_eff} shards split q/k/v projections "
+                        f"column-wise; the fused [q;k;v] column axis cannot "
+                        f"shard without splitting component boundaries")
+                    logger.warning(
+                        "init_inference(int8): fused-qkv decode disabled under "
+                        f"tensor parallelism (mesh tensor={tp_eff}) — {self._int8_fused_note}")
         elif self._int8_weights:
             raise ValueError(f"dtype=int8 requires a model with int8 weight support "
                              f"(CausalLMModel family); got {type(model)}")
@@ -130,16 +174,11 @@ class InferenceEngine:
         self.module = type(model)(dataclasses.replace(model.cfg, **overrides))
         self.model_config = self.module.cfg
 
-        tp = cfg.tensor_parallel.tp_size
-        if dist.has_mesh():
-            self.mesh = dist.get_mesh()
-            if self.mesh.shape[dist.TENSOR_AXIS] != tp and tp > 1:
-                raise ValueError(f"existing mesh has tensor={self.mesh.shape[dist.TENSOR_AXIS]}, "
-                                 f"config asks tp_size={tp}")
-        else:
-            self.mesh = dist.initialize_mesh(tensor=tp)
-
-        self.planner = ShardingPlanner(self.mesh, None, tp_rules=self.module.tp_rules(),
+        # the replicated fallback hands the planner NO tensor rules at all:
+        # every weight replicates, which trivially preserves bit-identity
+        tp_rules = (() if getattr(self, "_tp_replicated_fallback", False)
+                    else self.module.tp_rules())
+        self.planner = ShardingPlanner(self.mesh, None, tp_rules=tp_rules,
                                        expert_pattern=self.module.expert_pattern())
         # shared-params engines never materialize: the publisher installs
         # (and later swaps) the compute-layout tree
@@ -160,8 +199,35 @@ class InferenceEngine:
         self._scheduler = None  # lazily-built continuous-batching scheduler
         log_dist(
             f"InferenceEngine ready: model dtype={jnp.dtype(self.model_config.dtype).name} "
-            f"tp={self.mesh.shape[dist.TENSOR_AXIS]} kernel_inject={cfg.kernel_inject} "
+            f"{self._shard_desc()} kernel_inject={cfg.kernel_inject} "
             f"max_out_tokens={cfg.max_out_tokens}", [0])
+
+    def _shard_desc(self):
+        """The REAL shard configuration, for the ready line and the serving
+        metrics surface: the effective mesh tensor size (which may exceed
+        the config's tp_size when a training mesh pre-exists), the layout in
+        force, whether the KV pool's head axis actually shards (the
+        divisibility fallback), and the int8 fused-qkv gating outcome."""
+        tp_eff = self.mesh.shape[dist.TENSOR_AXIS]
+        if tp_eff <= 1:
+            desc = "tp=1"
+        elif getattr(self, "_tp_replicated_fallback", False):
+            nh = getattr(self.model_config, "num_heads", None)
+            nkv = getattr(self.model_config, "kv_heads", None)
+            desc = (f"tp={tp_eff} (REPLICATED fallback: num_heads={nh}/"
+                    f"kv_heads={nkv} don't divide the tensor degree)")
+        else:
+            nkv = getattr(self.model_config, "kv_heads", None)
+            kv = ("kv_heads sharded /" + str(tp_eff)
+                  if nkv is not None and nkv % tp_eff == 0
+                  else f"kv replicated ({nkv} kv_heads % tp={tp_eff} != 0)")
+            desc = f"tp={tp_eff} (bitwise all-gather layout, {kv})"
+        if self._int8_weights:
+            fused = getattr(self.model_config, "int8_fused_qkv", False)
+            desc += (f" int8_fused_qkv={'on' if fused else 'off'}"
+                     + (f" ({self._int8_fused_note})"
+                        if getattr(self, "_int8_fused_note", None) else ""))
+        return desc
 
     # ------------------------------------------------------------------ params
     def _adapt_layout(self, params, host=False):

@@ -95,14 +95,23 @@ histograms ``serving/ttft_ms``, ``serving/step_ms``,
 """
 
 import collections
+import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..comm import comm as dist
 from .engine import _round_up
 from .kv_cache import RadixPrefixCache, SlotKVCache, copy_slot, slot_slice, slot_update
 from .speculative import PromptLookupDrafter
+
+# Guards COMPILED-PROGRAM CACHE INSERTION only (replica sets share one
+# program cache across per-replica pump threads; two threads racing the
+# same missing key would each jit their own closure — two XLA programs
+# where the O(1)-compile contract promises one). Step dispatch itself is
+# unlocked: each scheduler stays single-threaded within its own pump.
+_PROGRAM_LOCK = threading.RLock()
 
 
 def _bucket_len(n, base, cap):
@@ -113,6 +122,22 @@ def _bucket_len(n, base, cap):
     while b < n:
         b *= 2
     return min(b, cap)
+
+
+def _replicate_logits(l, tp_size):
+    """Gather vocab-sharded step logits to replicated BEFORE sampling
+    (tp>1 only): the gather is exact concatenation, and `jax.random`
+    bit-generation is NOT sharding-invariant on every jax version — a
+    categorical draw over a vocab-sharded operand can partition the
+    counter differently and change the sample. Replicated operands make
+    the sampling math byte-identical to the tp=1 program's. (N, V) per
+    sync is noise next to the model forward."""
+    if tp_size > 1:
+        from jax.sharding import PartitionSpec
+        l = jax.lax.with_sharding_constraint(
+            l, jax.sharding.NamedSharding(dist.get_mesh(),
+                                          PartitionSpec(*([None] * l.ndim))))
+    return l
 
 
 def _sample_slot(seed, step, logits, do_sample, temperature, top_k, top_p):
@@ -237,8 +262,17 @@ class DecodeScheduler:
     def __init__(self, engine, num_slots=8, max_len=None, prefill_bucket=64,
                  collect_logits=False, steps_per_sync=4, prefill_chunk=64,
                  prefix_cache=True, spec_tokens=0, spec_ngram_max=3,
-                 spec_ngram_min=1, kv_cache_dtype="auto"):
+                 spec_ngram_min=1, kv_cache_dtype="auto", compiled_cache=None):
         self.engine = engine
+        # raw constructor args, so a replica set can clone this scheduler's
+        # exact configuration for its sibling replicas (normalization —
+        # max_len rounding, chunk clamping — re-runs identically)
+        self._init_kwargs = dict(
+            num_slots=num_slots, max_len=max_len, prefill_bucket=prefill_bucket,
+            collect_logits=collect_logits, steps_per_sync=steps_per_sync,
+            prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
+            spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
+            spec_ngram_min=spec_ngram_min, kv_cache_dtype=kv_cache_dtype)
         model = engine.module
         cfg = engine._config
         if max_len is None:
@@ -308,7 +342,27 @@ class DecodeScheduler:
         self._prefill = None  # at most one in-flight _PrefillState
         self.queue = collections.deque()
         self.active = {}  # slot -> _Request
-        self._compiled = {}
+        # ``compiled_cache``: an externally-shared program dict (the replica
+        # set passes one dict to every replica's scheduler, so N replicas of
+        # the same shape share ONE compiled program set — replica count adds
+        # zero XLA programs; jit's own shape cache handles any shape skew)
+        self._compiled = {} if compiled_cache is None else compiled_cache
+        # effective tensor parallelism: with tp>1 the step programs pin the
+        # pool's OUTPUT sharding to the layout _init_cache materialized
+        # (head-axis shard over `tensor`) — leaving it to propagation lets
+        # GSPMD re-layout the donated pool between program variants (e.g.
+        # slot axis over `data`), churning reshards across the step mix. At
+        # tp=1 nothing is pinned: the programs are byte-identical to the
+        # unsharded scheduler's.
+        self.tp_size = int(engine.mesh.shape[dist.TENSOR_AXIS])
+        if self.tp_size > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            self._pool_sharding = jax.tree_util.tree_map(
+                lambda leaf: leaf.sharding, self.cache.pool)
+            self._host_sharding = NamedSharding(engine.mesh, PartitionSpec())
+        else:
+            self._pool_sharding = None
+            self._host_sharding = None
         self._rid = 0
         self._steps = 0
         # weight-swap protocol (RLHF hybrid engine): pause gates ADMISSION
@@ -1049,6 +1103,34 @@ class DecodeScheduler:
         return delivered, K
 
     # ------------------------------------------------------------------ compiled programs
+    def _program(self, key, builder):
+        """Compiled-program cache lookup with locked insertion: the cache
+        dict may be SHARED across a replica set's schedulers (their pump
+        threads race the same first-touch), and a double build would both
+        waste a compile and break the replicas-add-zero-programs guard."""
+        fn = self._compiled.get(key)
+        if fn is None:
+            with _PROGRAM_LOCK:
+                fn = self._compiled.get(key)
+                if fn is None:
+                    fn = self._compiled[key] = builder()
+        return fn
+
+    def _jit_step(self, fn, aux_outs, donate):
+        """jit a step program. Under tp>1 the pool output pins to the
+        layout ``_init_cache`` materialized (head shard over ``tensor``)
+        and host-bound outputs (tokens/logits) pin replicated — leaving
+        them to propagation lets GSPMD re-layout the donated pool between
+        program variants, churning reshards across the fused/spec/copy
+        mix. ``aux_outs``: host-bound outputs after the pool (0 = the
+        program returns the bare pool tree). At tp=1 nothing is pinned —
+        the programs stay byte-identical to the unsharded scheduler's."""
+        if self._pool_sharding is None:
+            return jax.jit(fn, donate_argnums=donate)
+        outs = (self._pool_sharding if aux_outs == 0
+                else (self._pool_sharding, ) + (self._host_sharding, ) * aux_outs)
+        return jax.jit(fn, donate_argnums=donate, out_shardings=outs)
+
     def _fused_fn(self, sampling, collect, ksteps, chunk):
         """THE step program: per-row query spans over a fixed ``(num_slots,
         chunk)`` ids block, then the sync's remaining ``ksteps - 1`` decode
@@ -1075,10 +1157,12 @@ class DecodeScheduler:
         shared position scalar, so the slot-pool step always uses the
         per-projection path (paged Pallas kernels or XLA)."""
         key = ("fused", sampling, collect, chunk, ksteps)
-        if key not in self._compiled:
+
+        def build():
             model = self.engine.module
             K = ksteps
             V = model.cfg.vocab_size
+            tp = self.tp_size
 
             def sample(l2, seeds, steps, flags, temps, topks, topps):
                 if sampling:
@@ -1100,6 +1184,7 @@ class DecodeScheduler:
                 last_col = jnp.maximum(spans - 1, 0)
                 l0 = jnp.take_along_axis(
                     logits, last_col[:, None, None], axis=1)[:, 0].astype(jnp.float32)
+                l0 = _replicate_logits(l0, tp)
                 tok0 = sample(l0, seeds, steps, flags, temps, topks, topps)
                 out_toks = jnp.zeros((K, N), jnp.int32).at[0].set(tok0)
                 out_logits = jnp.zeros((K, N, V) if collect else (), jnp.float32)
@@ -1118,7 +1203,7 @@ class DecodeScheduler:
                         params, tok[:, None], pool, 0,
                         position_ids=(base + k)[:, None], write_index=base + k,
                         q_spans=live01)
-                    l2 = logits[:, 0].astype(jnp.float32)
+                    l2 = _replicate_logits(logits[:, 0].astype(jnp.float32), tp)
                     nxt = sample(l2, seeds, steps + k, flags, temps, topks, topps)
                     out_toks = jax.lax.dynamic_update_index_in_dim(out_toks, nxt, k, 0)
                     if collect:
@@ -1132,8 +1217,9 @@ class DecodeScheduler:
                     return pool, out_toks, out_logits
                 return pool, out_toks
 
-            self._compiled[key] = jax.jit(fused, donate_argnums=(1, ))
-        return self._compiled[key]
+            return self._jit_step(fused, 2 if collect else 1, (1, ))
+
+        return self._program(key, build)
 
     def _spec_fn(self, sampling, collect, width):
         """The speculative VERIFY program: one forward over a fixed
@@ -1150,8 +1236,10 @@ class DecodeScheduler:
         span kernel, same sampling path, same key folding), which is what
         makes accepted streams bit-identical to non-speculative decode."""
         key = ("spec", sampling, collect, width)
-        if key not in self._compiled:
+
+        def build():
             model = self.engine.module
+            tp = self.tp_size
 
             def sample(l2, seeds, steps, flags, temps, topks, topps):
                 if sampling:
@@ -1166,24 +1254,22 @@ class DecodeScheduler:
                 logits, pool = model.apply_with_cache(
                     params, ids, pool, 0, position_ids=pos, write_index=lengths,
                     q_spans=spans)
-                l = logits.astype(jnp.float32)  # (N, C, V)
+                l = _replicate_logits(logits.astype(jnp.float32), tp)  # (N, C, V)
                 toks = jnp.stack([sample(l[:, j], seeds, steps + j, flags,
                                          temps, topks, topps) for j in range(C)])
                 if collect:
                     return pool, toks, l.swapaxes(0, 1)
                 return pool, toks
 
-            self._compiled[key] = jax.jit(spec, donate_argnums=(1, ))
-        return self._compiled[key]
+            return self._jit_step(spec, 2 if collect else 1, (1, ))
+
+        return self._program(key, build)
 
     def _copy_fn(self):
         """The ONE slot-to-slot cache copy program (radix prefix hit): src and
         dst are runtime scalars, so every donor/recipient pair shares it."""
-        if "copy" not in self._compiled:
-            self._compiled["copy"] = jax.jit(
-                lambda pool, src, dst: copy_slot(pool, src, dst),
-                donate_argnums=(0, ))
-        return self._compiled["copy"]
+        return self._program("copy", lambda: self._jit_step(
+            lambda pool, src, dst: copy_slot(pool, src, dst), 0, (0, )))
 
     def _prefill_fn(self, Pb, collect):
         """Single-request prefill into one pool slot, compiled per prompt
@@ -1191,8 +1277,10 @@ class DecodeScheduler:
         causally invisible to the real tokens and get overwritten by later
         decode writes), take the last real token's logits, sample token 0."""
         key = ("prefill", Pb, collect)
-        if key not in self._compiled:
+
+        def build():
             model = self.engine.module
+            tp = self.tp_size
 
             def prefill(params, pool, ids, length, slot, seed, do_sample,
                         temperature, top_k, top_p):
@@ -1201,14 +1289,16 @@ class DecodeScheduler:
                 pool = slot_update(pool, slot, cache)
                 last = jnp.take_along_axis(
                     logits, (length - 1)[None, None, None], axis=1)[0, 0].astype(jnp.float32)
+                last = _replicate_logits(last, tp)
                 tok = _sample_slot(seed, jnp.zeros((), jnp.int32), last, do_sample,
                                    temperature, top_k, top_p)
                 if collect:
                     return pool, tok, last
                 return pool, tok
 
-            self._compiled[key] = jax.jit(prefill, donate_argnums=(1, ))
-        return self._compiled[key]
+            return self._jit_step(prefill, 2 if collect else 1, (1, ))
+
+        return self._program(key, build)
 
     # ------------------------------------------------------------------ introspection
     def compiled_program_count(self):

@@ -102,6 +102,18 @@ class TransformerConfig:
     # fused N axis concatenates [q;k;v] so a plain column shard would split
     # across component boundaries. The engine enables it when tp==1.
     int8_fused_qkv: bool = False
+    # bitwise tensor-parallel SERVING layout (the inference engine sets this
+    # when the mesh's ``tensor`` axis > 1): only column-parallel projections
+    # shard (qkv/up/gate on their output-head/ffn axes, the vocab head on
+    # vocab) and activations re-replicate before every row-parallel
+    # (contraction-split) matmul (o_proj/down_proj stay replicated). Every
+    # cross-shard transfer is then an all-gather — pure concatenation, never
+    # a partial-sum reduction — so tp>1 logits are BIT-IDENTICAL to tp=1.
+    # The price is that o/down weight reads don't scale with tp; the wins
+    # that matter for decode (KV cache HBM, attention, qkv/up/head reads)
+    # do. Training never sets this (training shards row-parallel too and
+    # tolerates reduction-order noise; serving's contract is bit-identity).
+    bitwise_tp: bool = False
 
     def __post_init__(self):
         if self.attention_impl not in ("xla", "flash"):
@@ -360,13 +372,41 @@ def _constrain(x, spec):
     return dist.constrain(x, spec)
 
 
+def _tp_mesh_size():
+    """Size of the ``tensor`` mesh axis usable from this trace context (1
+    when no mesh is installed or the axis is under manual partitioning)."""
+    if not dist.has_mesh() or dist.TENSOR_AXIS in dist.get_manual_axes():
+        return 1
+    return dist.get_mesh().shape[dist.TENSOR_AXIS]
+
+
+def _tp_replicate(x):
+    """Re-replicate a tensor-sharded activation (bitwise-TP serving layout):
+    the constraint lowers to an all-gather over ``tensor`` — pure
+    concatenation of the shards, no arithmetic — so the downstream
+    row-parallel matmul runs its FULL contraction on every shard and its
+    result is bit-identical to tp=1. Identity when no tensor axis is live
+    (tp=1 programs stay byte-stable)."""
+    if _tp_mesh_size() > 1:
+        return dist.constrain(x, P(*([None] * x.ndim)))
+    return x
+
+
 def _embed_layout(x):
     """Route the embedding-gather output into the canonical activation layout
     (batch over dp, T over seq, H replicated) in single-axis moves. The
     gather inherits the table's tensor-tiled H; jumping straight to
     (dp, seq, None) is a combined move the partitioner can only do by full
     rematerialization, so step via (dp, seq, tensor) — a free slice — then
-    all-gather H over tensor alone."""
+    all-gather H over tensor alone.
+
+    TRAINING/full-forward path only. The KV-cache (serving) forward skips
+    this routing: its batch axis is the scheduler's SLOT POOL, not a
+    data-parallel batch (replica sets are serving's data parallelism), and
+    both the dp constraint and the tensor reshard round-trip measurably
+    perturb XLA's fusion choices across mesh shapes — ulp drift that would
+    break the serving contract (tp>1 and any-mesh decode bit-identical to
+    tp=1)."""
     import math
     if not dist.has_mesh():
         return x
@@ -676,10 +716,17 @@ class Attention(nn.Module):
                 ck, cv, csc = written
             else:
                 ck, cv = written
+            # bitwise-TP serving: the paged kernels shard over the tensor
+            # axis (kv-head split, shard-local KV block walk) via shard_map
+            # when the head counts divide; otherwise the plain call runs and
+            # the engine's divisibility fallback keeps the pool replicated
+            tp_kernel_shard = (cfg.bitwise_tp and _tp_mesh_size() > 1
+                               and nkv % _tp_mesh_size() == 0
+                               and nh % _tp_mesh_size() == 0)
             if (cfg.attention_impl == "flash" and T == 1 and alibi is None
                     and (write_index is not None or not quant_kv)):
                 from ..ops.pallas.decode_attention import decode_attention, \
-                    paged_decode_attention
+                    paged_decode_attention, sharded_paged_decode_attention
                 if attn_mask is not None:
                     starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
                 else:
@@ -687,7 +734,14 @@ class Attention(nn.Module):
                 if window:
                     # a sliding window is just a raised start for one query
                     starts = jnp.maximum(starts, cache_index + 1 - window)
-                if write_index is not None:
+                if write_index is not None and tp_kernel_shard:
+                    out = sharded_paged_decode_attention(
+                        q[:, :, 0], ck, cv, starts, write_index + 1,
+                        mesh=dist.get_mesh(), axis=dist.TENSOR_AXIS,
+                        block_kv=cfg.decode_block_kv,
+                        k_scale=csc if quant_kv else None,
+                        v_scale=csc if quant_kv else None)[:, :, None]
+                elif write_index is not None:
                     out = paged_decode_attention(
                         q[:, :, 0], ck, cv, starts, write_index + 1,
                         block_kv=cfg.decode_block_kv,
@@ -702,15 +756,24 @@ class Attention(nn.Module):
                 # per-row query spans through the span variant of the paged
                 # decode kernel (each row's causal window advances with its
                 # query column)
-                from ..ops.pallas.decode_attention import paged_span_attention
+                from ..ops.pallas.decode_attention import \
+                    paged_span_attention, sharded_paged_span_attention
                 if attn_mask is not None:
                     starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
                 else:
                     starts = jnp.zeros((B, ), jnp.int32)
-                out = paged_span_attention(q, ck, cv, starts, write_index,
-                                           block_kv=cfg.decode_block_kv,
-                                           k_scale=csc if quant_kv else None,
-                                           v_scale=csc if quant_kv else None)
+                if tp_kernel_shard:
+                    out = sharded_paged_span_attention(
+                        q, ck, cv, starts, write_index,
+                        mesh=dist.get_mesh(), axis=dist.TENSOR_AXIS,
+                        block_kv=cfg.decode_block_kv,
+                        k_scale=csc if quant_kv else None,
+                        v_scale=csc if quant_kv else None)
+                else:
+                    out = paged_span_attention(q, ck, cv, starts, write_index,
+                                               block_kv=cfg.decode_block_kv,
+                                               k_scale=csc if quant_kv else None,
+                                               v_scale=csc if quant_kv else None)
             elif (cfg.attention_impl == "flash" and attn_mask is None and T >= 128
                   and isinstance(cache_index, int) and cache_index == 0 and alibi is None
                   and not window):
@@ -790,6 +853,11 @@ class Attention(nn.Module):
                 if ulysses is not None:
                     out = _constrain(out, seq_q)
 
+        if cfg.bitwise_tp:
+            # bitwise-TP layout: gather the head-sharded attention output
+            # (exact concat) so the replicated o_proj contracts its full
+            # head*hd axis locally — no partial-sum reduction anywhere
+            out = _tp_replicate(out)
         out = OutProjection(H, use_bias, cfg.dtype, cfg.int8_weights,
                             cfg.int8_group_size, name="o_proj")(out)
         return out, new_cache
@@ -843,6 +911,10 @@ class MLP(nn.Module):
                 h = h * nn.sigmoid(1.702 * h)  # CLIP's QuickGELU
             else:
                 h = nn.relu(h)
+        if cfg.bitwise_tp:
+            # bitwise-TP layout: gather the ffn-sharded activation (exact
+            # concat) so the replicated down_proj contracts fully locally
+            h = _tp_replicate(h)
         return dense(cfg.hidden_size, name="down_proj")(h)
 
 
@@ -908,7 +980,7 @@ class CausalLM(nn.Module):
         B, T = input_ids.shape
         emb = nn.Embed(cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
                        embedding_init=nn.initializers.normal(0.02), name="embed")
-        x = _embed_layout(emb(input_ids))
+        x = emb(input_ids) if kv_cache is not None else _embed_layout(emb(input_ids))
         if cfg.embed_norm:  # BLOOM's word_embeddings_layernorm
             x = make_norm(cfg, name="embed_norm")(x)
         if cfg.pos_embedding == "learned":
@@ -1653,15 +1725,27 @@ class CausalLMModel:
         # dim (last) splits over tensor for qkv/gate/up + the vocab head,
         # matching scale columns. Row-split kernels (o/down) stay replicated
         # under int8 (their per-column scales span the full contraction).
+        #
+        # bitwise_tp (serving): row-parallel kernels (o_proj/down_proj —
+        # their tensor shard splits the CONTRACTION dim, forcing a
+        # partial-sum all-reduce whose float addition order differs from
+        # tp=1) stay replicated; the matching activation re-replication
+        # happens in Attention/MLP. Column-parallel rules below are
+        # reduction-free (full contraction per shard) and stay.
+        bitwise = self.cfg.bitwise_tp
         if self.cfg.scan_layers:
             # scanned layers carry a leading L dim on every block param
             rules = [
                 (r"experts/(gate|up)_proj$", (None, e, None, t)),  # (L, E, H, F)
-                (r"experts/down_proj$", (None, e, t, None)),  # (L, E, F, H)
+                (r"experts/down_proj$",
+                 (None, e, None, None) if bitwise else (None, e, t, None)),  # (L, E, F, H)
                 (r"attn/(q|k|v)_proj/kernel$", (None, None, t, None)),  # (L, H, heads, hd)
-                (r"attn/o_proj/kernel$", (None, t, None, None)),  # (L, heads, hd, H)
+                (r"attn/o_proj/kernel$",
+                 (None, None, None, None) if bitwise
+                 else (None, t, None, None)),  # (L, heads, hd, H)
                 (r"mlp/(gate|up)_proj/kernel$", (None, None, t)),  # col
-                (r"mlp/down_proj/kernel$", (None, t, None)),  # row
+                (r"mlp/down_proj/kernel$",
+                 (None, None, None) if bitwise else (None, t, None)),  # row
                 (r"embed/embedding$", (t, None)),
                 (r"lm_head/kernel$", (None, t)),
             ]
@@ -1675,11 +1759,12 @@ class CausalLMModel:
             return rules
         rules = [
             (r"experts/(gate|up)_proj$", (e, None, t)),
-            (r"experts/down_proj$", (e, t, None)),
+            (r"experts/down_proj$", (e, None, None) if bitwise else (e, t, None)),
             (r"attn/(q|k|v)_proj/kernel$", (None, t, None)),
-            (r"attn/o_proj/kernel$", (t, None, None)),
+            (r"attn/o_proj/kernel$",
+             (None, None, None) if bitwise else (t, None, None)),
             (r"mlp/(gate|up)_proj/kernel$", (None, t)),
-            (r"mlp/down_proj/kernel$", (t, None)),
+            (r"mlp/down_proj/kernel$", (None, None) if bitwise else (t, None)),
             (r"embed/embedding$", (t, None)),
             (r"lm_head/kernel$", (None, t)),
         ]

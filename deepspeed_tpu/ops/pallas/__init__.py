@@ -2,9 +2,43 @@
 
 Shared compat: jax renamed ``pltpu.TPUCompilerParams`` to
 ``CompilerParams`` around 0.5 — kernels import the alias from here so the
-version shim can't drift between files.
+version shim can't drift between files. ``shard_map_compat`` papers over
+the ``jax.experimental.shard_map`` (0.4.x: ``check_rep``/``auto``) →
+``jax.shard_map`` (``check_vma``/``axis_names``) API move the same way.
 """
 
+import jax as _jax
 from jax.experimental.pallas import tpu as _pltpu
 
 CompilerParams = getattr(_pltpu, "CompilerParams", None) or _pltpu.TPUCompilerParams
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs, manual_axes=None):
+    """Version-tolerant shard_map: replication checking off (pallas_call
+    outputs carry no vma/rep annotations), manual only over
+    ``manual_axes`` (None = every mesh axis)."""
+    if hasattr(_jax, "shard_map"):
+        kw = {"check_vma": False}
+        if manual_axes is not None:
+            kw["axis_names"] = set(manual_axes)
+        return _jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def call(*args):
+        kw = {"check_rep": False}
+        if manual_axes is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(manual_axes)
+            if auto:
+                kw["auto"] = auto
+        try:
+            return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       **kw)(*args)
+        except NotImplementedError:
+            # 0.4.x partial-auto shard_map is unimplemented for most mixes;
+            # full-manual is equivalent for these kernel bodies (no inner
+            # collectives over the would-be-auto axes — unmentioned spec
+            # axes just replicate)
+            return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                       check_rep=False)(*args)
+    return call

@@ -219,6 +219,80 @@ def _row_scales(k_scale, v_scale, B, S):
     return k_scale.reshape(B, S), v_scale.reshape(B, S)
 
 
+def _tp_shard_map(fn, mesh, axis, q_ndim, quantized):
+    """shard_map wrapper for the paged kernels over the ``axis`` (tensor)
+    mesh dim: q and the KV cache split on their HEAD axes, window scalars
+    and the per-token-row scale leaves stay replicated. Each shard's kernel
+    then walks ONLY its local KV-head blocks (shard-local block walk — DMA
+    and compute scale down tp-fold), and because every (batch, kv-head)
+    pair is computed independently by the same kernel, the gathered output
+    is BIT-identical to the unsharded call."""
+    from jax.sharding import PartitionSpec as SP
+    from . import shard_map_compat
+    head_q = SP(*(None, axis) + (None, ) * (q_ndim - 2))
+    head_c = SP(None, axis, None, None)
+    rep = SP()
+    in_specs = [head_q, head_c, head_c, rep, rep, rep]
+    if quantized:
+        in_specs += [rep, rep]
+    return shard_map_compat(fn, mesh, tuple(in_specs), head_q)
+
+
+def sharded_paged_decode_attention(q, k_cache, v_cache, start, ends, *, mesh,
+                                   axis, block_kv=256, scale=None,
+                                   k_scale=None, v_scale=None):
+    """:func:`paged_decode_attention` shard_mapped over the ``axis`` mesh
+    dim (tensor-parallel serving): the KV pool stays head-sharded in HBM
+    and each shard walks only its local heads' blocks. Bit-identical to the
+    unsharded call (per-head independence). ``k_cache.shape[1]`` (and the
+    query head count) must divide by the axis size."""
+    B, H, D = q.shape
+    ends = ends.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, B, k_cache.shape[2])
+    max_end = jnp.max(ends)
+
+    def body(qg, kc, vc, st, en, me, *scales):
+        kss, vss = scales if scales else (None, None)
+        return _decode_call(qg, kc, vc, st, en, me[0], block_kv=block_kv,
+                            scale=scale, k_scale=kss, v_scale=vss)
+
+    out = _tp_shard_map(body, mesh, axis, 4, ks is not None)(
+        *((_group(q, k_cache.shape[1]), k_cache, v_cache,
+           start.astype(jnp.int32), ends, max_end[None])
+          + ((ks, vs) if ks is not None else ())))
+    return out.reshape(B, H, D)
+
+
+def sharded_paged_span_attention(q, k_cache, v_cache, start, base, *, mesh,
+                                 axis, block_kv=256, scale=None,
+                                 k_scale=None, v_scale=None):
+    """:func:`paged_span_attention` shard_mapped over the ``axis`` mesh dim
+    — the fused chunked-prefill/decode (and speculative verify) step's
+    kernel with a shard-local block walk. q: (B, H, T, D); the head axis
+    (and the cache's kv-head axis) must divide by the axis size. The
+    (head-group, column) fold happens INSIDE each shard, so per-column
+    causal offsets see only local heads and results stay bit-identical."""
+    B, H, T, D = q.shape
+    nkv = k_cache.shape[1]
+    base = base.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, B, k_cache.shape[2])
+    max_end = jnp.max(base) + T
+    g = H // nkv
+
+    def body(qs, kc, vc, st, bs, me, *scales):
+        nkv_l = kc.shape[1]
+        qf = qs.reshape(B, nkv_l, g * T, D)
+        kss, vss = scales if scales else (None, None)
+        out = _decode_call(qf, kc, vc, st, bs + 1, me[0], block_kv=block_kv,
+                           scale=scale, span=T, k_scale=kss, v_scale=vss)
+        return out.reshape(B, nkv_l * g, T, D)
+
+    out = _tp_shard_map(body, mesh, axis, 4, ks is not None)(
+        *((q, k_cache, v_cache, start.astype(jnp.int32), base, max_end[None])
+          + ((ks, vs) if ks is not None else ())))
+    return out.reshape(B, H, T, D)
+
+
 def paged_decode_attention(q, k_cache, v_cache, start, ends, *, block_kv=256,
                            scale=None, k_scale=None, v_scale=None):
     """Slot-pool variant: per-row ends. q: (B, H, D); k_cache/v_cache:

@@ -415,6 +415,8 @@ def sharded_flash_attention(q, k, v, causal=True, block_q=512, block_kv=512, sca
         return flash_attention(q, k, v, causal, block_q, block_kv, scale)
 
     with dist.manual_axes(set(dp_axes) | set(head_axes)):
-        # check_vma=False: pallas_call out_shapes carry no vma annotations
-        return jax.shard_map(fn, mesh=mesh, in_specs=(qspec, kvspec, kvspec), out_specs=qspec,
-                             axis_names=set(dp_axes) | set(head_axes), check_vma=False)(q, k, v)
+        # replication checking off: pallas_call out_shapes carry no
+        # vma/rep annotations (shard_map_compat spans the jax API move)
+        from . import shard_map_compat
+        return shard_map_compat(fn, mesh, (qspec, kvspec, kvspec), qspec,
+                                manual_axes=set(dp_axes) | set(head_axes))(q, k, v)

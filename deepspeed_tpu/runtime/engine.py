@@ -856,12 +856,13 @@ class DeepSpeedEngine:
             batch_specs = jax.tree_util.tree_map(
                 lambda x: P(*(([None, axis] + [None] * max(x.ndim - 2, 0))[:x.ndim])), batch)
             opt_specs = jax.tree_util.tree_map(lambda _: P(axis), state.opt_state)
-            new_params, new_opt, loss_mean, gnorm, overflow = jax.shard_map(
-                shard_fn, mesh=self.mesh,
-                in_specs=(P(), opt_specs, P(), P(), batch_specs),
-                out_specs=(P(), opt_specs, P(), P(), P()),
-                check_vma=False)(state.params, state.opt_state, state.loss_scale.cur_scale,
-                                 state.step, batch)
+            from ..ops.pallas import shard_map_compat
+            new_params, new_opt, loss_mean, gnorm, overflow = shard_map_compat(
+                shard_fn, self.mesh,
+                (P(), opt_specs, P(), P(), batch_specs),
+                (P(), opt_specs, P(), P(), P()))(
+                    state.params, state.opt_state, state.loss_scale.cur_scale,
+                    state.step, batch)
             new_scale = self.loss_scaler.update(state.loss_scale, overflow)
             new_state = state._replace(
                 step=state.step + jnp.where(overflow, 0, 1),

@@ -28,6 +28,10 @@ def build_parser():
                    help="0 binds an ephemeral port (printed in GATEWAY_READY)")
     p.add_argument("--num-slots", type=int, default=None,
                    help="decode batch slots (continuous_batching.num_slots)")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="scheduler replicas behind the gateway "
+                        "(continuous_batching.replicas): independent slot "
+                        "pools, one weight tree, one compiled program set")
     p.add_argument("--max-queue-depth", type=int, default=None)
     p.add_argument("--default-max-tokens", type=int, default=None)
     p.add_argument("--request-timeout-s", type=float, default=None)
@@ -46,6 +50,8 @@ def main(argv=None):
     cfg.setdefault("continuous_batching", {})["enabled"] = True
     if args.num_slots is not None:
         cfg["continuous_batching"]["num_slots"] = args.num_slots
+    if args.replicas is not None:
+        cfg["continuous_batching"]["replicas"] = args.replicas
     if args.dtype is not None:
         cfg["dtype"] = args.dtype
     if args.checkpoint is not None:

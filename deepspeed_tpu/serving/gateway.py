@@ -55,12 +55,23 @@ no aiohttp/fastapi in the image, and none needed):
   admitting (503 + Retry-After), finishes every already-admitted request,
   flushes telemetry, and exits; ``drain_timeout_s`` bounds the grace.
 
-Threading model: the asyncio event loop owns sockets and parsing; a single
-**pump thread** owns ALL scheduler interaction (submit/step/cancel — the
-scheduler is single-threaded by design). Tokens cross from the pump to a
-response's ``asyncio.Queue`` via ``loop.call_soon_threadsafe`` from the
-scheduler's ``on_token`` hook, so SSE events flush as each host sync lands
-(TTFB = queue wait + prefill + first sync, not request completion).
+- **Replica fleet** (``continuous_batching.replicas`` > 1): N scheduler
+  replicas — independent slot pools, ONE weight tree and ONE compiled
+  program set — behind this one gateway (``serving/replica.py``). The DRR
+  pop is placed prefix-sticky (prompts sharing a cached prefix follow the
+  replica that owns it) or least-loaded (occupancy x per-replica service
+  EMA); ``POST /v1/replicas/<i>/drain|resume`` and per-replica health keep
+  one sick replica from sinking the fleet. ``GET /v1/replicas`` lists
+  states.
+
+Threading model: the asyncio event loop owns sockets and parsing; one
+**pump thread per replica** owns ALL of that replica's scheduler
+interaction (submit/step/cancel — each scheduler stays single-threaded).
+Admission (fair-queue pop + placement) and terminal accounting serialize on
+the dispatch/finish locks. Tokens cross from a pump to a response's
+``asyncio.Queue`` via ``loop.call_soon_threadsafe`` from the scheduler's
+``on_token`` hook, so SSE events flush as each host sync lands (TTFB =
+queue wait + prefill + first sync, not request completion).
 
 Telemetry (PR-1 sink): histograms ``gateway/queue_wait_ms``,
 ``gateway/ttfb_ms``; gauges ``gateway/queue_depth``,
@@ -84,6 +95,7 @@ from ..telemetry import (DEFAULT_SERVING_OBJECTIVES, RequestTrace, SLOEngine,
 from ..telemetry import prometheus as prom
 from ..utils.logging import logger
 from .fair_queue import FairQueue, QueueFull
+from .replica import ReplicaSet
 
 _JSON = "application/json"
 
@@ -100,7 +112,7 @@ class _GatewayRequest:
                  "temperature", "top_k", "top_p", "seed", "tenant", "priority",
                  "cost", "deadline", "stream", "loop", "events", "handle",
                  "cancel_requested", "cancel_reason", "finished", "enq_ts",
-                 "admit_ts", "n_tokens", "trace", "trace_id")
+                 "admit_ts", "n_tokens", "trace", "trace_id", "replica")
 
     def __init__(self, rid, prompt, *, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, seed, tenant, priority, deadline,
@@ -130,6 +142,7 @@ class _GatewayRequest:
         self.n_tokens = 0
         self.trace = trace          # RequestTrace (None when tracing is off)
         self.trace_id = trace_id    # request identity echoed as x-request-id
+        self.replica = None         # serving replica this request landed on
 
 
 class Gateway:
@@ -161,7 +174,13 @@ class Gateway:
         self.engine = engine
         self.config = config
         self.telemetry = engine.telemetry
-        self.scheduler = engine.scheduler()
+        # multi-replica serving (continuous_batching.replicas): N scheduler
+        # replicas behind one dispatch policy (serving/replica.py), sharing
+        # one weight tree and ONE compiled program set. Replica 0 is the
+        # engine's singleton scheduler, so `self.scheduler` keeps meaning
+        # what it always did for the single-replica gateway.
+        self.replicas = ReplicaSet.build(engine)
+        self.scheduler = self.replicas.primary
         self._fair = FairQueue(max_depth=config.max_queue_depth,
                                quantum=config.quantum_tokens,
                                tenant_weights=config.tenant_weights,
@@ -179,10 +198,17 @@ class Gateway:
         self._wake = threading.Event()       # pump wakeup
         self._active = set()                 # admitted, unfinished _GatewayRequests
         self._ema_service_s = None           # EMA of request wall time
+        # pump-side locks: dispatch (fair-queue pop + replica placement must
+        # be one atomic decision across the per-replica pump threads) and
+        # finish (terminal accounting is exactly-once even when a cancel
+        # settling on one pump races the final token on another)
+        self._dispatch_lock = threading.Lock()
+        self._finish_lock = threading.Lock()
         self._loop = None
         self._server = None
         self._open_streams = 0               # responses still being written
         self._pump_thread = None
+        self._pump_threads = []
         self._loop_thread = None
         self._done_evt = threading.Event()   # fully drained + server closed
         self._force_stop = False
@@ -294,14 +320,23 @@ class Gateway:
         self._server = await asyncio.start_server(self._handle_conn, self.host,
                                                   self.config.port)
         self.port = self._server.sockets[0].getsockname()[1]
-        self._pump_thread = threading.Thread(target=self._pump, daemon=True,
-                                             name="gateway-pump")
-        self._pump_thread.start()
+        # one pump thread PER REPLICA: each owns all calls into its own
+        # scheduler (the single-threaded-scheduler contract, N times over);
+        # admission and terminal accounting serialize on the dispatch/finish
+        # locks. On a pod each pump drives its own device group; on one host
+        # the threads interleave through the shared backend.
+        self._pump_threads = [
+            threading.Thread(target=self._pump, args=(rep, ), daemon=True,
+                             name=f"gateway-pump-{rep.idx}")
+            for rep in self.replicas]
+        for t in self._pump_threads:
+            t.start()
+        self._pump_thread = self._pump_threads[0]  # single-replica back-compat
         self.ready = True
         ready_cb()
-        # pump exit == fully drained (it only returns when draining and all
-        # admitted work finished, or on force-stop)
-        while self._pump_thread.is_alive():
+        # pump exit == fully drained (each pump only returns when draining
+        # and all admitted work finished, or on force-stop)
+        while any(t.is_alive() for t in self._pump_threads):
             await asyncio.sleep(0.05)
         # let in-flight response writers flush their final events
         deadline = time.monotonic() + 10.0
@@ -315,37 +350,66 @@ class Gateway:
             pass
         logger.info("gateway: drained and closed")
 
-    # ------------------------------------------------------------------ pump thread
-    def _pump(self):
-        """The one thread that talks to the scheduler: admit from the fair
-        queue in DRR order, step the decode loop, enforce deadlines and
-        cancellations. Exits only when draining and every admitted request
-        has finished."""
-        sched = self.scheduler
+    # ------------------------------------------------------------------ pump threads
+    def _pump(self, rep):
+        """One replica's pump: admit from the fair queue in DRR order
+        (dispatch-locked — placement is a fleet-wide decision), step THIS
+        replica's decode loop, enforce deadlines and cancellations. Exits
+        only when draining and every admitted request has finished.
+
+        Replica 0's pump additionally owns the fleet-wide side duties (SLO
+        evaluation, operator flight dumps, recompile watch) so they run
+        exactly once per turn regardless of fleet size."""
+        sched = rep.scheduler
+        primary = rep.idx == 0
         while not self._force_stop:
-            self._enforce_cancellations()
-            self._admit()
-            if sched.active or sched.queue or sched._prefill is not None:
+            with self._dispatch_lock:
+                self._enforce_cancellations()
+                self._admit()
+            if not rep.idle() and not rep.sick:
                 try:
-                    sched.step()
+                    rep.step()
                 except Exception:  # noqa: BLE001 — fail requests, not the server
-                    logger.exception("gateway: scheduler step failed")
+                    logger.exception(f"gateway: replica {rep.idx} scheduler step failed")
                     self.telemetry.dump_flight("backend_error")
-                    self._fail_in_flight("scheduler step failed")
-                self._watch_recompiles()
+                    # "other healthy replicas remain BESIDES this one":
+                    # healthy() still counts this not-yet-marked replica, so
+                    # > 1 is the real fleet-keeps-serving test — the LAST
+                    # healthy replica failing must take the fail-and-retry
+                    # path below, not sick the whole fleet into a state only
+                    # a manual resume can leave
+                    if len(self.replicas.healthy()) > 1:
+                        # shed the sick replica, keep the fleet serving:
+                        # its in-flight requests fail, placement avoids it,
+                        # and its pump STOPS stepping it (a persistently-
+                        # raising backend must not spin traceback/flight-
+                        # dump loops or block drain) until resume()
+                        self.replicas.mark_sick(rep.idx, "scheduler step failed")
+                        self._fail_replica_in_flight(rep, "replica step failed")
+                    else:
+                        # single replica (or the last healthy one): today's
+                        # semantics — fail everything, stay up, retry on the
+                        # next admitted request
+                        self._fail_in_flight("scheduler step failed")
             self._settle_done()
-            if self.slo is not None:
-                self.slo.maybe_evaluate()
-            if self._flight_request is not None:
-                reason, self._flight_request = self._flight_request, None
-                self.telemetry.dump_flight(reason)
-            if not (sched.active or sched.queue or sched._prefill is not None):
+            if primary:
+                # every primary iteration, stepped or not: the program set
+                # is SHARED, so another replica's stray shape must trip the
+                # recompile watch even while replica 0 idles
+                self._watch_recompiles()
+                if self.slo is not None:
+                    self.slo.maybe_evaluate()
+                if self._flight_request is not None:
+                    reason, self._flight_request = self._flight_request, None
+                    self.telemetry.dump_flight(reason)
+            if rep.idle() or rep.sick:
                 if self.draining and not len(self._fair) and not self._active:
                     break
                 self._wake.wait(0.02)
                 self._wake.clear()
-        # force-stop: anything still in flight is failed, not silently dropped
-        if self._force_stop:
+        # force-stop: anything still in flight is failed, not silently
+        # dropped (any one pump suffices — _fail_in_flight spans the fleet)
+        if self._force_stop and primary:
             self._fail_in_flight("gateway shutdown")
 
     def _watch_recompiles(self):
@@ -369,15 +433,16 @@ class Gateway:
             self._compile_baseline = count
 
     def _admit(self):
-        """Move requests from the DRR queue into scheduler slots while
-        capacity is free. The scheduler's FIFO is kept empty (admission is
-        1:1 with free capacity) so fair-queue order IS slot order."""
-        sched = self.scheduler
+        """Move requests from the DRR queue into scheduler slots while the
+        fleet has capacity (caller holds the dispatch lock). Each pop is
+        placed by the replica set — prefix-sticky, else least-loaded — and
+        every replica's FIFO is kept empty (admission is 1:1 with free
+        capacity) so fair-queue order IS slot order."""
         tel = self.telemetry
         while True:
-            busy = (sched.cache.active_slots + len(sched.queue)
-                    + (1 if sched._prefill is not None else 0))
-            if busy >= sched.num_slots:
+            if not self.replicas.any_capacity():
+                if self.replicas.all_sick() and len(self._fair):
+                    self._fail_queue("no healthy serving replica")
                 return
             greq = self._fair.pop()
             if greq is None:
@@ -399,8 +464,20 @@ class Gateway:
                     greq.trace.instant("expired", where="queue")
                 self._post(greq, ("failed", 504, "deadline expired in queue"))
                 continue
+            rep = self.replicas.route(greq.prompt)
+            if rep is None:
+                # eligibility changed between the capacity check and the
+                # pop (drain/sick mutate under the ReplicaSet's own lock):
+                # shed the popped request — dropping it would strand the
+                # client with no terminal event until transport timeout
+                self.stats["shed_503"] += 1
+                if tel.enabled:
+                    tel.counter("gateway/shed_503")
+                self._post(greq, ("failed", 503,
+                                  "no serving replica available, retry later"))
+                return
             try:
-                handle = sched.submit(
+                handle = rep.scheduler.submit(
                     greq.prompt, max_new_tokens=greq.max_new_tokens,
                     eos_token_id=greq.eos_token_id, do_sample=greq.do_sample,
                     temperature=greq.temperature, top_k=greq.top_k,
@@ -413,11 +490,13 @@ class Gateway:
                 self._post(greq, ("failed", 400, str(e)))
                 continue
             greq.handle = handle
+            greq.replica = rep
+            self.replicas.note_dispatch(rep)
             greq.admit_ts = now
             if greq.trace is not None:
                 greq.trace.phase("queued",
                                  wait_ms=round((now - greq.enq_ts) * 1e3, 3))
-                greq.trace.instant("admitted")
+                greq.trace.instant("admitted", replica=rep.idx)
             if tel.enabled:
                 tel.histogram("gateway/queue_wait_ms", (now - greq.enq_ts) * 1e3)
             if handle.done:  # zero-budget edge: finished with no tokens
@@ -452,21 +531,28 @@ class Gateway:
         overload with impatient clients, making ``Retry-After`` advertise
         far-too-small backoffs (a retry-storm amplifier). Token counters
         still accrue — the decode work happened, and the per-tenant counter
-        is a billing/fairness audit."""
-        if greq.finished:
-            return
-        greq.finished = True
-        self._active.discard(greq)
-        completed = event is None or event[0] == "done"
+        is a billing/fairness audit.
+
+        Exactly-once across pump threads: a cancel settling on one replica's
+        pump can race the final token on another — the finish lock plus the
+        ``finished`` flag make whichever lands first the terminal event."""
+        with self._finish_lock:
+            if greq.finished:
+                return
+            greq.finished = True
+            self._active.discard(greq)
+            completed = event is None or event[0] == "done"
+            if completed:
+                service = time.monotonic() - greq.enq_ts
+                ema = self._ema_service_s
+                self._ema_service_s = (service if ema is None
+                                       else 0.9 * ema + 0.1 * service)
+                if greq.replica is not None:
+                    greq.replica.observe_service(service)
+                self.stats["completed"] += 1
+            self.stats["tokens"] += greq.n_tokens
         if event is not None:
             self._post(greq, event)
-        if completed:
-            service = time.monotonic() - greq.enq_ts
-            ema = self._ema_service_s
-            self._ema_service_s = (service if ema is None
-                                   else 0.9 * ema + 0.1 * service)
-            self.stats["completed"] += 1
-        self.stats["tokens"] += greq.n_tokens
         tel = self.telemetry
         if tel.enabled:
             if completed:
@@ -513,6 +599,18 @@ class Gateway:
             if greq.handle is not None:
                 greq.handle.cancel()
             self._finish(greq, ("failed", 500, msg))
+        self._fail_queue(msg)
+
+    def _fail_replica_in_flight(self, rep, msg):
+        """Fail ONLY the requests placed on ``rep`` (a sick replica sheds
+        its own work; the rest of the fleet, and the queue, keep going)."""
+        for greq in list(self._active):
+            if greq.replica is rep:
+                if greq.handle is not None:
+                    greq.handle.cancel()
+                self._finish(greq, ("failed", 500, msg))
+
+    def _fail_queue(self, msg):
         while True:
             greq = self._fair.pop()
             if greq is None:
@@ -530,15 +628,16 @@ class Gateway:
     # ------------------------------------------------------------------ admission math
     def _retry_after(self):
         """Advertised backoff, from live state: time for the current backlog
-        to drain through the slot pool at the measured per-request service
-        time (EMA). Floor 1s; capped; integer seconds per RFC 9110."""
+        to drain through the FLEET's slot pools at the measured per-request
+        service time (EMA). Floor 1s; capped; integer seconds per RFC 9110."""
         depth = (len(self._fair) + len(self._active)
-                 + len(self.scheduler.queue))
+                 + sum(len(r.scheduler.queue) for r in self.replicas))
+        slots = self.replicas.total_slots()
         ema = self._ema_service_s
         if ema is None:
-            est = 1 + depth // max(1, self.scheduler.num_slots)
+            est = 1 + depth // max(1, slots)
         else:
-            est = (depth + 1) * ema / max(1, self.scheduler.num_slots)
+            est = (depth + 1) * ema / max(1, slots)
         return max(1, min(int(self.config.retry_after_cap_s), int(est + 0.999)))
 
     def _next_rid(self):
@@ -639,10 +738,38 @@ class Gateway:
                                  {"path": dump,
                                   "note": "file lands after the recorder's "
                                           "post-window elapses"})
+        elif method == "GET" and path == "/v1/replicas":
+            await self._json(writer, 200, {"replicas": self.replicas.states()})
+        elif method == "POST" and path.startswith("/v1/replicas/"):
+            await self._replica_admin(path, writer)
         elif method == "POST" and path == "/v1/completions":
             await self._completions(headers, body, reader, writer)
         else:
             await self._json(writer, 404, {"error": {"message": f"no route {method} {path}"}})
+
+    async def _replica_admin(self, path, writer):
+        """``POST /v1/replicas/<idx>/drain`` stops placement onto a replica
+        (in-flight work finishes; resumable); ``.../resume`` re-admits it
+        (clearing drain AND sick — the operator asserting recovery)."""
+        parts = path.strip("/").split("/")  # v1 replicas <idx> <action>
+        if len(parts) != 4 or parts[3] not in ("drain", "resume"):
+            await self._json(writer, 404,
+                             {"error": {"message": "POST /v1/replicas/<idx>/"
+                                        "{drain|resume}"}})
+            return
+        try:
+            idx = int(parts[2])
+            if not 0 <= idx < len(self.replicas):
+                raise ValueError
+        except ValueError:
+            await self._json(writer, 400,
+                             {"error": {"message": f"no replica {parts[2]!r} "
+                                        f"(fleet size {len(self.replicas)})"}})
+            return
+        state = (self.replicas.drain(idx) if parts[3] == "drain"
+                 else self.replicas.resume(idx))
+        self._wake.set()
+        await self._json(writer, 200, {"replica": state})
 
     def _prom_extra(self):
         """Gateway/scheduler state the sink doesn't own, exposed as plain
@@ -659,6 +786,10 @@ class Gateway:
             "scheduler/active_slots": float(sched.cache.active_slots),
             "scheduler/slot_occupancy": float(sched.cache.occupancy()),
             "scheduler/compiled_programs": float(sched.compiled_program_count()),
+            "serving/replicas": float(len(self.replicas)),
+            "serving/replicas_available": float(
+                sum(1 for r in self.replicas if r.available())),
+            "serving/tp_size": float(sched.tp_size),
         }
 
     def _metrics(self):
@@ -679,7 +810,9 @@ class Gateway:
                           "active_slots": sched.cache.active_slots,
                           "queue_depth": len(sched.queue),
                           "slot_occupancy": sched.cache.occupancy(),
-                          "compiled_programs": sched.compiled_program_count()},
+                          "compiled_programs": sched.compiled_program_count(),
+                          "tp_size": sched.tp_size},
+            "replicas": self.replicas.states(),
             "telemetry": self.telemetry.snapshot(),
         }
 

@@ -1,0 +1,349 @@
+"""Multi-replica serving: N independent decode schedulers behind one gateway.
+
+The data-parallel half of pod-scale serving (the tensor-parallel half lives
+in the scheduler's sharded step programs): a :class:`ReplicaSet` fronts N
+:class:`~deepspeed_tpu.inference.scheduler.DecodeScheduler` replicas — each
+its own slot pool (tp-sharded over the mesh's ``tensor`` axis when tp>1) —
+behind one dispatch policy, in the AlpaServe/"replica groups" sense rather
+than N processes: one weight tree, ONE compiled program set (replicas share
+the primary scheduler's program cache, so replica count adds ZERO XLA
+programs), N independent KV pools and decode loops.
+
+Dispatch policy (the gateway's fair queue pops in DRR order, then this
+layer places):
+
+- **Prefix-sticky**: prompts whose leading ``prefill_chunk`` tokens match a
+  previously-dispatched prompt route to the replica that served it — that
+  replica's radix trie holds the prefix, so admission copies KV instead of
+  recomputing prefill. The sticky index is a bounded host-side LRU keyed on
+  the leading chunk (NOT a cross-thread read of another replica's trie —
+  pump threads own their schedulers), re-pointed whenever placement falls
+  elsewhere, so it tracks the most recent owner exactly like the trie's MRU
+  donor choice.
+- **Least-loaded**: otherwise the replica minimizing expected drain time —
+  ``(busy_slots + 1) x service-time EMA`` (the same EMA the gateway's
+  Retry-After advertises, tracked per replica) — with a round-robin tie
+  break so an idle fleet doesn't pile onto replica 0.
+
+Per-replica lifecycle: ``drain(i)`` stops placement and lets in-flight work
+finish (resumable); a replica whose ``step()`` raises is marked **sick** —
+its requests fail, its sticky entries purge, and the rest of the fleet keeps
+serving (one sick replica sheds instead of sinking the fleet). A sick
+replica can be ``resume()``d after operator intervention.
+
+Why replicas (vs one bigger pool): each replica is its own scheduler loop —
+on a pod, its own tensor-sharded device group stepping independently; on
+one host, independent pools whose aggregate KV capacity (and radix
+residency) scales with N. Compile count stays O(1) because programs are
+per-shard-SHAPE, not per-replica.
+
+Telemetry: gauges ``serving/replica/<id>/{slot_occupancy,queue_depth,
+tok_s}``; counters ``serving/replica/<id>/{dispatched,tokens}``,
+``serving/dispatch/{sticky,least_loaded}``, ``serving/replica_sick``,
+``serving/replica_drains``. All reach ``/v1/metrics`` JSON and render as
+labeled Prometheus series (``telemetry/prometheus.py``).
+"""
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+
+class Replica:
+    """One scheduler + its fleet bookkeeping (placement load signals,
+    health/drain state, throughput EMA). The scheduler itself stays
+    single-threaded: exactly one pump thread calls :meth:`step`."""
+
+    def __init__(self, idx, scheduler, telemetry=None):
+        self.idx = idx
+        self.scheduler = scheduler
+        self.telemetry = telemetry if telemetry is not None else scheduler.telemetry
+        self.draining = False
+        self.sick = False
+        self.sick_error = None
+        self.dispatched = 0
+        self.tokens = 0
+        self.ema_service_s = None   # per-replica Retry-After-style service EMA
+        self.tok_s = 0.0            # EWMA of delivered tokens/sec
+        self._last_step_end = None
+
+    # ---------------------------------------------------------------- load
+    def busy_slots(self):
+        s = self.scheduler
+        return (s.cache.active_slots + len(s.queue)
+                + (1 if s._prefill is not None else 0))
+
+    def has_capacity(self):
+        return self.busy_slots() < self.scheduler.num_slots
+
+    def available(self):
+        """Placement-eligible: healthy and accepting new work."""
+        return not self.sick and not self.draining
+
+    def idle(self):
+        s = self.scheduler
+        return not (s.active or s.queue or s._prefill is not None)
+
+    def expected_drain_s(self, fallback_ema):
+        """Placement score: expected time for this replica's backlog (+ the
+        incoming request) to clear at its measured service rate."""
+        ema = self.ema_service_s if self.ema_service_s is not None else fallback_ema
+        return (self.busy_slots() + 1) * ema / max(1, self.scheduler.num_slots)
+
+    # ---------------------------------------------------------------- loop
+    def step(self):
+        """One scheduler iteration plus throughput accounting. Called ONLY
+        from this replica's pump thread."""
+        t0 = time.monotonic()
+        delivered = self.scheduler.step()
+        now = time.monotonic()
+        self.tokens += delivered
+        # inter-step host overhead counts, but an IDLE gap (pump parked
+        # waiting for work) must not: a lull would fold a near-zero sample
+        # into the EWMA and understate a lightly-loaded replica
+        prev = self._last_step_end
+        start = prev if (prev is not None and t0 - prev < 1.0) else t0
+        dt = now - start
+        self._last_step_end = now
+        if dt > 0:
+            inst = delivered / dt
+            self.tok_s = inst if self.tok_s == 0.0 else 0.9 * self.tok_s + 0.1 * inst
+        tel = self.telemetry
+        if tel.enabled:
+            tel.gauges([
+                (f"serving/replica/{self.idx}/slot_occupancy",
+                 self.scheduler.cache.occupancy(), None),
+                (f"serving/replica/{self.idx}/queue_depth",
+                 float(len(self.scheduler.queue)), None),
+                (f"serving/replica/{self.idx}/tok_s", self.tok_s, None)])
+            if delivered:
+                tel.counter(f"serving/replica/{self.idx}/tokens", delivered)
+        return delivered
+
+    def observe_service(self, service_s):
+        """Fold one naturally-completed request's wall time into the
+        placement EMA (same exclusion rule as the gateway's Retry-After EMA:
+        cancelled/failed requests don't count)."""
+        self.ema_service_s = (service_s if self.ema_service_s is None
+                              else 0.9 * self.ema_service_s + 0.1 * service_s)
+
+    def state(self):
+        s = self.scheduler
+        return {
+            "idx": self.idx,
+            "status": ("sick" if self.sick else
+                       "draining" if self.draining else "active"),
+            "error": self.sick_error,
+            "num_slots": s.num_slots,
+            "active_slots": s.cache.active_slots,
+            "cached_slots": s.cache.cached_slots,
+            "queue_depth": len(s.queue),
+            "slot_occupancy": round(s.cache.occupancy(), 4),
+            "dispatched": self.dispatched,
+            "tokens": self.tokens,
+            "tok_s": round(self.tok_s, 2),
+            "ema_service_s": self.ema_service_s,
+            "tp_size": s.tp_size,
+            "prefix_cache_hit_rate": (round(s.radix.hit_rate(), 4)
+                                      if s.radix is not None else None),
+        }
+
+
+class ReplicaSet:
+    """N replicas behind one dispatch policy. Thread-safe: the gateway's
+    pump threads race :meth:`dispatch`/:meth:`route` under the internal
+    lock; each replica's ``step`` stays exclusive to its own pump."""
+
+    def __init__(self, replicas, sticky_capacity=2048):
+        if not replicas:
+            raise ValueError("ReplicaSet needs at least one replica")
+        self.replicas = list(replicas)
+        self.telemetry = self.replicas[0].telemetry
+        self._lock = threading.RLock()
+        self._rr = 0  # round-robin tie break cursor
+        # sticky prefix index: leading-chunk key -> replica idx (bounded LRU)
+        self._sticky = collections.OrderedDict()
+        self._sticky_capacity = int(sticky_capacity)
+        chunk = self.primary.prefill_chunk
+        self._sticky_chunk = chunk if chunk > 0 else 64
+
+    # ---------------------------------------------------------------- build
+    @classmethod
+    def build(cls, engine, n=None, **scheduler_overrides):
+        """N replicas over ONE engine: replica 0 is the engine's singleton
+        scheduler (so a single-replica gateway is byte-for-byte the
+        pre-replica path), siblings clone its exact configuration and share
+        its compiled-program cache — same shapes, same programs, zero new
+        XLA compiles per added replica. ``n`` defaults to the engine's
+        ``continuous_batching.replicas``."""
+        from ..inference.scheduler import DecodeScheduler
+        if n is None:
+            n = int(getattr(engine._config.continuous_batching, "replicas", 1) or 1)
+        if n < 1:
+            raise ValueError(f"replicas must be >= 1, got {n}")
+        primary = engine.scheduler(**scheduler_overrides)
+        scheds = [primary]
+        for _ in range(1, n):
+            scheds.append(DecodeScheduler(engine, compiled_cache=primary._compiled,
+                                          **primary._init_kwargs))
+        return cls([Replica(i, s) for i, s in enumerate(scheds)])
+
+    @property
+    def primary(self):
+        return self.replicas[0].scheduler
+
+    def __len__(self):
+        return len(self.replicas)
+
+    def __iter__(self):
+        return iter(self.replicas)
+
+    # ---------------------------------------------------------------- fleet state
+    def total_slots(self):
+        """Slots across placement-eligible replicas (the gateway's
+        Retry-After backlog math divides by this)."""
+        return sum(r.scheduler.num_slots for r in self.replicas
+                   if r.available()) or self.replicas[0].scheduler.num_slots
+
+    def any_capacity(self):
+        return any(r.available() and r.has_capacity() for r in self.replicas)
+
+    def healthy(self):
+        return [r for r in self.replicas if not r.sick]
+
+    def all_sick(self):
+        return all(r.sick for r in self.replicas)
+
+    def compiled_program_count(self):
+        """One shared program set — the fleet's compile count IS the
+        primary's (the O(1)-in-replicas guard reads this)."""
+        return self.primary.compiled_program_count()
+
+    def states(self):
+        return [r.state() for r in self.replicas]
+
+    # ---------------------------------------------------------------- lifecycle
+    def drain(self, idx):
+        """Stop placing onto replica ``idx``; in-flight work finishes (its
+        pump keeps stepping). Idempotent; resumable."""
+        with self._lock:
+            rep = self.replicas[idx]
+            rep.draining = True
+            self._purge_sticky(idx)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/replica_drains")
+        return rep.state()
+
+    def resume(self, idx):
+        """Re-admit replica ``idx`` to placement (clears drain AND sick —
+        resuming a sick replica is the operator asserting it recovered)."""
+        with self._lock:
+            rep = self.replicas[idx]
+            rep.draining = False
+            rep.sick = False
+            rep.sick_error = None
+        return rep.state()
+
+    def mark_sick(self, idx, error):
+        """Health-out replica ``idx`` (its step raised): no further
+        placement, sticky entries purge so its prompt families re-home.
+        Idempotent — re-marking an already-sick replica neither
+        re-increments the health-out counter nor re-scans the sticky map
+        (a persistently-raising backend would otherwise spin both)."""
+        with self._lock:
+            rep = self.replicas[idx]
+            if rep.sick:
+                return
+            rep.sick = True
+            rep.sick_error = str(error)[:500]
+            self._purge_sticky(idx)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/replica_sick")
+
+    def _purge_sticky(self, idx):
+        for key in [k for k, v in self._sticky.items() if v == idx]:
+            del self._sticky[key]
+
+    # ---------------------------------------------------------------- dispatch
+    def _sticky_key(self, prompt):
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        return p[:self._sticky_chunk].tobytes()
+
+    def route(self, prompt):
+        """The replica to place ``prompt`` on, or None when no eligible
+        replica has a free slot. Sticky first, least-loaded otherwise; the
+        sticky index re-points to wherever placement actually lands, so the
+        NEXT matching prompt follows the freshest cached copy."""
+        with self._lock:
+            candidates = [r for r in self.replicas
+                          if r.available() and r.has_capacity()]
+            if not candidates:
+                return None
+            key = self._sticky_key(prompt)
+            hit = self._sticky.get(key)
+            tel = self.telemetry
+            if hit is not None:
+                rep = self.replicas[hit]
+                if rep.available() and rep.has_capacity():
+                    self._sticky.move_to_end(key)
+                    if tel.enabled:
+                        tel.counter("serving/dispatch/sticky")
+                    return rep
+                if not rep.available():
+                    del self._sticky[key]  # sick/draining owner: re-home
+            known = [r.ema_service_s for r in candidates
+                     if r.ema_service_s is not None]
+            fallback = (sum(known) / len(known)) if known else 1.0
+            n = len(self.replicas)
+            rep = min(candidates,
+                      key=lambda r: (r.expected_drain_s(fallback),
+                                     (r.idx - self._rr) % n))
+            self._rr = (rep.idx + 1) % n
+            self._record_sticky(key, rep.idx)
+            if tel.enabled:
+                tel.counter("serving/dispatch/least_loaded")
+            return rep
+
+    def _record_sticky(self, key, idx):
+        self._sticky[key] = idx
+        self._sticky.move_to_end(key)
+        while len(self._sticky) > self._sticky_capacity:
+            self._sticky.popitem(last=False)
+
+    def dispatch(self, prompt, **submit_kwargs):
+        """Route + submit in one step: returns ``(replica, handle)`` or
+        ``(None, None)`` when the fleet has no free slot. The direct-drive
+        entry point for benches/tests; the gateway calls :meth:`route` and
+        submits itself (it owns request bookkeeping)."""
+        rep = self.route(prompt)
+        if rep is None:
+            return None, None
+        handle = rep.scheduler.submit(prompt, **submit_kwargs)
+        self.note_dispatch(rep)
+        return rep, handle
+
+    def note_dispatch(self, rep):
+        """Account one placement on ``rep`` (called after a successful
+        submit so failed validation doesn't skew the counters)."""
+        rep.dispatched += 1
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter(f"serving/replica/{rep.idx}/dispatched")
+
+    # ---------------------------------------------------------------- drive (testing/bench)
+    def drain_all_work(self):
+        """Single-threaded convenience pump: step every replica until the
+        whole fleet is idle (benches and tests; the gateway runs one pump
+        thread per replica instead)."""
+        while True:
+            progressed = False
+            for rep in self.replicas:
+                if not rep.idle() and not rep.sick:
+                    rep.step()
+                    progressed = True
+            if not progressed:
+                return

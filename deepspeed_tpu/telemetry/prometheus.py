@@ -6,9 +6,11 @@ a standard scraper pointed at ``GET /v1/metrics`` with the usual
 ``Accept: text/plain`` header works with zero glue. Mapping:
 
 - counters -> ``# TYPE ... counter`` with a ``_total`` suffix;
-  ``gateway/tenant/<t>/tokens`` and ``comm/<op>/<group>/bytes`` become
-  labeled series instead of a per-tenant/per-group metric-name explosion.
-- gauges   -> ``# TYPE ... gauge``.
+  ``gateway/tenant/<t>/tokens``, ``comm/<op>/<group>/bytes``, and
+  ``serving/replica/<id>/...`` become labeled series instead of a
+  per-tenant/per-group/per-replica metric-name explosion.
+- gauges   -> ``# TYPE ... gauge`` (``serving/replica/<id>/...`` gauges
+  fold into labeled series the same way).
 - histograms -> ``# TYPE ... summary`` (the sink keeps windowed quantiles,
   not cumulative buckets): ``{quantile="0.5|0.95|0.99"}`` + ``_sum`` +
   ``_count``.
@@ -22,6 +24,7 @@ import re
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 _TENANT_RE = re.compile(r"^gateway/tenant/(?P<tenant>.+)/tokens$")
 _COMM_RE = re.compile(r"^comm/(?P<op>[^/]+)/(?P<group>[^/]+)/bytes$")
+_REPLICA_RE = re.compile(r"^serving/replica/(?P<replica>\d+)/(?P<metric>.+)$")
 
 _PREFIX = "dstpu_"
 
@@ -53,7 +56,7 @@ def _fmt(value):
 
 
 def _counter_series(raw_name):
-    """(metric_name, label_pairs) for one counter, folding the two
+    """(metric_name, label_pairs) for one counter, folding the
     client/topology-cardinality families into labels."""
     m = _TENANT_RE.match(raw_name)
     if m:
@@ -62,7 +65,21 @@ def _counter_series(raw_name):
     if m:
         return _PREFIX + "comm_bytes_total", [("op", m.group("op")),
                                               ("group", m.group("group"))]
+    m = _REPLICA_RE.match(raw_name)
+    if m:
+        return (_name("serving/replica/" + m.group("metric")) + "_total",
+                [("replica", m.group("replica"))])
     return _name(raw_name) + "_total", []
+
+
+def _gauge_series(raw_name):
+    """(metric_name, label_pairs) for one gauge — per-replica serving
+    gauges fold into one labeled family per metric."""
+    m = _REPLICA_RE.match(raw_name)
+    if m:
+        return (_name("serving/replica/" + m.group("metric")),
+                [("replica", m.group("replica"))])
+    return _name(raw_name), []
 
 
 def render(snapshot, extra_gauges=None):
@@ -94,10 +111,16 @@ def render(snapshot, extra_gauges=None):
     for raw, value in (extra_gauges or {}).items():
         if value is not None:
             all_gauges[raw] = value
+    # group by RESOLVED name (same contiguity rule as counters: the
+    # per-replica labeled families must not interleave with plain gauges)
+    gauge_groups = {}
     for raw, value in sorted(all_gauges.items()):
-        name = _name(raw)
+        name, labels = _gauge_series(raw)
+        gauge_groups.setdefault(name, []).append((labels, value))
+    for name in sorted(gauge_groups):
         header(name, "gauge")
-        lines.append(f"{name} {_fmt(value)}")
+        for labels, value in gauge_groups[name]:
+            lines.append(f"{name}{_labels(labels)} {_fmt(value)}")
 
     for raw, h in sorted(snapshot.get("histograms", {}).items()):
         name = _name(raw)

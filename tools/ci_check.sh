@@ -2,12 +2,13 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
 #   tools/ci_check.sh --observability  # tracing/SLO/flight-recorder lane only
 #   tools/ci_check.sh --rlhf     # RLHF hybrid-engine lane only
+#   tools/ci_check.sh --sharded  # tensor-sharded decode + replica-set lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -57,6 +58,21 @@ rlhf_lane() {
   # checkpoint round-trip + scheduler rollout tok/s).
   timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
     tests/unit/rlhf tests/unit/test_hybrid_engine.py -q -p no:cacheprovider
+}
+
+sharded_lane() {
+  echo "== sharded serving lane =="
+  # pod-scale serving guards under the forced multi-CPU-device backend:
+  # tp=2 scheduler decode (greedy/sampled/radix/spec/int8-KV, XLA + Pallas
+  # paths) must match tp=1 BIT-FOR-BIT (the bitwise all-gather layout), the
+  # int8 fused-qkv tp gating must fall back loudly, and the replica set
+  # must dispatch (least-loaded + prefix-sticky + drain/health) while
+  # adding ZERO XLA programs per replica (jax.monitoring guard). The
+  # matching perf leg is `python bench.py serving` ("replicas" entry).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" python -m pytest \
+    tests/unit/inference/test_sharded_decode.py \
+    tests/unit/serving/test_replica.py -q -p no:cacheprovider
 }
 
 observability_lane() {
@@ -118,6 +134,10 @@ if [ "${1:-}" = "--rlhf" ]; then
   rlhf_lane
   exit $?
 fi
+if [ "${1:-}" = "--sharded" ]; then
+  sharded_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -148,7 +168,10 @@ ob_rc=$?
 rlhf_lane
 rl_rc=$?
 
+sharded_lane
+sh_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ]
