@@ -342,6 +342,8 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
                lambda: _replicas_bench(make, num_slots, max_new, seed,
                                        n_replicas=int(os.environ.get(
                                            "BENCH_SERVING_REPLICAS", "2"))))
+    _guard_leg(results, "hier_kv",
+               lambda: _hier_kv_bench(make, num_slots, max_new, seed))
     _guard_leg(results, "speculative",
                lambda: _speculative_bench(make, num_slots, n_requests, max_new, seed))
     _guard_leg(results, "kv_int8",
@@ -546,6 +548,132 @@ def _replicas_bench(make, num_slots, max_new, seed, n_replicas=2):
     if lo.get("tokens_per_sec") and hi.get("tokens_per_sec"):
         out["speedup"] = round(hi["tokens_per_sec"] / lo["tokens_per_sec"], 3)
         out["scaling_efficiency"] = round(out["speedup"] / n_replicas, 3)
+        if lo.get("ttft_ms_p95") and hi.get("ttft_ms_p95"):
+            out["ttft_p95_speedup"] = round(lo["ttft_ms_p95"] / hi["ttft_ms_p95"], 3)
+    return out
+
+
+def _hier_kv_bench(make, num_slots, max_new, seed, rounds=3):
+    """Hierarchical-KV leg: an LRU-thrashing revisit stream with NO
+    contrived prompt families (the PR 10 replicas leg had to size family
+    working sets to fleet capacity to dodge cold replicas — this leg is the
+    honest version of that traffic). A working set of W distinct long
+    prompts (W ≈ 2x the slot pool) is revisited cyclically with a fresh
+    short suffix per visit — every revisit is a device-LRU miss by
+    construction. Device-only retention recomputes every prefix; the host
+    tier demotes evicted prefixes and restores them on revisit. Reports
+    tok/s, TTFT p50/p95, combined tier hit rate, demote/restore counts, and
+    a restore_ms-vs-cold_prefill_ms crossover table by prefix length (the
+    restore-vs-recompute threshold evidence for SERVING.md)."""
+    from deepspeed_tpu.memory.prefix_store import GlobalPrefixStore
+
+    chunk = 16
+    W = 2 * num_slots + 2
+    rng = np.random.default_rng(seed + 31)
+    out = {"working_set": W, "rounds": rounds, "prefill_chunk": chunk}
+    prompts = None
+    for label in ("device_only", "hier_kv"):
+        eng = make(True)
+        overrides = dict(num_slots=num_slots, prefill_chunk=chunk)
+        if label == "hier_kv":
+            overrides["prefix_store"] = GlobalPrefixStore(
+                capacity_bytes=512 << 20, telemetry=eng.telemetry)
+        sched = eng.scheduler(**overrides)
+        if sched.radix is None:
+            return {"skipped": "hier_kv leg needs the chunked radix path"}
+        budget = 2 * sched.steps_per_sync
+        cap = sched.max_len - max_new - budget
+        n_chunks = min(5, (cap - 8) // chunk)
+        if n_chunks < 2:
+            return {"skipped": f"slot capacity {sched.max_len} too small for a "
+                               f"multi-chunk prefix at max_new={max_new}"}
+        pre_len = n_chunks * chunk
+        if prompts is None:
+            bases = [rng.integers(0, eng.model_config.vocab_size, pre_len)
+                     .astype(np.int32) for _ in range(W)]
+            # cyclic revisits, fresh 2-6 token suffix per visit: prefix KV is
+            # the only reusable part, exactly the follow-up-turn shape
+            prompts = [np.concatenate([bases[i % W],
+                                       rng.integers(0, eng.model_config.vocab_size,
+                                                    int(rng.integers(2, 7)))
+                                       .astype(np.int32)])
+                       for i in range(W * rounds)]
+            out["prefix_tokens"] = int(pre_len)
+        # warm every program the stream touches: cold + repeat (copy program)
+        # + an eviction/restore cycle on the hier leg (slice/restore programs)
+        warm = np.concatenate([np.full(pre_len, 3, np.int32), [7, 8, 9]])
+        sched.submit(warm, max_new_tokens=budget + 2).result()
+        sched.submit(warm, max_new_tokens=budget + 2).result()
+        if label == "hier_kv":
+            for k in range(num_slots + 1):
+                sched.submit(np.full(pre_len + k + 1, 11 + k, np.int32),
+                             max_new_tokens=2).result()
+            sched.submit(warm, max_new_tokens=2).result()  # restore warms
+        sched.radix.hits = sched.radix.misses = sched.radix.evictions = 0
+        if sched.kv_tier is not None:
+            sched.kv_tier.restores = sched.kv_tier.demotes = 0
+            sched.kv_tier.restored_tokens = 0
+        n_programs = sched.compiled_program_count()
+        t0 = time.perf_counter()
+        handles = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+        toks = sum(len(h.result()) for h in handles)
+        dt = time.perf_counter() - t0
+        ttfts = sorted((h._req.first_token_ts - h._req.submit_ts) * 1e3
+                       for h in handles if h._req.first_token_ts is not None)
+        hits, misses = sched.radix.hits, sched.radix.misses
+        restores = sched.kv_tier.restores if sched.kv_tier is not None else 0
+        entry = {
+            "tokens_per_sec": round(toks / dt, 1),
+            "ttft_ms_p50": round(float(np.percentile(ttfts, 50)), 2) if ttfts else None,
+            "ttft_ms_p95": round(float(np.percentile(ttfts, 95)), 2) if ttfts else None,
+            "device_hit_rate": round(hits / max(1, hits + misses), 3),
+            "tier_hit_rate": round((hits + restores)
+                                   / max(1, hits + misses + restores), 3),
+            "evictions": sched.radix.evictions,
+            "compiled_programs_after_stream": sched.compiled_program_count(),
+            "new_programs_in_stream": sched.compiled_program_count() - n_programs,
+        }
+        if sched.kv_tier is not None:
+            entry.update({"demotes": sched.kv_tier.demotes, "restores": restores,
+                          "restored_tokens": sched.kv_tier.restored_tokens,
+                          "host_tier": sched.kv_tier.store.stats()})
+            # restore-vs-recompute crossover: TTFT of a restored admission vs
+            # a cold prefill of the same prefix length (per prefix length)
+            crossover = {}
+            for nc in sorted({2, max(2, n_chunks // 2), n_chunks}):
+                plen = nc * chunk
+                base = rng.integers(0, eng.model_config.vocab_size, plen).astype(np.int32)
+                cold_ms, restore_ms = [], []
+                for rep in range(3):
+                    p = np.concatenate([base, [int(rep) + 1, 77]])
+                    h = sched.submit(p, max_new_tokens=2)  # cold (new prefix rep 0)
+                    h.result()
+                    if rep == 0:
+                        continue  # rep 0 built the registration; skip timing
+                    # evict base's slot so the next submit restores
+                    for k in range(num_slots + 1):
+                        sched.submit(np.full(plen + k + 1, 200 + rep + k, np.int32),
+                                     max_new_tokens=2).result()
+                    r0 = sched.kv_tier.restores
+                    h = sched.submit(np.concatenate([base, [int(rep) + 50, 78]]),
+                                     max_new_tokens=2)
+                    h.result()
+                    (restore_ms if sched.kv_tier.restores > r0 else cold_ms).append(
+                        (h._req.first_token_ts - h._req.submit_ts) * 1e3)
+                    q = np.concatenate([rng.integers(0, eng.model_config.vocab_size,
+                                                     plen).astype(np.int32), [9, 9]])
+                    h = sched.submit(q, max_new_tokens=2)  # genuinely cold prefill
+                    h.result()
+                    cold_ms.append((h._req.first_token_ts - h._req.submit_ts) * 1e3)
+                crossover[f"prefix{plen}"] = {
+                    "cold_prefill_ms": round(float(np.median(cold_ms)), 2) if cold_ms else None,
+                    "restore_ms": round(float(np.median(restore_ms)), 2) if restore_ms else None,
+                }
+            entry["crossover"] = crossover
+        out[label] = entry
+    lo, hi = out.get("device_only", {}), out.get("hier_kv", {})
+    if lo.get("tokens_per_sec") and hi.get("tokens_per_sec"):
+        out["speedup"] = round(hi["tokens_per_sec"] / lo["tokens_per_sec"], 3)
         if lo.get("ttft_ms_p95") and hi.get("ttft_ms_p95"):
             out["ttft_p95_speedup"] = round(lo["ttft_ms_p95"] / hi["ttft_ms_p95"], 3)
     return out

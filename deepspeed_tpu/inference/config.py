@@ -44,6 +44,31 @@ class MoEInferenceConfig(DeepSpeedConfigModel):
     type = ConfigField(default="standard")
 
 
+class HierarchicalKVConfig(DeepSpeedConfigModel):
+    """Hierarchical KV tier (``deepspeed_tpu/memory/``): radix-evicted
+    prefix KV demotes to a fleet-global host store (with optional NVMe
+    spill) instead of being destroyed, and admission restores matched
+    prefixes ahead of chunked prefill — restored decode is bit-identical to
+    a device-resident hit and to cold prefill. The store is shared across
+    all scheduler replicas, so any replica can restore a prefix any other
+    computed. See ``benchmarks/SERVING.md`` ("Hierarchical KV")."""
+
+    enabled = ConfigField(default=False)
+    host_capacity_mb = ConfigField(default=256, help="host-RAM budget for demoted "
+                                   "prefix KV (fleet-wide); LRU entries past it "
+                                   "spill to nvme_path, or drop when no NVMe tier "
+                                   "is configured")
+    nvme_path = ConfigField(default=None, help="directory for spilled prefix KV "
+                            "(one flat file per entry, read back through the "
+                            "shared AIO read window with submit-time look-ahead); "
+                            "None disables the NVMe tier")
+    restore_min_tokens = ConfigField(default=0, help="restore-vs-recompute "
+                                     "threshold: host matches shorter than this "
+                                     "(after prefill_chunk rounding) chunk-prefill "
+                                     "cold instead of paying the host->device "
+                                     "copy; 0 = one chunk (the structural floor)")
+
+
 class ContinuousBatchingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving path (``inference/scheduler.py``):
     iteration-level admission into a fixed slot-pool KV cache. When enabled,
@@ -98,6 +123,11 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
                                  "~1.9x the resident slots per HBM byte at a small "
                                  "bounded logit error; 'bf16'/'fp32' force a plain "
                                  "cache at that precision")
+    hierarchical_kv = ConfigField(
+        default=HierarchicalKVConfig,
+        help="hierarchical KV tier: demote radix-evicted prefixes to a "
+        "fleet-global host/NVMe store and restore them on admission "
+        "(deepspeed_tpu/memory/; see benchmarks/SERVING.md)")
     replicas = ConfigField(default=1, help="data-parallel scheduler replicas behind "
                            "the gateway (serving/replica.py): N independent slot "
                            "pools (each tp-sharded per the mesh) sharing ONE "

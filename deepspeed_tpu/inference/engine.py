@@ -577,6 +577,16 @@ class InferenceEngine:
                   "spec_ngram_max": cb.spec_ngram_max,
                   "spec_ngram_min": cb.spec_ngram_min,
                   "kv_cache_dtype": cb.kv_cache_dtype}
+            hk = cb.hierarchical_kv
+            if hk.enabled:
+                # ONE store per engine: the scheduler threads it through
+                # _init_kwargs, so every ReplicaSet sibling binds the same
+                # fleet-global host tier (the weight-tree sharing model)
+                from ..memory.prefix_store import GlobalPrefixStore
+                kw["prefix_store"] = GlobalPrefixStore(
+                    capacity_bytes=int(hk.host_capacity_mb) << 20,
+                    nvme_path=hk.nvme_path, telemetry=self.telemetry)
+                kw["restore_min_tokens"] = hk.restore_min_tokens
             kw.update(overrides)
             self._scheduler = DecodeScheduler(self, **kw)
         elif overrides:

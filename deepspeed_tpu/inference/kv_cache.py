@@ -331,6 +331,11 @@ class RadixPrefixCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0  # whole-trie drops (weight swaps)
+        # hierarchical KV tier (deepspeed_tpu/memory/kv_tier.KVTier): when
+        # attached, evicted registrations DEMOTE their prefix KV to the
+        # fleet-global host store instead of being destroyed, and
+        # invalidate_all drops the host tier too
+        self.tier = None
 
     # ------------------------------------------------------------------ core
     def _touch(self, slot):
@@ -468,9 +473,34 @@ class RadixPrefixCache:
             return None
         spared = [s for s in candidates if s != prefer_not]
         victim = min(spared or candidates, key=lambda s: self._lru.get(s, 0))
+        if self.tier is not None and len(self._slot_node[victim].slots) == 1:
+            # hierarchical KV: the registration dies but its prefix rows
+            # demote to the host tier BEFORE removal (the tier needs the
+            # registered token key, reconstructed from the trie path).
+            # Only the LAST device copy demotes: a sibling registration at
+            # the same node holds the identical key (same prompt admitted
+            # twice), so the bytes survive on device — demoting one copy
+            # would put the key in BOTH tiers and break one-tier-per-key
+            self.tier.demote(victim, self.registered_tokens(victim))
         self.remove(victim)
         self.evictions += 1
         return victim
+
+    def registered_tokens(self, slot):
+        """The full token sequence ``slot`` registered (reconstructed from
+        the trie path — edges concatenated root→registration node), or ()
+        when unregistered. The demotion path keys host-tier entries on
+        this, so the trie doubles as the token storage."""
+        node = self._slot_node.get(slot)
+        if node is None:
+            return ()
+        edges = []
+        while node is not self.root:
+            edges.append(node.edge)
+            node = node.parent
+        out = tuple(t for edge in reversed(edges) for t in edge)
+        assert len(out) == self._slot_len[slot], (slot, len(out))
+        return out
 
     def registered_len(self, slot):
         """Token length of ``slot``'s registered prefix (0 if unregistered)
@@ -494,8 +524,27 @@ class RadixPrefixCache:
             self.remove(slot)
             if self.kv.state[slot] == "cached":
                 self.kv.reclaim(slot)
+        if self.tier is not None:
+            # the host tier holds KV computed under the SAME outgoing
+            # weights — serving it post-swap is the stale-KV RLHF failure
+            # mode, so the swap drops it with the device registrations
+            dropped_tokens += self.tier.invalidate()
         self.invalidations += 1
         return dropped_tokens
+
+    def check_invariants(self):
+        """Pool invariants (:meth:`SlotKVCache.check_invariants`) plus the
+        tiered-registration contract when a hierarchical KV tier is
+        attached: a prefix must never be simultaneously device-registered
+        here AND host-demoted by this same scheduler under one key (the
+        demote/restore protocol moves a prefix between tiers, never copies
+        it within one scheduler's view)."""
+        self.kv.check_invariants()
+        for slot in self._slot_node:
+            if slot not in self._slot_len or slot not in self._slot_version:
+                raise AssertionError(f"slot {slot} registration missing metadata")
+        if self.tier is not None:
+            self.tier.check_invariants(self)
 
     # ------------------------------------------------------------------ stats
     def hit_rate(self):

@@ -73,6 +73,16 @@ group-quantized K/V (per-token-row fp16 scales, ``ops/quantizer``
 so bf16 KV never materializes in HBM — roughly doubling resident slots per
 chip at a small bounded logit error.
 
+**Hierarchical KV tier** (``continuous_batching.hierarchical_kv``,
+``deepspeed_tpu/memory/``): radix-evicted prefixes DEMOTE their slot KV to
+a fleet-global host store (optional NVMe spill) through the shared
+streaming layer instead of being destroyed, and admission RESTORES the
+longest host match into the fresh slot ahead of chunked prefill — same
+rounding as a device hit, so restored == device-hit == cold stays
+bit-identical. The store is shared across the ReplicaSet, so any replica
+restores a prefix any other computed. See ``benchmarks/SERVING.md``
+("Hierarchical KV").
+
 **Weight-swap protocol** (RLHF hybrid engine, ``deepspeed_tpu/rlhf/``):
 ``pause()`` gates admission, ``flush()`` drains in-flight rows under the
 weights that prefilled them, ``swap_weights(params)`` invalidates the radix
@@ -87,7 +97,10 @@ Telemetry (PR-1 sink): gauges ``serving/slot_occupancy``,
 ``serving/kv_bytes_per_token``, ``serving/kv_cache_capacity_bytes``,
 ``serving/kv_bytes_live``; counters ``serving/admitted``,
 ``serving/evicted``, ``serving/decode_steps``, ``serving/decode_tokens``,
-``serving/prefix_cache_{hit,miss,evict}``, ``serving/spec_steps``,
+``serving/prefix_cache_{hit,miss,evict}``,
+``serving/prefix_cache_{demote,restore,restore_tokens,spill}`` (+ gauges
+``serving/kv_host_tier_bytes``, ``serving/kv_tier_hit_rate``) on the
+hierarchical tier, ``serving/spec_steps``,
 ``serving/spec_draft_tokens``, ``serving/spec_accepted_tokens``;
 histograms ``serving/ttft_ms``, ``serving/step_ms``,
 ``serving/tokens_per_step``, ``serving/prefill_stall_ms``,
@@ -262,17 +275,22 @@ class DecodeScheduler:
     def __init__(self, engine, num_slots=8, max_len=None, prefill_bucket=64,
                  collect_logits=False, steps_per_sync=4, prefill_chunk=64,
                  prefix_cache=True, spec_tokens=0, spec_ngram_max=3,
-                 spec_ngram_min=1, kv_cache_dtype="auto", compiled_cache=None):
+                 spec_ngram_min=1, kv_cache_dtype="auto", compiled_cache=None,
+                 prefix_store=None, restore_min_tokens=0):
         self.engine = engine
         # raw constructor args, so a replica set can clone this scheduler's
         # exact configuration for its sibling replicas (normalization —
-        # max_len rounding, chunk clamping — re-runs identically)
+        # max_len rounding, chunk clamping — re-runs identically).
+        # ``prefix_store`` rides along BY REFERENCE: every replica's tier
+        # client binds the same fleet-global host store, which is what makes
+        # a prefix computed on replica A restorable on replica B
         self._init_kwargs = dict(
             num_slots=num_slots, max_len=max_len, prefill_bucket=prefill_bucket,
             collect_logits=collect_logits, steps_per_sync=steps_per_sync,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
             spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
-            spec_ngram_min=spec_ngram_min, kv_cache_dtype=kv_cache_dtype)
+            spec_ngram_min=spec_ngram_min, kv_cache_dtype=kv_cache_dtype,
+            prefix_store=prefix_store, restore_min_tokens=restore_min_tokens)
         model = engine.module
         cfg = engine._config
         if max_len is None:
@@ -339,6 +357,17 @@ class DecodeScheduler:
         # chunk boundaries so a hit replays the cold path's exact programs
         self.radix = (RadixPrefixCache(self.cache)
                       if prefix_cache and self.prefill_chunk > 0 else None)
+        # hierarchical KV tier: a shared GlobalPrefixStore turns radix
+        # eviction into demotion (device -> host/NVMe) and admission into
+        # restoration — LRU pressure stops destroying reuse, and the store
+        # being fleet-global means ANY replica restores what any other
+        # computed. Chunked-radix mode only (restores replay the hit path).
+        self.kv_tier = None
+        if prefix_store is not None and self.radix is not None:
+            from ..memory.kv_tier import KVTier
+            self.kv_tier = KVTier(self, prefix_store,
+                                  min_restore_tokens=restore_min_tokens)
+            self.radix.tier = self.kv_tier
         self._prefill = None  # at most one in-flight _PrefillState
         self.queue = collections.deque()
         self.active = {}  # slot -> _Request
@@ -441,6 +470,11 @@ class DecodeScheduler:
                 f"slot capacity {self.max_len}; raise max_out_tokens/num_slots' max_len "
                 f"or shorten the request")
         self.queue.append(req)
+        if self.kv_tier is not None:
+            # hierarchical KV look-ahead: if the prompt's best host-tier
+            # match is NVMe-spilled, start the disk read now so it overlaps
+            # the request's queue wait (admission's restore joins it)
+            self.kv_tier.prefetch(req.prompt)
         if tel.enabled:
             tel.gauge("serving/queue_depth", len(self.queue))
         return SchedulerHandle(self, req)
@@ -680,8 +714,32 @@ class DecodeScheduler:
             # other slot is live); its registration is gone, but the freed
             # slot became OUR slot with the prefix rows still resident —
             # src == dst makes the copy a no-op and the hit stands
-            if m > 0 and donor is not None and (
-                    donor == slot or donor in self.radix._slot_node):
+            donor_ok = donor is not None and (
+                donor == slot or donor in self.radix._slot_node)
+            if not donor_ok:
+                m = 0
+            # hierarchical KV: probe the host tier and restore when it
+            # beats the device match (same rounding/cap as the device hit,
+            # so restored == device-hit == cold run identical chunk
+            # boundaries and the decode is bit-identical across all three)
+            hm, entry = 0, None
+            if self.kv_tier is not None:
+                hm, entry = self.kv_tier.probe(req.prompt)
+                hm = min(hm, req.prompt.size - 1)
+                hm = (hm // self.prefill_chunk) * self.prefill_chunk
+                if hm < max(self.prefill_chunk, self.kv_tier.min_restore_tokens):
+                    hm, entry = 0, None
+            restored = False
+            if entry is not None and hm > m:
+                with self.engine.mesh:
+                    restored = self.kv_tier.restore(entry, slot, hm,
+                                                    req.prompt.size)
+            if restored:
+                pos = hm
+                if tel.enabled:
+                    tel.counter("serving/prefix_cache_restore")
+                    tel.counter("serving/prefix_cache_restore_tokens", hm)
+            elif m > 0:
                 if donor != slot:
                     with self.engine.mesh:
                         self.cache.pool = self._copy_fn()(
@@ -699,9 +757,13 @@ class DecodeScheduler:
                     tel.counter("serving/prefix_cache_miss")
             if tel.enabled:
                 tel.gauge("serving/prefix_cache_hit_rate", self.radix.hit_rate())
+                if self.kv_tier is not None:
+                    tel.gauge("serving/kv_tier_hit_rate",
+                              self.kv_tier.hit_rate(self.radix))
             if tr is not None and tr.enabled:
                 tr.phase("prefix_probe", start=probe_t0, slot=slot,
-                         cached_tokens=pos, prompt=int(req.prompt.size))
+                         cached_tokens=pos, prompt=int(req.prompt.size),
+                         **({"restored": True} if restored else {}))
         self.cache.lengths[slot] = pos
         self._prefill = _PrefillState(req, pos)
 
@@ -713,6 +775,14 @@ class DecodeScheduler:
         self._prefill = None
         self.active[req.slot] = req
         if self.radix is not None:
+            if self.kv_tier is not None:
+                # a cold/device-hit prefill supersedes this scheduler's own
+                # host copy of the EXACT same prompt (restore normally
+                # consumes it; the corner cases — match rounded below a
+                # chunk, device donor at least as long — leave it behind,
+                # and registering the key on device too would break the
+                # one-tier-per-key invariant)
+                self.kv_tier.discard_exact(req.prompt)
             self.radix.insert(req.slot, req.prompt)
         req.first_token_ts = tel.now()
         if tel.enabled:

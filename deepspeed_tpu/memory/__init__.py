@@ -1,0 +1,31 @@
+"""Hierarchical memory subsystem: the shared device↔host↔NVMe streaming
+layer (``streams.py``) and the tiers built on it — the fleet-global host
+prefix store (``prefix_store.py``) and the per-scheduler serving KV tier
+(``kv_tier.py``). See ``benchmarks/SERVING.md`` ("Hierarchical KV") and
+``benchmarks/OFFLOAD.md``.
+
+Exports resolve lazily (PEP 562): ``streams`` must stay importable as a
+LEAF module (``runtime/zero/offload.py`` pulls its transfer pool at import
+time), so this package must not eagerly drag ``prefix_store``/``kv_tier``
+— whose ``runtime/swap_tensor`` imports would close the cycle — in behind
+it.
+"""
+
+_EXPORTS = {
+    "LayerStreamExecutor": "streams",
+    "TRANSFER_POOL": "streams",
+    "AioReadWindow": "streams",
+    "GlobalPrefixStore": "prefix_store",
+    "PrefixEntry": "prefix_store",
+    "KVTier": "kv_tier",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    return getattr(importlib.import_module(f".{mod}", __name__), name)

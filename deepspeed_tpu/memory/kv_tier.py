@@ -1,0 +1,225 @@
+"""Per-scheduler KV tier client: demotion and restoration between the
+device slot pool and the fleet-global host prefix store.
+
+One :class:`KVTier` hangs off each
+:class:`~deepspeed_tpu.inference.scheduler.DecodeScheduler` whose config
+enables the hierarchical KV tier. It owns the device↔host transfer
+mechanics and rides the shared streaming layer
+(:class:`~deepspeed_tpu.memory.streams.LayerStreamExecutor`):
+
+- **demote** (radix eviction → host): ONE compiled slot-slice program copies
+  the victim slot's rows out of the pool (fixed shape — the full slot; the
+  prefix slice happens host-side so the program count stays O(1) in prefix
+  length), then the device→host fetch + store registration runs through the
+  executor's BOUNDED async fetch window, so admissions that evict don't
+  stall on the copy-out — backpressure only past ``fetch_window`` in-flight
+  demotes.
+- **restore** (host store → a fresh slot, ahead of chunked prefill): the
+  entry's rows land in persistent full-slot staging buffers (rows past the
+  prefix are stale staging garbage — masked exactly like a device donor's
+  rows past the matched prefix), ride ONE fenced ``device_put`` through the
+  executor's put path, and ONE compiled slot-write program
+  (:func:`~deepspeed_tpu.inference.kv_cache.slot_update`) installs them at
+  the admitted slot. The restored rows are the bit-identical bytes the
+  demote fetched, so restored == device-hit == cold decode (the suffix
+  chunk-prefills on the same chunk boundaries either way).
+
+All dtype tiers ride through generically — the pool's flat leaf list is
+sliced/padded on the row axis (``ndim - 2``), which holds for plain bf16/
+fp32 pools and the 3-leaf int8 pool (k, v, per-token-row scales) alike.
+
+Compiled-program budget: exactly two programs (``tier_slice``,
+``tier_restore``), warmed on the first demote/restore; every cycle after
+warmup adds ZERO XLA programs (guarded by
+``tests/unit/memory/test_kv_tier.py``).
+"""
+
+import numpy as np
+
+import jax
+
+from .streams import LayerStreamExecutor
+
+
+class KVTier:
+    """Demote/restore client binding one scheduler to a shared
+    :class:`~deepspeed_tpu.memory.prefix_store.GlobalPrefixStore`.
+
+    ``min_restore_tokens``: the restore-vs-recompute threshold — a host
+    match shorter than this (after chunk rounding) chunk-prefills cold
+    instead of paying the host→device copy (restores shorter than one
+    ``prefill_chunk`` are structurally impossible: the match rounds down to
+    chunk multiples)."""
+
+    def __init__(self, scheduler, store, min_restore_tokens=0, fetch_window=2):
+        self.sched = scheduler
+        self.kv = scheduler.cache
+        self.store = store
+        self.min_restore_tokens = max(0, int(min_restore_tokens))
+        # depth 0: restore puts are point-of-use FENCED (the persistent
+        # staging buffers may be rewritten by the next restore the moment
+        # take() returns); the async half of the tier is the demote fetch
+        # window below
+        self.executor = LayerStreamExecutor(self._dispatch_restore, None,
+                                            prefetch_depth=0,
+                                            fetch_window=fetch_window)
+        self._stage = None      # persistent full-slot host staging leaves
+        self._pending = None    # (leaves, treedef) staged for the in-flight put
+        self.demotes = 0
+        self.restores = 0
+        self.restored_tokens = 0
+
+    # ------------------------------------------------------------------ demote
+    def demote(self, slot, tokens):
+        """Copy ``slot``'s registered prefix KV out of the pool and register
+        it in the store (called by ``RadixPrefixCache.evict_lru`` BEFORE the
+        registration is removed). The slice program dispatches synchronously
+        — its output owns fresh buffers, so later pool donations can't
+        corrupt it — and the device→host fetch + store put ride the bounded
+        async fetch window."""
+        m = len(tokens)
+        if m < max(self.sched.prefill_chunk, self.min_restore_tokens, 1):
+            # below the restore threshold it could never be restored (the
+            # match rounds to chunk multiples and honors min_restore_tokens)
+            # — demoting it would only waste host RAM
+            return
+        version = int(self.kv.weights_version)
+        with self.sched.engine.mesh:
+            dev = self._slice_fn()(self.kv.pool, np.int32(slot))
+        flat = jax.tree_util.tree_leaves(dev)
+        key = tuple(int(t) for t in tokens)
+        ex = self.executor
+
+        def fetch():
+            with ex.timed_fetch():
+                host = [np.asarray(jax.device_get(leaf)) for leaf in flat]
+            rows = [np.ascontiguousarray(x[(Ellipsis, slice(0, m), slice(None))])
+                    for x in host]
+            self.store.put(key, rows, version, origin=id(self))
+            self.demotes += 1
+            tel = self.sched.telemetry
+            if tel.enabled:
+                tel.counter("serving/prefix_cache_demote")
+        ex.submit_fetch(fetch)
+
+    # ------------------------------------------------------------------ probe
+    def probe(self, tokens, drain=True):
+        """Longest host-tier prefix of ``tokens`` under the scheduler's
+        weights version: ``(matched_len, entry)`` or ``(0, None)``.
+        With ``drain``, a MISS joins in-flight demotes and re-probes — a
+        prefix demoted moments ago must be probe-visible — but a hit skips
+        the join, so admissions don't stall on unrelated copy-outs (the
+        bounded-async demote window's whole point). Submit-time look-ahead
+        passes drain=False — advisory only."""
+        m, entry = self.store.probe(tokens, self.kv.weights_version)
+        if drain and entry is None and self.executor._fetches:
+            self.executor.drain_fetches()
+            m, entry = self.store.probe(tokens, self.kv.weights_version)
+        return m, entry
+
+    def prefetch(self, tokens):
+        """Submit-time look-ahead: when the prompt's best host match is
+        NVMe-spilled, start its disk read now so it overlaps the request's
+        queue wait (the restore joins it)."""
+        m, entry = self.probe(tokens, drain=False)
+        if entry is not None and entry.spill_path is not None:
+            self.store.prefetch(entry)
+        return m, entry
+
+    # ------------------------------------------------------------------ restore
+    def restore(self, entry, slot, matched, prompt_len):
+        """Install ``entry``'s rows at ``slot`` (rows ``[0, matched)``;
+        ``matched`` is already chunk-rounded by the scheduler). The entry is
+        CONSUMED (one-tier-per-key move) unless it is strictly longer than
+        the restoring prompt — then its cached tail outlives this partial
+        restore (a 64-token turn must not destroy the 512-token
+        conversation prefix it branched from), and its key can never
+        collide with the prompt's own device re-registration. Returns
+        False when a concurrent restore claimed the entry first (the caller
+        falls back to cold prefill)."""
+        leaves = self.store.pop(entry, consume=entry.length <= int(prompt_len))
+        if leaves is None:
+            return False
+        pool_leaves, treedef = jax.tree_util.tree_flatten(self.kv.pool)
+        if self._stage is None:
+            # zeros, not empty: rows past the restored prefix are masked on
+            # device exactly like a donor's garbage rows, but they must be
+            # FINITE bit patterns (uninitialized bf16 bytes can be NaN)
+            self._stage = [np.zeros(s.shape[:s.ndim - 4] + (1,) + s.shape[s.ndim - 3:],
+                                    np.dtype(s.dtype)) for s in pool_leaves]
+        for buf, rows in zip(self._stage, leaves):
+            n = min(matched, rows.shape[rows.ndim - 2])
+            buf[(Ellipsis, slice(0, n), slice(None))] = \
+                rows[(Ellipsis, slice(0, n), slice(None))]
+        self._pending = (self._stage, treedef)
+        dev = self.executor.take("restore")  # depth 0: fenced point-of-use put
+        self._pending = None
+        self.kv.pool = self._restore_fn()(self.kv.pool, dev, np.int32(slot))
+        self.restores += 1
+        self.restored_tokens += int(matched)
+        return True
+
+    def _dispatch_restore(self, name):
+        leaves, treedef = self._pending
+        return jax.device_put(jax.tree_util.tree_unflatten(treedef, leaves))
+
+    # ------------------------------------------------------------------ programs
+    def _slice_fn(self):
+        """ONE compiled slot→(B=1)-tree copy-out program (src slot is a
+        runtime scalar; the pool is NOT donated — the scheduler keeps it)."""
+        from ..inference.kv_cache import slot_slice
+        return self.sched._program(
+            "tier_slice",
+            lambda: self.sched._jit_step(lambda pool, s: slot_slice(pool, s), 0, ()))
+
+    def _restore_fn(self):
+        """ONE compiled (B=1)-tree→slot write program (dst slot runtime;
+        pool donated — the write replaces it in place)."""
+        from ..inference.kv_cache import slot_update
+        return self.sched._program(
+            "tier_restore",
+            lambda: self.sched._jit_step(
+                lambda pool, tree, s: slot_update(pool, s, tree), 0, (0, )))
+
+    def discard_exact(self, tokens):
+        """Drop this scheduler's own host entry for an exact key about to be
+        device-registered (a cold or device-hit prefill superseded it) —
+        restore normally consumes the entry, but a match that rounded below
+        a chunk or a device donor at least as long leaves it behind, and
+        holding both copies would break the one-tier-per-key invariant."""
+        self.executor.drain_fetches()
+        self.store.discard(tokens, origin=id(self))
+
+    # ------------------------------------------------------------------ invariants
+    def invalidate(self):
+        """Weight-swap path (called through
+        ``RadixPrefixCache.invalidate_all`` before the pool version bumps):
+        join in-flight demotes, then drop every store entry of the outgoing
+        version. Returns prefix tokens dropped from the host tier."""
+        self.executor.drain_fetches()
+        self.executor.invalidate()
+        return self.store.drop_version(self.kv.weights_version)
+
+    def check_invariants(self, radix):
+        """Tier half of ``RadixPrefixCache.check_invariants``: no prefix may
+        be simultaneously device-registered in ``radix`` and host-demoted BY
+        THIS SCHEDULER under the same key (cross-replica duplication is
+        legal — another replica may hold its own device copy)."""
+        self.executor.drain_fetches()
+        for slot in radix.registered_slots():
+            tokens = radix.registered_tokens(slot)
+            if self.store.contains_exact(tokens, origin=id(self)):
+                raise AssertionError(
+                    f"prefix of slot {slot} is device-registered AND host-"
+                    f"demoted by the same scheduler (key length {len(tokens)})")
+
+    def hit_rate(self, radix):
+        """Combined tier hit rate: (device hits + host restores) over all
+        admissions that probed (the ``serving/kv_tier_hit_rate`` gauge)."""
+        total = radix.hits + radix.misses + self.restores
+        return (radix.hits + self.restores) / total if total else 0.0
+
+    def stats(self):
+        return {"demotes": self.demotes, "restores": self.restores,
+                "restored_tokens": self.restored_tokens,
+                "store": self.store.stats()}

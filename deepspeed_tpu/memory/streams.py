@@ -1,0 +1,298 @@
+"""Client-agnostic device↔host↔NVMe streaming layer.
+
+This module is the transfer core that used to live inside the ZeRO-Infinity
+offload runner (``runtime/zero/param_offload.py``), extracted so BOTH halves
+of the codebase ride one pipeline:
+
+- **training offload** (``runtime/zero/param_offload.ParamStreamRunner``):
+  depth-``k`` bidirectional parameter prefetch, bounded-window async grad
+  fetch, persistent grad staging, NVMe optimizer-state look-ahead — wired
+  exactly as before (the extraction is bit-identical by construction: the
+  executor moves bytes, never math, and ``tests/unit/test_offload_stream.py``
+  holds the parity + zero-new-XLA-programs bar unchanged);
+- **serving KV tier** (``memory/kv_tier.py``): radix-evicted prefix KV
+  demotes device→host through the bounded fetch window, restores host→device
+  through the fenced put path, and spills host→NVMe through the same
+  per-slot :class:`~deepspeed_tpu.runtime.swap_tensor.read_window.AioReadWindow`
+  look-ahead the optimizer-state prefetch uses.
+
+The pieces a client composes:
+
+- :class:`LayerStreamExecutor` — the four-flow pipeline executor
+  (host→device put prefetch with completion fencing, bounded async
+  device→host fetch queue, generation-tagged persistent staging buffers,
+  and a state-prefetch hook for NVMe-backed stores).
+- :data:`TRANSFER_POOL` — the shared device↔host copy pool (copies of
+  different tensors are independent; a pool keeps multiple DMA streams in
+  flight).
+- ``AioReadWindow`` (re-exported from ``runtime/swap_tensor/read_window``) —
+  rotating per-slot AIO handles + persistent aligned buffers for NVMe reads
+  that must overlap (a shared handle's ``wait()`` would fence the look-ahead
+  reads too).
+
+Accounting contract (shared by every client so the ``overlap_efficiency``
+gauges read on one scale): DISPATCH is wall time issuing the transfer,
+REALIZED is the busy-interval UNION of fenced transfer spans (k overlapping
+transfers count each wall second once), WAIT is main-thread blocked time;
+``overlap_efficiency = 1 - exposed_wait / realized_transfer``.
+"""
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+import jax
+
+
+def __getattr__(name):
+    # AioReadWindow re-export, resolved lazily (PEP 562): this module is a
+    # LEAF — `runtime/zero/offload.py` imports its transfer pool, so a
+    # module-level import of anything under `runtime/` here would close an
+    # import cycle through `swap_tensor/__init__` -> optimizer_swapper ->
+    # zero.offload -> back to this module
+    if name == "AioReadWindow":
+        from ..runtime.swap_tensor.read_window import AioReadWindow
+        return AioReadWindow
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+# host<->device copies of different tensors are independent; issuing them
+# from a pool keeps multiple DMA streams in flight. Module-level because
+# test suites build many engines/schedulers (per-client pools would leak
+# threads).
+TRANSFER_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="memstream-io")
+
+# Transfer-completion fence pool. Fences only OBSERVE (block_until_ready +
+# a timestamp); puts dispatch from the caller's thread so DMA stays in
+# traversal order with no GIL ping-pong on the hot loop.
+_FENCE_POOL = ThreadPoolExecutor(max_workers=4, thread_name_prefix="memstream-fence")
+
+
+class LayerStreamExecutor:
+    """Double-buffered bidirectional streaming transfer executor.
+
+    Pipelines the four data flows of a streamed step against compute:
+
+    1. **Put prefetch** (host->device, both traversal directions):
+       ``take(name, ahead=...)`` returns the device tree for ``name`` and
+       issues (asynchronous) puts for the caller's next ``prefetch_depth``
+       blocks in its OWN walk order — the backward loop passes its reversed
+       layer order and gets the same look-ahead the forward loop has.
+    2. **Fetch queue** (device->host, bounded window):
+       ``submit_fetch`` runs fetches/applies on the transfer pool and
+       blocks only when more than ``fetch_window`` are in flight, so
+       sink work drains while the next block's compute runs.
+    3. **Persistent staging buffers**: ``stage_grad`` accumulates into
+       per-(block, leaf) host buffers reused across microbatches and steps
+       (generation-tagged: first write of a step overwrites in place, later
+       writes add) instead of reallocating full-model-size accumulators.
+    4. **NVMe state look-ahead**: ``schedule_state_prefetch`` forwards the
+       predicted apply order to the store so state reads run
+       ``prefetch_depth`` blocks ahead of use (no-op on the host tier,
+       whose state is already DRAM-resident, and when no store is wired).
+
+    Accounting separates DISPATCH (wall time issuing ``jax.device_put``,
+    wherever it runs), REALIZED (dispatch -> transfer-completion fence via
+    ``jax.block_until_ready`` on a fence thread; reported as the UNION of
+    in-flight spans so k overlapping transfers count each wall second once)
+    and WAIT (main-thread blocked time) — so prefetched puts stop counting
+    against the critical path and the step can report *realized* (not
+    dispatched) overlap:
+    ``overlap_efficiency = 1 - exposed_wait / realized_transfer``.
+    """
+
+    def __init__(self, dispatch_fn, store, prefetch_depth, fetch_window):
+        self._dispatch = dispatch_fn  # block name -> device pytree
+        self._store = store           # optional NVMe-backed state store
+        self.depth = max(0, int(prefetch_depth))
+        self.window = max(1, int(fetch_window))
+        self._puts = {}          # name -> in-flight put entry
+        self._fetches = deque()  # in-flight fetch futures
+        self._fences = []        # transfer-completion fence futures (per step)
+        self._grad_stage = {}    # (name, path) -> persistent host accumulator
+        self._stage_gen = {}     # (name, path) -> generation last written
+        self._gen = 0
+        self._lock = threading.Lock()
+        self.reset_stats()
+
+    def reset_stats(self):
+        self.stats = {"put_dispatch_s": 0.0, "put_wait_s": 0.0,
+                      "fetch_wait_s": 0.0, "puts": 0, "puts_prefetched": 0}
+        # realized transfer time is the UNION of in-flight spans (wall-clock
+        # busy time): with k transfers in flight, summing per-transfer
+        # durations would count the same wall second k times and bias
+        # overlap_efficiency toward 1. [accumulated_busy, last_span_end]
+        self._busy = {"put": [0.0, 0.0], "fetch": [0.0, 0.0]}
+
+    def _bump(self, key, dt):
+        with self._lock:
+            self.stats[key] += dt
+
+    def _bump_busy(self, key, t0, t1):
+        """Fold span [t0, t1] into ``key``'s busy-interval union (spans
+        arrive roughly in completion order; a span ending before an already
+        counted end is fully inside the counted region)."""
+        with self._lock:
+            acc, last = self._busy[key]
+            if t1 > last:
+                self._busy[key] = [acc + t1 - max(t0, last), t1]
+
+    def begin_step(self):
+        """Reset per-step transfer stats and advance the staging generation
+        (first ``stage_grad`` write of the new step overwrites in place)."""
+        # join stragglers before the generation bump: a fetch stranded by an
+        # aborted step would otherwise run AFTER the bump and tag its stale
+        # data with the new generation (the retry's first contribution would
+        # then accumulate instead of overwriting); a late fence would fold
+        # its span into this step's busy union with a stale start time
+        while self._fetches:
+            try:
+                self._fetches.popleft().result()
+            except Exception:  # noqa: BLE001 — the aborted step already
+                pass           # surfaced this; its data is discarded
+        for f in self._fences:
+            f.result()
+        self._fences = []
+        self._gen += 1
+        self.invalidate()
+        with self._lock:
+            self.reset_stats()
+
+    def invalidate(self):
+        """Drop in-flight puts. A normally-completed walk consumes every
+        put, but an aborted step can strand entries whose host buffers the
+        applies have since mutated — stale snapshots must never be served."""
+        self._puts.clear()
+
+    def collect_stats(self):
+        """Join outstanding fences (cheap once the step's work has drained)
+        and return this step's transfer accounting."""
+        for f in self._fences:
+            f.result()
+        self._fences = []
+        with self._lock:
+            out = dict(self.stats)
+            out["put_realized_s"] = self._busy["put"][0]
+            out["fetch_realized_s"] = self._busy["fetch"][0]
+            return out
+
+    # -- flow 1: host->device streaming --------------------------------------
+    def _dispatch_timed(self, name):
+        """Issue the put (asynchronous on the device stream) and fence its
+        completion on the observer pool. Returns (device_tree, fence)."""
+        t0 = time.perf_counter()
+        val = self._dispatch(name)
+        self._bump("put_dispatch_s", time.perf_counter() - t0)
+
+        def fence():
+            jax.block_until_ready(val)
+            self._bump_busy("put", t0, time.perf_counter())
+        f = _FENCE_POOL.submit(fence)
+        # outside a train step (eval/generate never call begin_step /
+        # collect_stats) the fence list would grow one future per put
+        # forever; prune the completed ones once it gets long
+        if len(self._fences) > 256:
+            self._fences = [p for p in self._fences if not p.done()]
+        self._fences.append(f)
+        return val, f
+
+    def prefetch(self, names):
+        """Issue puts for ``names`` now (skips in-flight blocks; no-op at
+        depth 0). ``jax.device_put`` is asynchronous, so issuing ``k``
+        blocks ahead keeps that many transfers in flight behind the
+        device's compute stream — double-buffering without handing the
+        dispatch to another thread (which would fight the hot loop for
+        the GIL and reorder DMA)."""
+        if self.depth == 0:
+            return
+        for name in names:
+            if name not in self._puts:
+                self._puts[name] = self._dispatch_timed(name)
+
+    def take(self, name, ahead=()):
+        """Device tree for ``name``. Issues ``name`` (if cold) plus
+        ``ahead`` (the caller's next blocks in walk order, truncated to the
+        prefetch depth), so the pipeline stays ``depth`` blocks deep in
+        either traversal direction. At depth 0 the put is fenced at point
+        of use — the genuinely unpipelined step: compute never overlaps a
+        transfer (the measurement baseline, and the reference's
+        no-prefetch hook semantics of fetch-then-forward)."""
+        was_ahead = name in self._puts  # issued by an EARLIER take's look-ahead
+        self.prefetch([name])
+        self.prefetch(list(ahead)[:self.depth])
+        ent = self._puts.pop(name, None)
+        t0 = time.perf_counter()
+        if ent is None:  # depth 0: synchronous point-of-use put
+            val, fence = self._dispatch_timed(name)
+            fence.result()
+        else:
+            val, _ = ent
+        with self._lock:
+            self.stats["put_wait_s"] += time.perf_counter() - t0
+            self.stats["puts"] += 1
+            self.stats["puts_prefetched"] += was_ahead
+        return val
+
+    # -- flow 2: bounded-window async fetch -----------------------------------
+    def timed_fetch(self):
+        """Context manager bracketing the device->host TRANSFER portion of a
+        fetch into the fetch busy union. The fetch fn wraps only its
+        ``device_get`` section with this — timing the whole fn would count
+        the host-side apply as 'realized transfer' and inflate
+        overlap_efficiency with compute that was never a transfer."""
+        ex = self
+
+        class _Span:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+
+            def __exit__(self, *exc):
+                ex._bump_busy("fetch", self.t0, time.perf_counter())
+                return False
+        return _Span()
+
+    def submit_fetch(self, fn):
+        """Run ``fn`` (a device->host fetch + host apply) on the transfer
+        pool; block only while more than ``fetch_window`` are in flight."""
+        self._fetches.append(TRANSFER_POOL.submit(fn))
+        t0 = time.perf_counter()
+        while len(self._fetches) > self.window:
+            self._fetches.popleft().result()
+        self._bump("fetch_wait_s", time.perf_counter() - t0)
+
+    def drain_fetches(self):
+        """Block until every in-flight fetch has landed (boundary sync:
+        same-slot fetches accumulate in place and must not race the next
+        round's contributions)."""
+        t0 = time.perf_counter()
+        while self._fetches:
+            self._fetches.popleft().result()
+        self._bump("fetch_wait_s", time.perf_counter() - t0)
+
+    # -- flow 3: persistent staging -------------------------------------------
+    def stage_grad(self, name, path, host, dtype):
+        """Accumulate ``host`` into the persistent ``(name, path)`` staging
+        buffer and return it. The buffer is allocated once and reused across
+        microbatches AND steps; the generation tag decides overwrite-vs-add."""
+        key = (name, path)
+        buf = self._grad_stage.get(key)
+        if buf is None or buf.shape != np.shape(host) or buf.dtype != np.dtype(dtype):
+            buf = np.empty(np.shape(host), dtype)
+            self._grad_stage[key] = buf
+            self._stage_gen[key] = -1
+        if self._stage_gen[key] != self._gen:
+            np.copyto(buf, host, casting="unsafe")
+            self._stage_gen[key] = self._gen
+        else:
+            np.add(buf, np.asarray(host, buf.dtype), out=buf)
+        return buf
+
+    # -- flow 4: NVMe state look-ahead ----------------------------------------
+    def schedule_state_prefetch(self, names):
+        """Issue state reads for the next blocks of the apply order (no
+        store / host tier: no-op; depth 0: disabled like the other flows)."""
+        if self.depth and names and self._store is not None:
+            self._store.schedule_state_prefetch(names[:self.depth])

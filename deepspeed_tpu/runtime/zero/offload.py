@@ -36,7 +36,6 @@ The push uses ``jax.block_until_ready`` before the next in-place host step:
 import io
 import os
 import zipfile
-from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -48,8 +47,10 @@ from ...utils.logging import logger, log_dist
 
 # host<->device copies of different leaves are independent; issuing them from
 # a pool keeps multiple DMA streams in flight (4x measured on serialized
-# links, still a win on direct PCIe)
-_TRANSFER_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="offload-io")
+# links, still a win on direct PCIe). ONE process-wide pool, owned by the
+# shared streaming layer since PR 11 — a second pool here would double the
+# I/O threads and contend for the same links
+from ...memory.streams import TRANSFER_POOL as _TRANSFER_POOL  # noqa: E402
 
 
 def _slash_path(path):

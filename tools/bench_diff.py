@@ -30,9 +30,13 @@ import sys
 # higher-is-better. Tokenized (not substring) matching: "_s" as a substring
 # would misfile tokens_per_sec_chip. "p95"/"p50" alone are ambiguous
 # (ttft_ms_p95 carries "ms" anyway), so direction keys on unit-ish tokens.
+# hier_kv leg notes: restore_ms/cold_prefill_ms regress upward via the "ms"
+# token; "spills"/"dropped" mark host-tier pressure (a round that spills or
+# drops more at the same stream is a capacity regression); tier_hit_rate /
+# restores / tokens_per_sec keep the higher-is-better default.
 _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
                  "shed", "evict", "evictions", "miss", "misses", "s", "seconds",
-                 "loss", "ppl", "perplexity"}
+                 "loss", "ppl", "perplexity", "spill", "spills", "dropped"}
 
 
 def _lower_better(path):

@@ -2,13 +2,14 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
 #   tools/ci_check.sh --observability  # tracing/SLO/flight-recorder lane only
 #   tools/ci_check.sh --rlhf     # RLHF hybrid-engine lane only
 #   tools/ci_check.sh --sharded  # tensor-sharded decode + replica-set lane only
+#   tools/ci_check.sh --hierkv   # hierarchical-KV tier lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -87,6 +88,22 @@ observability_lane() {
     tests/unit/test_observability.py -q -p no:cacheprovider
 }
 
+hierkv_lane() {
+  echo "== hierarchical-KV tier lane =="
+  # hierarchical KV guards: restored-prefix decode BIT-identical to a
+  # device-resident hit and to cold prefill (greedy+sampled x bf16/int8 KV
+  # x 1/2 replicas, cross-replica restore asserted), demote->restore->decode
+  # adds ZERO XLA programs after warmup (jax.monitoring), swap_weights drops
+  # the host tier (stale host KV is a structural error), NVMe spill
+  # round-trips bytes exactly, and the tiered eviction storm holds the
+  # one-tier-per-key invariant after every operation. The matching perf leg
+  # is `python bench.py serving` ("hier_kv" entry: LRU-thrashing revisit
+  # stream, device-only vs host tier).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/memory \
+    tests/unit/inference/test_kv_cache.py -q -p no:cacheprovider
+}
+
 bench_diff() {
   echo "== bench diff (advisory) =="
   # diff the given fresh bench JSON (or the latest committed round) against
@@ -138,6 +155,10 @@ if [ "${1:-}" = "--sharded" ]; then
   sharded_lane
   exit $?
 fi
+if [ "${1:-}" = "--hierkv" ]; then
+  hierkv_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -171,7 +192,10 @@ rl_rc=$?
 sharded_lane
 sh_rc=$?
 
+hierkv_lane
+hk_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ]
