@@ -238,13 +238,18 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
     gaps = (rng.exponential(1.0 / arrival_rate, n_requests) if arrival_rate
             else np.zeros(n_requests))
 
-    def make(continuous, telemetry=None):
+    def make(continuous, telemetry=None, cfg_extra=None):
         _comm._state["mesh"] = None
         cfg = {"dtype": dtype, "max_out_tokens": 512, "kernel_inject": kernel_inject,
                "continuous_batching": {"enabled": continuous, "num_slots": num_slots,
                                        "steps_per_sync": steps_per_sync}}
         if telemetry:
             cfg["telemetry"] = telemetry
+        if cfg_extra:
+            cb = cfg_extra.pop("continuous_batching", None)
+            cfg.update(cfg_extra)
+            if cb:
+                cfg["continuous_batching"].update(cb)
         return deepspeed_tpu.init_inference(model_name, config=cfg)
 
     results = {}
@@ -344,6 +349,10 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
                                            "BENCH_SERVING_REPLICAS", "2"))))
     _guard_leg(results, "hier_kv",
                lambda: _hier_kv_bench(make, num_slots, max_new, seed))
+    _guard_leg(results, "multi_lora",
+               lambda: _multi_lora_bench(make, num_slots, max_new, seed,
+                                         n_adapters=int(os.environ.get(
+                                             "BENCH_SERVING_MULTILORA", "4"))))
     _guard_leg(results, "speculative",
                lambda: _speculative_bench(make, num_slots, n_requests, max_new, seed))
     _guard_leg(results, "kv_int8",
@@ -550,6 +559,173 @@ def _replicas_bench(make, num_slots, max_new, seed, n_replicas=2):
         out["scaling_efficiency"] = round(out["speedup"] / n_replicas, 3)
         if lo.get("ttft_ms_p95") and hi.get("ttft_ms_p95"):
             out["ttft_p95_speedup"] = round(lo["ttft_ms_p95"] / hi["ttft_ms_p95"], 3)
+    return out
+
+
+def _multi_lora_bench(make, num_slots, max_new, seed, n_adapters=4, rounds=2):
+    """multi_lora leg: an N-adapter round-robin tenant stream (every request
+    names a different tenant's LoRA variant than the last) served two ways:
+
+    - **paged** (this PR): one base tree + the rank-bucketed adapter store;
+      heterogeneous-adapter batches decode CONCURRENTLY through one fused
+      program (per-row page gather).
+    - **rotation** (the only pre-PR alternative): merged weights per tenant,
+      rotated through the PR 9 pause/flush/swap_weights protocol — every
+      tenant switch drains the pool, invalidates all KV, and serializes.
+
+    Reports aggregate tok/s, OPEN-LOOP TTFT p95 (first token since leg
+    start — the whole round-robin burst arrives at t=0, so queue/serialize
+    time counts for both legs; rotation's serial tenant runs pay it in
+    full), adapter/page hit rates, swap counts,
+    and the swap-AMORTIZATION table: rotation throughput as the per-tenant
+    run length k grows (1 = strict round robin). ``crossover_k`` is the
+    smallest measured k where rotation reaches >= 90% of the paged
+    throughput — the operating region where merged-weight rotation stops
+    being catastrophically behind (higher = paged wins over more traffic).
+
+    Runs both legs at the model compute dtype, forcing bf16 when the bench
+    dtype is int8 (rotation needs host-mergeable weights; the paged leg
+    alone would be an unfair comparison across tiers)."""
+    import jax as _jax
+    from deepspeed_tpu.runtime.lora import LoRAModel
+
+    chunk = 16
+    cfg_extra = {"continuous_batching": {"prefill_chunk": chunk}}
+    eng = make(True, cfg_extra=dict(cfg_extra, dtype="bf16"))
+    params = _jax.device_get(eng.params)
+    rng = np.random.default_rng(seed + 57)
+    out = {"n_adapters": int(n_adapters), "rounds": rounds,
+           "prefill_chunk": chunk, "dtype": "bf16"}
+
+    # per-tenant adapters (rank 8 bucket) with nonzero deltas
+    lora = LoRAModel(eng.module, r=8, alpha=16.0)
+
+    def bump(node, key):
+        if isinstance(node, dict) and "a" in node and "b" in node \
+                and not isinstance(node["a"], dict):
+            key[0] += 1
+            import jax.numpy as jnp
+            return {"a": node["a"],
+                    "b": _jax.random.normal(_jax.random.key(key[0]),
+                                            node["b"].shape) * 0.02}
+        return {k: bump(v, key) for k, v in node.items()}
+
+    tenants = [f"tenant-{i}" for i in range(n_adapters)]
+    trees = {t: bump(lora.init_lora(params, _jax.random.key(i + 1)),
+                     [1000 * (i + 1)]) for i, t in enumerate(tenants)}
+    merged = {t: _jax.device_get(lora.merge({"base": params, "lora": tr}))
+              for t, tr in trees.items()}
+
+    # ---- paged (batched mixed-adapter) leg ---------------------------------
+    peng = make(True, cfg_extra=dict(
+        cfg_extra, dtype="bf16",
+        continuous_batching={"prefill_chunk": chunk,
+                             "multi_lora": {"enabled": True,
+                                            "pool_slots": max(2, n_adapters),
+                                            "rank_buckets": [8]}}))
+    peng.params = _jax.device_put(params)  # identical weights across legs
+    for t, tr in trees.items():
+        peng.register_adapter(t, lora_tree=tr, alpha=16.0)
+    sched = peng.scheduler(num_slots=num_slots, prefill_chunk=chunk)
+
+    # round-robin stream: per-tenant system prefix (as long as slot capacity
+    # allows, up to 4 chunks) + a fresh short suffix. The long prefix is the
+    # structural contrast: rotation's swap invalidates ALL KV per tenant
+    # switch, so it re-prefills the prefix on every revisit; the paged path
+    # retains it per adapter
+    V = eng.model_config.vocab_size
+    budget = 2 * sched.steps_per_sync
+    n_chunks = min(4, (sched.max_len - max_new - budget - 8) // chunk)
+    if n_chunks < 1:
+        return {"skipped": f"slot capacity {sched.max_len} too small for a "
+                           f"chunked tenant prefix at max_new={max_new}"}
+    pre_len = n_chunks * chunk
+    out["prefix_tokens"] = int(pre_len)
+    prefixes = {t: rng.integers(0, V, pre_len).astype(np.int32) for t in tenants}
+    n_reqs = n_adapters * rounds * 2
+    stream = [(tenants[i % n_adapters],
+               np.concatenate([prefixes[tenants[i % n_adapters]],
+                               rng.integers(0, V, 3).astype(np.int32)]))
+              for i in range(n_reqs)]
+    # warm: base + two adapters mixed (lora program variants + page loads)
+    warmup = [sched.submit(np.full(8, 3, np.int32), max_new_tokens=2)]
+    warmup += [sched.submit(np.full(8, 3, np.int32), max_new_tokens=2,
+                            adapter_id=t) for t in tenants[:2]]
+    for h in warmup:
+        h.result()
+    store = peng.adapter_store()
+    store.acquires = store.resident_hits = 0
+    t0 = time.perf_counter()
+    t0_tel = sched.telemetry.now()  # first_token_ts rides the telemetry clock
+    handles = [sched.submit(p, max_new_tokens=max_new, adapter_id=t)
+               for t, p in stream]
+    toks = sum(len(h.result()) for h in handles)
+    dt = time.perf_counter() - t0
+    ttfts = sorted((h._req.first_token_ts - t0_tel) * 1e3
+                   for h in handles if h._req.first_token_ts is not None)
+    paged_tps = toks / dt
+    out["paged"] = {
+        "tokens_per_sec": round(paged_tps, 1),
+        "ttft_ms_p95": round(float(np.percentile(ttfts, 95)), 2) if ttfts else None,
+        "adapter_hit_rate": round(store.hit_rate(), 3),
+        "adapter_loads": store.loads, "adapter_evicts": store.evicts,
+        "prefix_hit_rate": round(sched.radix.hit_rate(), 3),
+    }
+
+    # ---- merged-weight swap-rotation baseline ------------------------------
+    def rotation(run_len):
+        reng = make(True, cfg_extra=dict(cfg_extra, dtype="bf16"))
+        rsched = reng.scheduler(num_slots=num_slots, prefill_chunk=chunk)
+        # group the SAME stream into per-tenant runs of run_len
+        by_tenant = {t: [p for tt, p in stream if tt == t] for t in tenants}
+        runs = []
+        cursor = {t: 0 for t in tenants}
+        while any(cursor[t] < len(by_tenant[t]) for t in tenants):
+            for t in tenants:
+                i = cursor[t]
+                if i < len(by_tenant[t]):
+                    runs.append((t, by_tenant[t][i:i + run_len]))
+                    cursor[t] = i + run_len
+        rsched.submit(np.full(8, 3, np.int32), max_new_tokens=2).result()  # warm
+        swaps = 0
+        version = 0
+        ttfts = []
+        t0 = time.perf_counter()
+        t0_tel = rsched.telemetry.now()
+        toks = 0
+        for t, prompts in runs:
+            version += 1
+            rsched.pause()
+            rsched.flush()
+            rsched.swap_weights(_jax.device_put(merged[t]), version=version)
+            rsched.resume()
+            swaps += 1
+            hs = [rsched.submit(p, max_new_tokens=max_new) for p in prompts]
+            toks += sum(len(h.result()) for h in hs)
+            ttfts += [(h._req.first_token_ts - t0_tel) * 1e3
+                      for h in hs if h._req.first_token_ts is not None]
+        dt = time.perf_counter() - t0
+        return {"tokens_per_sec": round(toks / dt, 1),
+                "ttft_ms_p95": round(float(np.percentile(sorted(ttfts), 95)), 2)
+                if ttfts else None,
+                "swaps": swaps}
+
+    out["rotation"] = rotation(1)  # strict round robin: swap every request
+    out["speedup_vs_rotation"] = round(
+        paged_tps / max(1e-9, out["rotation"]["tokens_per_sec"]), 3)
+    out["ttft_p95_ratio_rotation_over_paged"] = (
+        round(out["rotation"]["ttft_ms_p95"] / out["paged"]["ttft_ms_p95"], 3)
+        if out["rotation"]["ttft_ms_p95"] and out["paged"]["ttft_ms_p95"] else None)
+    # swap-amortization: rotation at growing per-tenant run lengths
+    amort = {"1": out["rotation"]["tokens_per_sec"]}
+    crossover = None
+    for k in (2, rounds * 2):
+        r = rotation(k)
+        amort[str(k)] = r["tokens_per_sec"]
+        if crossover is None and r["tokens_per_sec"] >= 0.9 * paged_tps:
+            crossover = k
+    out["rotation_amortization_tok_s"] = amort
+    out["crossover_k"] = crossover  # None: rotation never caught up
     return out
 
 

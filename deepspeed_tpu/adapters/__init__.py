@@ -1,0 +1,18 @@
+"""Multi-tenant adapter serving: paged LoRA store + batched mixed-adapter
+decode on one base model (S-LoRA / Punica translated to the slot-pool
+serving stack).
+
+- :mod:`.store` — :class:`PagedAdapterStore`: adapter (A, B) pages in
+  rank-bucketed device pools (pow2 buckets keep compiled programs O(1) in
+  the adapter mix), LRU hot-load/evict through the shared
+  ``memory/streams.py`` transfer layer, version tags + invalidation
+  listeners so a reloaded adapter can never serve a stale page.
+- :mod:`.batched_lora` — the per-row gather that turns pool pages +
+  per-slot adapter indices into the ``lora_ops`` operands the transformer's
+  fused decode/prefill programs consume.
+
+See ``benchmarks/SERVING.md`` ("Multi-LoRA serving").
+"""
+
+from .store import AdapterRef, PagedAdapterStore  # noqa: F401
+from .batched_lora import gather_rows  # noqa: F401

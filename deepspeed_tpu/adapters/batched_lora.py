@@ -1,0 +1,42 @@
+"""Batched mixed-adapter decode: the per-row gather between the paged
+adapter pools and the fused decode/prefill programs.
+
+The S-LoRA/Punica insight made XLA-shaped: a heterogeneous-adapter batch
+needs no per-adapter program — each rank bucket's pool is ONE device tensor
+per projection site, a per-row ``adapter_slot`` index gathers each row's
+(A, B) pages inside the compiled step, and the model adds
+``base(x) + (x @ A_row) @ B_row`` per row (``models/transformer.py``
+``_lora_rank_delta``; the same per-row-variation fold the ``q_spans`` span
+machinery uses for chunked prefill). Rows with no adapter index the
+all-zero slot 0, so their delta is exactly zero; which rows carry which
+adapter is RUNTIME DATA, keeping the compiled-program count O(1) in
+adapter count, rank-bucket mix, and load/evict churn.
+
+The scheduler passes the program a ``lora`` argument — a tuple of
+``(slots (num_slots,) int32, {site: (A_pool, B_pool)})`` per rank bucket —
+and :func:`gather_rows` (traced inside the program) turns it into the
+``lora_ops`` layout the transformer consumes: per-bucket dicts of
+``site -> (A (L, N, in..., r), B (L, N, r, out...))`` whose leading layer
+axis scans alongside the KV cache.
+"""
+
+import jax.numpy as jnp
+
+
+def gather_rows(lora):
+    """Gather per-row adapter pages from the rank-bucket pools (traced —
+    runs inside the compiled step program).
+
+    ``lora``: tuple over rank buckets of ``(slots, sites)`` where ``slots``
+    is the per-batch-row pool-slot index (0 = the reserved all-zero page)
+    and ``sites`` maps site name -> ``(A_pool (P, L, in..., r), B_pool
+    (P, L, r, out...))``. Returns the transformer's ``lora_ops``: a tuple
+    of per-bucket dicts ``site -> (A (L, N, in..., r), B (L, N, r,
+    out...))`` — pool-slot axis gathered to batch rows, layer axis moved
+    leading so scanned models scan it with the cache."""
+    ops = []
+    for slots, sites in lora:
+        ops.append({site: (jnp.moveaxis(a_pool[slots], 0, 1),
+                           jnp.moveaxis(b_pool[slots], 0, 1))
+                    for site, (a_pool, b_pool) in sites.items()})
+    return tuple(ops)

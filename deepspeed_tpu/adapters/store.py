@@ -1,0 +1,506 @@
+"""Paged LoRA adapter store: rank-bucketed device pools with LRU
+hot-load/evict and version-tagged invalidation.
+
+The S-LoRA translation of the slot-pool KV design to ADAPTER WEIGHTS: a
+fleet serves thousands of fine-tuned variants of one base model, so adapter
+(A, B) pages live in fixed-shape device pools — one pool pair per projection
+site per RANK BUCKET (pow2 ranks, so the compiled decode programs see one
+shape per bucket regardless of which adapters are resident) — and the fused
+step gathers each row's pages by a runtime ``adapter_slot`` index
+(:mod:`.batched_lora`). Slot 0 of every bucket is reserved all-zero: rows
+with no adapter gather it and their delta is exactly zero.
+
+Residency is LRU: a request for a cold adapter hot-loads its host copy into
+a free slot (or evicts the least-recently-used UNPINNED resident) through
+the shared ``memory/streams.py`` transfer layer — a fenced ``device_put``
+plus ONE compiled per-bucket slot-write program, so load/evict churn adds
+ZERO XLA programs after the bucket's first load. Active requests PIN their
+adapter's slot (a page can never be overwritten mid-decode).
+
+Version tags: every (re)registration of an adapter id bumps its ``version``
+and mints a fresh ``uid``. KV/prefix registrations key on the uid
+(``inference/kv_cache.RadixPrefixCache`` adapter axis; the host prefix
+store namespaces keys with :meth:`PagedAdapterStore.namespace`), so KV
+computed under an outdated adapter version is UNREACHABLE by construction,
+and invalidation listeners let every scheduler reclaim the dead
+registrations on its own pump thread (reload/evict fires them — a reloaded
+adapter can never serve a stale page).
+
+Shared across the :class:`~deepspeed_tpu.serving.replica.ReplicaSet`
+exactly like the weight tree and the PR 11 prefix store: one store object,
+threaded by reference through the scheduler's ``_init_kwargs``.
+
+Telemetry (PR 1/8 sink): counters ``serving/adapter_loads``,
+``serving/adapter_evicts`` (+ per-adapter ``serving/adapter/<id>/{loads,
+evicts}`` behind the 256-label cardinality cap), histogram
+``serving/adapter_swap_ms``; gauges ``serving/adapters_resident``,
+``serving/adapter_pool_bytes``, ``serving/adapter_hit_rate``.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def site_shapes(cfg):
+    """(num_layers, {site: (in_shape, out_shape)}) for a
+    :class:`~deepspeed_tpu.models.transformer.TransformerConfig` — the
+    shape table the pools are sized against and registrations validate
+    against. MoE models expose attention sites only (the dense-MLP sites
+    have no expert dispatch path)."""
+    H, nh, nkv, hd = (cfg.hidden_size, cfg.num_heads, cfg.kv_heads,
+                      cfg.head_size)
+    sites = {"q": ((H, ), (nh, hd)), "k": ((H, ), (nkv, hd)),
+             "v": ((H, ), (nkv, hd)), "o": ((nh, hd), (H, ))}
+    if getattr(cfg, "num_experts", 0) == 0:
+        F = cfg.ffn_size
+        sites["up"] = ((H, ), (F, ))
+        sites["down"] = ((F, ), (H, ))
+        if cfg.activation in ("swiglu", "geglu"):
+            sites["gate"] = ((H, ), (F, ))
+    return cfg.num_layers, sites
+
+
+def rank_bucket(rank, buckets):
+    """Smallest configured pow2 bucket holding ``rank``."""
+    for b in buckets:
+        if rank <= b:
+            return b
+    raise ValueError(f"adapter rank {rank} exceeds every configured rank "
+                     f"bucket {tuple(buckets)}; raise multi_lora.rank_buckets")
+
+
+class AdapterRef:
+    """One pinned residency: the (bucket, slot) a request's rows gather
+    from, stable until :meth:`PagedAdapterStore.release`."""
+
+    __slots__ = ("uid", "adapter_id", "bucket", "slot", "version")
+
+    def __init__(self, uid, adapter_id, bucket, slot, version):
+        self.uid = uid
+        self.adapter_id = adapter_id
+        self.bucket = bucket
+        self.slot = slot
+        self.version = version
+
+
+class _Registered:
+    __slots__ = ("adapter_id", "rank", "alpha", "version", "uid", "bucket",
+                 "leaves", "nbytes")
+
+    def __init__(self, adapter_id, rank, alpha, version, uid, bucket, leaves):
+        self.adapter_id = adapter_id
+        self.rank = rank
+        self.alpha = alpha
+        self.version = version
+        self.uid = uid
+        self.bucket = bucket
+        self.leaves = leaves  # {site: (a_padded, b_padded)} host f32, scale-folded
+        self.nbytes = int(sum(a.nbytes + b.nbytes for a, b in leaves.values()))
+
+
+class _Bucket:
+    __slots__ = ("rank", "pools", "free", "nbytes")
+
+    def __init__(self, rank, pools, free, nbytes):
+        self.rank = rank
+        self.pools = pools  # {site: (A (P, L, in..., r), B (P, L, r, out...))}
+        self.free = free    # free slot list (slot 0 reserved all-zero)
+        self.nbytes = nbytes
+
+
+class PagedAdapterStore:
+    """Rank-bucketed paged adapter store (see module docstring).
+
+    ``model_cfg``: the serving model's TransformerConfig (shape table);
+    ``pool_slots``: resident adapters per rank bucket (slot 0 is the
+    reserved zero page on top of this); ``rank_buckets``: pow2 rank tiers;
+    ``mesh``: pools pin REPLICATED under a tp>1 mesh (adapter pages are
+    tiny next to the weights; replication keeps tp>1 gathers bit-identical
+    to tp=1)."""
+
+    def __init__(self, model_cfg, pool_slots=4, rank_buckets=(8, ),
+                 telemetry=None, mesh=None):
+        self.model_cfg = model_cfg
+        self.telemetry = telemetry
+        self.mesh = mesh
+        self.pool_slots = int(pool_slots)
+        if self.pool_slots < 1:
+            raise ValueError("multi_lora.pool_slots must be >= 1")
+        bl = sorted(int(b) for b in rank_buckets)
+        if not bl or any(b < 1 or (b & (b - 1)) for b in bl):
+            raise ValueError(f"rank_buckets must be powers of two, got {rank_buckets}")
+        self.num_layers, self.sites = site_shapes(model_cfg)
+        self._lock = threading.RLock()
+        self._buckets = {b: self._build_bucket(b) for b in bl}
+        self._current = {}    # adapter_id -> _Registered (latest version)
+        self._by_uid = {}     # uid -> _Registered (current generations only)
+        self._resident = {}   # uid -> (bucket_rank, slot)
+        self._pins = {}       # uid -> pin count
+        self._zombies = set()  # superseded uids still pinned by live requests
+        self._lru = {}
+        self._tick = 0
+        self._uid = 0
+        self._write_fns = {}  # bucket -> compiled slot-write program
+        self._listeners = []  # fn(uid): fired on reload/evict/unregister
+        self._labels = set()
+        self._pending = None  # staged host leaves for the in-flight load put
+        from ..memory.streams import LayerStreamExecutor
+        # depth 0: hot-load puts are point-of-use FENCED (the staging tuple
+        # is rebuilt per load) — same pattern as the KV tier's restore path
+        self._executor = LayerStreamExecutor(self._dispatch_load, None,
+                                             prefetch_depth=0, fetch_window=1)
+        self.loads = 0
+        self.evicts = 0
+        self.acquires = 0
+        self.resident_hits = 0
+        self._gauges()
+
+    # ------------------------------------------------------------------ build
+    def _replicate(self, x):
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            return jax.device_put(x, NamedSharding(self.mesh, PartitionSpec()))
+        return jax.device_put(x)
+
+    def _build_bucket(self, rank):
+        P = self.pool_slots + 1  # + the reserved all-zero slot 0
+        L = self.num_layers
+        pools = {}
+        nbytes = 0
+        for site in sorted(self.sites):
+            in_s, out_s = self.sites[site]
+            a = self._replicate(jnp.zeros((P, L) + in_s + (rank, ), jnp.float32))
+            b = self._replicate(jnp.zeros((P, L, rank) + out_s, jnp.float32))
+            pools[site] = (a, b)
+            nbytes += a.nbytes + b.nbytes
+        return _Bucket(rank, pools, list(range(P - 1, 0, -1)), nbytes)
+
+    # ------------------------------------------------------------------ register
+    def register(self, adapter_id, lora_tree=None, sites=None, alpha=16.0,
+                 rank=None):
+        """Register (or UPDATE) adapter ``adapter_id``. ``lora_tree`` is a
+        ``runtime/lora.LoRAModel`` adapter tree (converted via
+        :func:`~deepspeed_tpu.runtime.lora.site_adapters`); ``sites`` is
+        the already-flattened ``{site: (a (L, in..., r), b (L, r, out...))}``
+        form. The scale ``alpha / rank`` is folded into ``a`` at
+        registration (host fp32), ranks pad with zeros to the bucket rank
+        (zero pages contribute exact-zero delta terms). Re-registering an
+        id bumps its version, mints a fresh uid, and fires the invalidation
+        listeners for the OLD uid — its KV/prefix registrations die, and
+        its device page (if any) frees the moment no live request pins it.
+        Returns the new version."""
+        from ..runtime.lora import site_adapters
+        if sites is None:
+            if lora_tree is None:
+                raise ValueError("register needs lora_tree or sites")
+            sites = site_adapters(jax.device_get(lora_tree))
+        unknown = set(sites) - set(self.sites)
+        if unknown:
+            raise ValueError(f"adapter {adapter_id!r} targets sites {sorted(unknown)} "
+                             f"the serving model does not expose ({sorted(self.sites)})")
+        ranks = {a.shape[-1] for a, _ in sites.values()}
+        if len(ranks) != 1:
+            raise ValueError(f"adapter {adapter_id!r} mixes ranks {sorted(ranks)}; "
+                             f"one rank per adapter")
+        r = int(rank if rank is not None else ranks.pop())
+        bucket = rank_bucket(r, sorted(self._buckets))
+        scale = float(alpha) / r
+        leaves = {}
+        for site in sorted(self.sites):
+            in_s, out_s = self.sites[site]
+            L = self.num_layers
+            a_pad = np.zeros((L, ) + in_s + (bucket, ), np.float32)
+            b_pad = np.zeros((L, bucket) + out_s, np.float32)
+            if site in sites:
+                a, b = sites[site]
+                if a.shape != (L, ) + in_s + (r, ) or b.shape != (L, r) + out_s:
+                    raise ValueError(
+                        f"adapter {adapter_id!r} site {site!r} shapes "
+                        f"{a.shape}/{b.shape} don't match the model's "
+                        f"{(L, ) + in_s + (r, )}/{(L, r) + out_s}")
+                # scale folded into `a` HERE (host fp32): the gathered page
+                # already carries alpha/r, so the compiled delta is just
+                # (x @ A) @ B — one rounding contract for every reference
+                a_pad[..., :r] = np.asarray(a, np.float32) * scale
+                b_pad[:, :r] = np.asarray(b, np.float32)
+            leaves[site] = (a_pad, b_pad)
+        with self._lock:
+            old = self._current.get(adapter_id)
+            version = (old.version + 1) if old is not None else 1
+            self._uid += 1
+            reg = _Registered(adapter_id, r, float(alpha), version, self._uid,
+                              bucket, leaves)
+            self._current[adapter_id] = reg
+            self._by_uid[reg.uid] = reg
+            if old is not None:
+                self._by_uid.pop(old.uid, None)
+                self._retire(old.uid)
+        if old is not None:
+            self._fire(old.uid)
+        return version
+
+    def unregister(self, adapter_id):
+        """Drop ``adapter_id`` entirely: its uid retires (device page freed
+        when unpinned) and the invalidation listeners fire."""
+        with self._lock:
+            reg = self._current.pop(adapter_id, None)
+            if reg is None:
+                return False
+            self._by_uid.pop(reg.uid, None)
+            self._retire(reg.uid)
+        self._fire(reg.uid)
+        return True
+
+    def _retire(self, uid):
+        """A uid stopped being current: free its device slot now, or flag
+        it zombie until the last pinning request releases it (a live
+        request's pages must stay stable mid-decode)."""
+        if uid not in self._resident:
+            return
+        if self._pins.get(uid, 0) > 0:
+            self._zombies.add(uid)
+        else:
+            self._free_slot(uid)
+
+    def _free_slot(self, uid):
+        bucket, slot = self._resident.pop(uid)
+        self._buckets[bucket].free.append(slot)
+        self._lru.pop(uid, None)
+        self._pins.pop(uid, None)
+        self._zombies.discard(uid)
+
+    # ------------------------------------------------------------------ acquire
+    def check_registered(self, adapter_id):
+        with self._lock:
+            reg = self._current.get(adapter_id)
+        if reg is None:
+            raise ValueError(f"unknown adapter_id {adapter_id!r}: register it "
+                             f"before submitting requests against it")
+        return reg
+
+    def registered(self):
+        with self._lock:
+            return sorted(self._current)
+
+    def current_uid(self, adapter_id):
+        with self._lock:
+            reg = self._current.get(adapter_id)
+            return reg.uid if reg is not None else None
+
+    def acquirable(self, adapter_id):
+        """Side-effect-free check: could :meth:`acquire` succeed right now
+        (page resident, or a free/evictable slot in its bucket)? The
+        scheduler uses this to SKIP a pool-starved request at the queue
+        head instead of head-of-line-blocking unrelated admissions; a race
+        (another pump pinning the last slot between check and acquire) just
+        falls back to the retry-next-iteration path."""
+        with self._lock:
+            reg = self._current.get(adapter_id)
+            if reg is None:
+                return True  # let acquire() raise the real error
+            if reg.uid in self._resident:
+                return True
+            bucket = self._buckets[reg.bucket]
+            if bucket.free:
+                return True
+            return any(b == reg.bucket and self._pins.get(u, 0) == 0
+                       for u, (b, _s) in self._resident.items())
+
+    def acquire(self, adapter_id):
+        """Pin ``adapter_id``'s current version resident and return its
+        :class:`AdapterRef`, hot-loading (and LRU-evicting an unpinned
+        resident if needed) on a miss. Returns None when the bucket is
+        exhausted — every slot pinned by live requests — so admission can
+        retry next iteration instead of deadlocking."""
+        tel = self.telemetry
+        with self._lock:
+            reg = self._current.get(adapter_id)
+            if reg is None:
+                raise ValueError(f"unknown adapter_id {adapter_id!r}")
+            self.acquires += 1
+            uid = reg.uid
+            res = self._resident.get(uid)
+            if res is not None:
+                self.resident_hits += 1
+                self._pin(uid)
+                return AdapterRef(uid, adapter_id, reg.bucket, res[1], reg.version)
+            bucket = self._buckets[reg.bucket]
+            if not bucket.free:
+                victim = self._evict_lru(reg.bucket)
+                if victim is None:
+                    return None  # every page pinned: caller retries
+            slot = bucket.free.pop()
+            t0 = time.perf_counter()
+            self._load(reg, slot)
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            self._resident[uid] = (reg.bucket, slot)
+            self.loads += 1
+            self._pin(uid)
+            label = self.label(adapter_id)
+        if tel is not None and tel.enabled:
+            tel.counter("serving/adapter_loads")
+            tel.counter(f"serving/adapter/{label}/loads")
+            tel.histogram("serving/adapter_swap_ms", dur_ms)
+            self._gauges()
+        return AdapterRef(uid, adapter_id, reg.bucket, slot, reg.version)
+
+    def _pin(self, uid):
+        self._pins[uid] = self._pins.get(uid, 0) + 1
+        self._tick += 1
+        self._lru[uid] = self._tick
+
+    def release(self, ref):
+        """Unpin one request's hold on ``ref``; a superseded (zombie) uid's
+        page frees on its last release."""
+        with self._lock:
+            n = self._pins.get(ref.uid, 0) - 1
+            self._pins[ref.uid] = max(0, n)
+            if n <= 0 and ref.uid in self._zombies:
+                self._free_slot(ref.uid)
+
+    def _evict_lru(self, bucket_rank):
+        """Evict the LRU unpinned resident of ``bucket_rank``'s pool (host
+        copies persist — eviction frees the device page only) and fire the
+        invalidation listeners: per the isolation contract, KV registered
+        under an adapter whose page left the device is dropped rather than
+        trusted across the reload."""
+        candidates = [u for u, (b, _s) in self._resident.items()
+                      if b == bucket_rank and self._pins.get(u, 0) == 0]
+        if not candidates:
+            return None
+        victim = min(candidates, key=lambda u: self._lru.get(u, 0))
+        reg = self._by_uid[victim]
+        self._free_slot(victim)
+        self.evicts += 1
+        tel = self.telemetry
+        label = self.label(reg.adapter_id)
+        if tel is not None and tel.enabled:
+            tel.counter("serving/adapter_evicts")
+            tel.counter(f"serving/adapter/{label}/evicts")
+        self._fire(victim)
+        return victim
+
+    # ------------------------------------------------------------------ load
+    def _dispatch_load(self, name):
+        return jax.device_put(self._pending)
+
+    def _load(self, reg, slot):
+        """Write ``reg``'s pages into ``slot`` of its bucket: fenced
+        host→device put through the shared streaming layer, then ONE
+        compiled per-bucket slot-write program (slot is a runtime scalar —
+        load/evict churn adds zero XLA programs after the bucket warms)."""
+        bucket = self._buckets[reg.bucket]
+        self._pending = {s: (reg.leaves[s][0], reg.leaves[s][1])
+                         for s in sorted(self.sites)}
+        if self.mesh is not None:
+            with self.mesh:
+                dev = self._executor.take(f"adapter_load_r{reg.bucket}")
+                bucket.pools = self._write_fn(reg.bucket)(
+                    bucket.pools, jnp.asarray(slot, jnp.int32), dev)
+        else:
+            dev = self._executor.take(f"adapter_load_r{reg.bucket}")
+            bucket.pools = self._write_fn(reg.bucket)(
+                bucket.pools, jnp.asarray(slot, jnp.int32), dev)
+        self._pending = None
+
+    def _write_fn(self, bucket_rank):
+        fn = self._write_fns.get(bucket_rank)
+        if fn is None:
+            def write(pools, slot, new):
+                # NOT donated: an in-flight step program on another replica
+                # may still be reading the old pool buffers
+                return {s: (pools[s][0].at[slot].set(new[s][0]),
+                            pools[s][1].at[slot].set(new[s][1]))
+                        for s in pools}
+            kw = {}
+            if self.mesh is not None and self.mesh.devices.size > 1:
+                from jax.sharding import NamedSharding, PartitionSpec
+                repl = NamedSharding(self.mesh, PartitionSpec())
+                kw["out_shardings"] = {s: (repl, repl) for s in sorted(self.sites)}
+            fn = self._write_fns[bucket_rank] = jax.jit(write, **kw)
+        return fn
+
+    # ------------------------------------------------------------------ program-facing
+    def bucket_keys(self):
+        return tuple(sorted(self._buckets))
+
+    def device_pools(self):
+        """{bucket_rank: {site: (A_pool, B_pool)}} — the tensors the fused
+        step programs take as runtime arguments (snapshot under the lock;
+        jax arrays are immutable, so an in-flight dispatch keeps a
+        consistent view across concurrent hot-loads)."""
+        with self._lock:
+            return {b: dict(bk.pools) for b, bk in self._buckets.items()}
+
+    # ------------------------------------------------------------------ isolation
+    def namespace(self, uid):
+        """Host-prefix-store key namespace for ``uid``: a single negative
+        sentinel token (prompt tokens are non-negative, so namespaces can
+        never collide with real prefixes). Distinct per (adapter_id,
+        version) — a stale-version entry is unreachable by construction.
+        ``None`` (base traffic) maps to the EMPTY namespace: base prefixes
+        keep their pre-adapter keys (the radix cache calls this for every
+        demote, adapter-owned or not)."""
+        if uid is None:
+            return ()
+        return (-(int(uid)) - 1, )
+
+    def namespace_of_id(self, adapter_id):
+        uid = self.current_uid(adapter_id)
+        return self.namespace(uid) if uid is not None else ()
+
+    def add_listener(self, fn):
+        """``fn(uid)`` fires when ``uid``'s page leaves the device or its
+        adapter is re-registered/unregistered — each scheduler queues the
+        uid and reclaims its KV/prefix registrations on its own pump
+        thread."""
+        self._listeners.append(fn)
+
+    def _fire(self, uid):
+        for fn in list(self._listeners):
+            try:
+                fn(uid)
+            except Exception:  # noqa: BLE001 — one listener must not wedge the store
+                from ..utils.logging import logger
+                logger.warning("adapter invalidation listener raised", exc_info=True)
+
+    # ------------------------------------------------------------------ telemetry
+    def label(self, adapter_id):
+        """Cardinality-capped telemetry label (PR 4 rule: client-supplied
+        ids must not grow the sink without bound)."""
+        if adapter_id in self._labels:
+            return adapter_id
+        if len(self._labels) < 256:
+            self._labels.add(adapter_id)
+            return adapter_id
+        return "__other__"
+
+    def hit_rate(self):
+        return self.resident_hits / self.acquires if self.acquires else 0.0
+
+    def pool_bytes(self):
+        return sum(b.nbytes for b in self._buckets.values())
+
+    def _gauges(self):
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.gauges([
+                ("serving/adapters_resident", float(len(self._resident)), None),
+                ("serving/adapter_pool_bytes", float(self.pool_bytes()), None),
+                ("serving/adapter_hit_rate", self.hit_rate(), None)])
+
+    def stats(self):
+        with self._lock:
+            return {"registered": len(self._current),
+                    "resident": len(self._resident),
+                    "pool_slots": self.pool_slots,
+                    "rank_buckets": list(self.bucket_keys()),
+                    "pool_bytes": self.pool_bytes(),
+                    "loads": self.loads, "evicts": self.evicts,
+                    "acquires": self.acquires,
+                    "hit_rate": round(self.hit_rate(), 4)}

@@ -69,6 +69,27 @@ class HierarchicalKVConfig(DeepSpeedConfigModel):
                                      "copy; 0 = one chunk (the structural floor)")
 
 
+class MultiLoRAConfig(DeepSpeedConfigModel):
+    """Multi-tenant adapter serving (``deepspeed_tpu/adapters/``): paged
+    LoRA store + batched mixed-adapter decode. Adapter (A, B) pages live in
+    rank-bucketed device pools; per-request ``adapter_id`` selects the
+    variant, heterogeneous-adapter batches decode through ONE fused program
+    (per-row gather — compile count O(1) in adapter count/mix/churn), and
+    cold adapters LRU hot-load/evict through the shared streaming layer.
+    See ``benchmarks/SERVING.md`` ("Multi-LoRA serving")."""
+
+    enabled = ConfigField(default=False)
+    pool_slots = ConfigField(default=4, help="resident adapters per rank bucket "
+                             "(on top of the reserved all-zero base page); more "
+                             "slots = less load/evict churn at more HBM")
+    rank_buckets = ConfigField(default=lambda: [8], help="pow2 LoRA rank tiers; "
+                               "an adapter lands in the smallest bucket holding "
+                               "its rank (zero-padded). One pool pair per "
+                               "projection site per bucket — each bucket adds "
+                               "its gather cost to every mixed-adapter step, so "
+                               "keep the list short")
+
+
 class ContinuousBatchingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving path (``inference/scheduler.py``):
     iteration-level admission into a fixed slot-pool KV cache. When enabled,
@@ -128,6 +149,11 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
         help="hierarchical KV tier: demote radix-evicted prefixes to a "
         "fleet-global host/NVMe store and restore them on admission "
         "(deepspeed_tpu/memory/; see benchmarks/SERVING.md)")
+    multi_lora = ConfigField(
+        default=MultiLoRAConfig,
+        help="multi-tenant adapter serving: paged LoRA store + batched "
+        "mixed-adapter decode (deepspeed_tpu/adapters/; see "
+        "benchmarks/SERVING.md)")
     replicas = ConfigField(default=1, help="data-parallel scheduler replicas behind "
                            "the gateway (serving/replica.py): N independent slot "
                            "pools (each tp-sharded per the mesh) sharing ONE "

@@ -197,6 +197,7 @@ class InferenceEngine:
                 self.telemetry = TelemetrySink(None)
         self._inflight = 0  # submitted-not-yet-fetched requests
         self._scheduler = None  # lazily-built continuous-batching scheduler
+        self._adapter_store = None  # lazily-built paged LoRA store (multi_lora)
         log_dist(
             f"InferenceEngine ready: model dtype={jnp.dtype(self.model_config.dtype).name} "
             f"{self._shard_desc()} kernel_inject={cfg.kernel_inject} "
@@ -587,12 +588,50 @@ class InferenceEngine:
                     capacity_bytes=int(hk.host_capacity_mb) << 20,
                     nvme_path=hk.nvme_path, telemetry=self.telemetry)
                 kw["restore_min_tokens"] = hk.restore_min_tokens
+            # multi-LoRA serving: one paged adapter store per engine, shared
+            # across the ReplicaSet the same way (register_adapter() before
+            # the first scheduler() call also flips this on)
+            if cb.multi_lora.enabled or self._adapter_store is not None:
+                kw["adapter_store"] = self.adapter_store()
             kw.update(overrides)
             self._scheduler = DecodeScheduler(self, **kw)
         elif overrides:
             raise ValueError("scheduler already built; overrides must be passed on "
                              "the first scheduler() call")
         return self._scheduler
+
+    def adapter_store(self):
+        """The engine's :class:`~deepspeed_tpu.adapters.PagedAdapterStore`
+        (multi-tenant adapter serving), built lazily from the
+        ``continuous_batching.multi_lora`` section. One store per engine —
+        every scheduler replica binds it by reference, so an adapter loaded
+        through any replica is resident for all of them."""
+        if self._adapter_store is None:
+            from ..adapters import PagedAdapterStore
+            ml = self._config.continuous_batching.multi_lora
+            self._adapter_store = PagedAdapterStore(
+                self.model_config, pool_slots=ml.pool_slots,
+                rank_buckets=tuple(ml.rank_buckets), telemetry=self.telemetry,
+                mesh=self.mesh)
+        return self._adapter_store
+
+    def register_adapter(self, adapter_id, lora_tree=None, sites=None,
+                         alpha=16.0, rank=None):
+        """Register (or update) a LoRA adapter for per-request serving
+        (``submit(..., adapter_id=...)`` / the gateway's ``adapter_id``
+        body field). ``lora_tree`` is a ``runtime/lora.LoRAModel`` adapter
+        tree; ``sites`` the pre-flattened ``{site: (a, b)}`` form. Builds
+        the paged store on first use (so tests and in-process callers don't
+        need the config flag); must precede the first ``scheduler()`` call
+        only when the config flag is off. Returns the adapter version."""
+        if (self._scheduler is not None
+                and getattr(self._scheduler, "adapters", None) is None):
+            raise ValueError(
+                "scheduler already built without multi-LoRA support; enable "
+                "continuous_batching.multi_lora or register adapters before "
+                "the first scheduler() call")
+        return self.adapter_store().register(adapter_id, lora_tree=lora_tree,
+                                             sites=sites, alpha=alpha, rank=rank)
 
     def submit(self, input_ids, **kwargs):
         """Pipelined generation: dispatch and return a handle WITHOUT

@@ -322,7 +322,16 @@ class RadixPrefixCache:
     def __init__(self, kv):
         self.kv = kv
         self.root = _RadixNode()
+        # adapter axis (multi-tenant LoRA serving, deepspeed_tpu/adapters/):
+        # every registration lives under its ADAPTER's root — base traffic
+        # under `self.root` (key None), each adapter uid under its own —
+        # so a prefix prefilled under adapter A is STRUCTURALLY unmatchable
+        # for adapter B (or for base): match() only walks the requesting
+        # adapter's subtree. There is no cross-adapter "wrong hit" to guard
+        # against by convention; the trees are disjoint.
+        self._roots = {None: self.root}   # adapter key (uid) -> root node
         self._slot_node = {}   # slot -> registration node
+        self._slot_adapter = {}  # slot -> adapter key at registration
         self._slot_len = {}    # slot -> retained prefix length
         self._slot_version = {}  # slot -> weights_version at registration
         self._lru = {}         # slot -> last-use tick (monotonic)
@@ -331,11 +340,16 @@ class RadixPrefixCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0  # whole-trie drops (weight swaps)
+        self.adapter_invalidations = 0  # per-adapter drops (reload/evict)
         # hierarchical KV tier (deepspeed_tpu/memory/kv_tier.KVTier): when
         # attached, evicted registrations DEMOTE their prefix KV to the
         # fleet-global host store instead of being destroyed, and
         # invalidate_all drops the host tier too
         self.tier = None
+        # adapter key -> host-store key namespace (set by the scheduler
+        # when a PagedAdapterStore is attached); () keeps base prefixes on
+        # their pre-adapter keys
+        self.adapter_ns = lambda adapter: ()
 
     # ------------------------------------------------------------------ core
     def _touch(self, slot):
@@ -350,13 +364,14 @@ class RadixPrefixCache:
             m += 1
         return m
 
-    def insert(self, slot, tokens):
-        """Register ``slot`` as holding KV for the full ``tokens`` prefix.
-        One registration per slot (re-registering raises: a slot must be
-        evicted/freed before it can carry a different prefix). The
-        registration is tagged with the pool's current ``weights_version``
-        — registering rows stamped under older weights raises, so a stale
-        prefix can never ENTER the trie, let alone be served from it."""
+    def insert(self, slot, tokens, adapter=None):
+        """Register ``slot`` as holding KV for the full ``tokens`` prefix
+        under ``adapter``'s root (None = base). One registration per slot
+        (re-registering raises: a slot must be evicted/freed before it can
+        carry a different prefix). The registration is tagged with the
+        pool's current ``weights_version`` — registering rows stamped under
+        older weights raises, so a stale prefix can never ENTER the trie,
+        let alone be served from it."""
         if slot in self._slot_node:
             raise ValueError(f"slot {slot} already registered in the prefix trie")
         if self.kv.slot_version[slot] != self.kv.weights_version:
@@ -366,7 +381,10 @@ class RadixPrefixCache:
                 f"{self.kv.weights_version}: stale-weights rows cannot register "
                 f"as reusable prefixes")
         tokens = tuple(int(t) for t in tokens)
-        node, depth = self.root, 0
+        root = self._roots.get(adapter)
+        if root is None:
+            root = self._roots[adapter] = _RadixNode()
+        node, depth = root, 0
         while depth < len(tokens):
             child = node.children.get(tokens[depth])
             if child is None:
@@ -387,18 +405,25 @@ class RadixPrefixCache:
                 node, depth = child, depth + m
         node.slots.add(slot)
         self._slot_node[slot] = node
+        self._slot_adapter[slot] = adapter
         self._slot_len[slot] = len(tokens)
         self._slot_version[slot] = self.kv.weights_version
         self.kv.refs[slot] += 1
         self._touch(slot)
 
-    def match(self, tokens):
-        """Longest registered prefix of ``tokens``: returns
-        ``(matched_len, donor_slot)`` or ``(0, None)``. Any slot in the
-        deepest matched node's subtree shares at least ``matched_len``
-        tokens with the prompt (most recently used wins)."""
+    def match(self, tokens, adapter=None):
+        """Longest prefix of ``tokens`` registered under ``adapter``'s
+        root: returns ``(matched_len, donor_slot)`` or ``(0, None)``. Any
+        slot in the deepest matched node's subtree shares at least
+        ``matched_len`` tokens with the prompt (most recently used wins).
+        Registrations under OTHER adapters (or base) are invisible — the
+        per-adapter roots make cross-adapter KV reuse structurally
+        impossible, not merely checked."""
+        root = self._roots.get(adapter)
+        if root is None:
+            return 0, None
         tokens = tuple(int(t) for t in tokens)
-        node, depth = self.root, 0
+        node, depth = root, 0
         while depth < len(tokens):
             child = node.children.get(tokens[depth])
             if child is None:
@@ -441,20 +466,25 @@ class RadixPrefixCache:
 
     def remove(self, slot):
         """Drop ``slot``'s registration (and its trie reference), pruning
-        now-empty branches."""
+        now-empty branches up to its adapter's root (an emptied adapter
+        root leaves the root table too — base keeps its permanent root)."""
         node = self._slot_node.pop(slot, None)
         if node is None:
             return False
+        adapter = self._slot_adapter.pop(slot, None)
+        root = self._roots.get(adapter, self.root)
         node.slots.discard(slot)
         del self._slot_len[slot]
         self._slot_version.pop(slot, None)
         self._lru.pop(slot, None)
         self.kv.refs[slot] -= 1
         # prune childless, slotless nodes up the path
-        while node is not self.root and not node.slots and not node.children:
+        while node is not root and not node.slots and not node.children:
             parent = node.parent
             del parent.children[node.edge[0]]
             node = parent
+        if adapter is not None and not root.slots and not root.children:
+            self._roots.pop(adapter, None)
         return True
 
     def evict_lru(self, prefer_not=None):
@@ -480,8 +510,11 @@ class RadixPrefixCache:
             # Only the LAST device copy demotes: a sibling registration at
             # the same node holds the identical key (same prompt admitted
             # twice), so the bytes survive on device — demoting one copy
-            # would put the key in BOTH tiers and break one-tier-per-key
-            self.tier.demote(victim, self.registered_tokens(victim))
+            # would put the key in BOTH tiers and break one-tier-per-key.
+            # Adapter registrations demote under their uid NAMESPACE, so a
+            # host restore can only ever serve the same (adapter, version)
+            self.tier.demote(victim, self.registered_tokens(victim),
+                             namespace=self.adapter_ns(self._slot_adapter.get(victim)))
         self.remove(victim)
         self.evictions += 1
         return victim
@@ -495,12 +528,37 @@ class RadixPrefixCache:
         if node is None:
             return ()
         edges = []
-        while node is not self.root:
+        while node.parent is not None:  # every root (base or adapter) has parent None
             edges.append(node.edge)
             node = node.parent
         out = tuple(t for edge in reversed(edges) for t in edge)
         assert len(out) == self._slot_len[slot], (slot, len(out))
         return out
+
+    def registered_adapter(self, slot):
+        """Adapter key ``slot`` registered under (None = base / unregistered)."""
+        return self._slot_adapter.get(slot)
+
+    def invalidate_adapter(self, adapter):
+        """Drop every registration under ``adapter``'s root and reclaim its
+        cached slots — fired when the adapter's device page is evicted or a
+        reload bumps its version (``PagedAdapterStore`` listeners): KV
+        registered against a page that left the device (or changed bytes)
+        must never seed a new request. LIVE slots lose their registration
+        but keep decoding — their request pinned the old page, which stays
+        resident until release; with no trie reference left the slot frees
+        (instead of retaining) when it ends. Returns tokens dropped."""
+        root = self._roots.get(adapter)
+        if root is None:
+            return 0
+        dropped = 0
+        for slot in [s for s, a in self._slot_adapter.items() if a == adapter]:
+            dropped += int(self._slot_len.get(slot, 0))
+            self.remove(slot)
+            if self.kv.state[slot] == "cached" and self.kv.refs[slot] == 0:
+                self.kv.reclaim(slot)
+        self.adapter_invalidations += 1
+        return dropped
 
     def registered_len(self, slot):
         """Token length of ``slot``'s registered prefix (0 if unregistered)
@@ -543,6 +601,24 @@ class RadixPrefixCache:
         for slot in self._slot_node:
             if slot not in self._slot_len or slot not in self._slot_version:
                 raise AssertionError(f"slot {slot} registration missing metadata")
+            if slot not in self._slot_adapter:
+                raise AssertionError(f"slot {slot} registration missing its "
+                                     f"adapter key")
+            adapter = self._slot_adapter[slot]
+            if adapter is not None and adapter not in self._roots:
+                raise AssertionError(f"slot {slot} registered under adapter "
+                                     f"{adapter!r} whose root is gone")
+            # the adapter axis is structural: the registration node must sit
+            # in ITS adapter's tree (walk to the root and compare)
+            node = self._slot_node[slot]
+            while node.parent is not None:
+                node = node.parent
+            if node is not self._roots.get(adapter, self.root):
+                raise AssertionError(f"slot {slot} registration reachable from "
+                                     f"the wrong adapter root (cross-adapter "
+                                     f"trie corruption)")
+        if set(self._slot_adapter) != set(self._slot_node):
+            raise AssertionError("adapter-key table out of sync with registrations")
         if self.tier is not None:
             self.tier.check_invariants(self)
 

@@ -83,6 +83,20 @@ bit-identical. The store is shared across the ReplicaSet, so any replica
 restores a prefix any other computed. See ``benchmarks/SERVING.md``
 ("Hierarchical KV").
 
+**Multi-LoRA serving** (``continuous_batching.multi_lora``,
+``deepspeed_tpu/adapters/``): per-request ``adapter_id`` selects a model
+variant whose (A, B) pages live in the fleet-shared rank-bucketed
+:class:`~deepspeed_tpu.adapters.PagedAdapterStore`; heterogeneous-adapter
+batches decode through ONE fused program that gathers each row's pages by a
+runtime slot index (``base(x) + (x @ A_row) @ B_row`` per projection site),
+so compile count is O(1) in adapter count, mix, and load/evict churn.
+Base-only dispatches run the byte-identical pre-adapter program variant.
+Radix/host-tier prefix registrations carry the adapter uid (per-adapter
+trie roots + negative-sentinel store namespaces): cross-adapter KV reuse is
+structurally impossible, and a page eviction or adapter reload queues an
+invalidation this scheduler drains on its own pump thread. Chunked-prefill
+mode only.
+
 **Weight-swap protocol** (RLHF hybrid engine, ``deepspeed_tpu/rlhf/``):
 ``pause()`` gates admission, ``flush()`` drains in-flight rows under the
 weights that prefilled them, ``swap_weights(params)`` invalidates the radix
@@ -104,7 +118,12 @@ hierarchical tier, ``serving/spec_steps``,
 ``serving/spec_draft_tokens``, ``serving/spec_accepted_tokens``;
 histograms ``serving/ttft_ms``, ``serving/step_ms``,
 ``serving/tokens_per_step``, ``serving/prefill_stall_ms``,
-``serving/spec_tokens_per_step``.
+``serving/spec_tokens_per_step``. Multi-LoRA adds
+``serving/adapter_{loads,evicts}`` + per-adapter
+``serving/adapter/<id>/{loads,evicts,requests,tokens}`` (256-label cap),
+``serving/adapter_swap_ms``, ``serving/adapter_kv_invalidated_tokens``, and
+gauges ``serving/adapters_resident``, ``serving/adapter_pool_bytes``,
+``serving/adapter_hit_rate``.
 """
 
 import collections
@@ -180,11 +199,11 @@ class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id", "do_sample",
                  "temperature", "top_k", "top_p", "seed", "slot", "out", "logits",
                  "done", "cancelled", "submit_ts", "first_token_ts", "collect_logits",
-                 "on_token", "trace")
+                 "on_token", "trace", "adapter_id", "adapter_ref")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, seed, collect_logits, submit_ts,
-                 on_token=None, trace=None):
+                 on_token=None, trace=None, adapter_id=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -206,6 +225,10 @@ class _Request:
         self.first_token_ts = None
         self.on_token = on_token
         self.trace = trace  # optional telemetry.tracing.RequestTrace
+        # multi-LoRA serving: the requested model variant and, once
+        # admitted, the pinned AdapterRef its rows gather pages through
+        self.adapter_id = adapter_id
+        self.adapter_ref = None
 
 
 class SchedulerHandle:
@@ -276,21 +299,23 @@ class DecodeScheduler:
                  collect_logits=False, steps_per_sync=4, prefill_chunk=64,
                  prefix_cache=True, spec_tokens=0, spec_ngram_max=3,
                  spec_ngram_min=1, kv_cache_dtype="auto", compiled_cache=None,
-                 prefix_store=None, restore_min_tokens=0):
+                 prefix_store=None, restore_min_tokens=0, adapter_store=None):
         self.engine = engine
         # raw constructor args, so a replica set can clone this scheduler's
         # exact configuration for its sibling replicas (normalization —
         # max_len rounding, chunk clamping — re-runs identically).
-        # ``prefix_store`` rides along BY REFERENCE: every replica's tier
-        # client binds the same fleet-global host store, which is what makes
-        # a prefix computed on replica A restorable on replica B
+        # ``prefix_store`` AND ``adapter_store`` ride along BY REFERENCE:
+        # every replica's tier client binds the same fleet-global host
+        # store / paged adapter pools, which is what makes a prefix (or an
+        # adapter page) computed/loaded on replica A servable on replica B
         self._init_kwargs = dict(
             num_slots=num_slots, max_len=max_len, prefill_bucket=prefill_bucket,
             collect_logits=collect_logits, steps_per_sync=steps_per_sync,
             prefill_chunk=prefill_chunk, prefix_cache=prefix_cache,
             spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
             spec_ngram_min=spec_ngram_min, kv_cache_dtype=kv_cache_dtype,
-            prefix_store=prefix_store, restore_min_tokens=restore_min_tokens)
+            prefix_store=prefix_store, restore_min_tokens=restore_min_tokens,
+            adapter_store=adapter_store)
         model = engine.module
         cfg = engine._config
         if max_len is None:
@@ -368,6 +393,24 @@ class DecodeScheduler:
             self.kv_tier = KVTier(self, prefix_store,
                                   min_restore_tokens=restore_min_tokens)
             self.radix.tier = self.kv_tier
+        # multi-LoRA serving (deepspeed_tpu/adapters/): per-request model
+        # variants gathered from the shared paged adapter store inside the
+        # fused step programs. Chunked-radix mode only — the monolithic
+        # prefill path has no adapter plumbing (submit validates). The
+        # store's invalidation listeners queue adapter uids here; step()
+        # drains them on THIS pump thread, so trie surgery never races a
+        # dispatch (the same single-threaded discipline as cancellation).
+        self.adapters = adapter_store
+        self._adapter_invalidations = collections.deque()
+        if adapter_store is not None:
+            if self.prefill_chunk <= 0:
+                raise ValueError(
+                    "multi-LoRA serving requires chunked prefill "
+                    "(prefill_chunk > 0): the monolithic prefill path has no "
+                    "per-row adapter plumbing")
+            if self.radix is not None:
+                self.radix.adapter_ns = adapter_store.namespace
+            adapter_store.add_listener(self._adapter_invalidations.append)
         self._prefill = None  # at most one in-flight _PrefillState
         self.queue = collections.deque()
         self.active = {}  # slot -> _Request
@@ -417,7 +460,7 @@ class DecodeScheduler:
     # ------------------------------------------------------------------ API
     def submit(self, prompt, max_new_tokens=64, eos_token_id=None, do_sample=False,
                temperature=1.0, top_k=0, top_p=1.0, seed=0, collect_logits=None,
-               on_token=None, trace=None):
+               on_token=None, trace=None, adapter_id=None):
         """Enqueue one request; returns a :class:`SchedulerHandle`. The
         request joins the decode batch as soon as a slot frees up.
 
@@ -437,12 +480,27 @@ class DecodeScheduler:
         the device step, never inside it). Hook exceptions are logged and
         swallowed so one bad consumer can't wedge the shared decode loop.
         Cancelled requests stop receiving callbacks; the hook is never
-        called with a token after it has seen ``done=True``."""
+        called with a token after it has seen ``done=True``.
+
+        ``adapter_id``: OPTIONAL model variant (multi-LoRA serving) — the
+        request's rows decode through that adapter's paged (A, B) pages
+        gathered inside the shared fused programs. Requires an attached
+        :class:`~deepspeed_tpu.adapters.PagedAdapterStore` with the id
+        registered; None is base-model traffic (bit-identical to the
+        pre-adapter programs)."""
         tel = self.telemetry
+        if adapter_id is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    f"request names adapter_id {adapter_id!r} but multi-LoRA "
+                    f"serving is not enabled (continuous_batching.multi_lora "
+                    f"/ scheduler adapter_store)")
+            self.adapters.check_registered(adapter_id)
         req = _Request(self._rid, prompt, max_new_tokens, eos_token_id, do_sample,
                        temperature, top_k, top_p, seed,
                        self.collect_logits if collect_logits is None else collect_logits,
-                       tel.now(), on_token=on_token, trace=trace)
+                       tel.now(), on_token=on_token, trace=trace,
+                       adapter_id=adapter_id)
         self._rid += 1
         if trace is not None:
             trace.attrs.setdefault("sched_rid", req.rid)
@@ -474,7 +532,9 @@ class DecodeScheduler:
             # hierarchical KV look-ahead: if the prompt's best host-tier
             # match is NVMe-spilled, start the disk read now so it overlaps
             # the request's queue wait (admission's restore joins it)
-            self.kv_tier.prefetch(req.prompt)
+            ns = (self.adapters.namespace_of_id(adapter_id)
+                  if (adapter_id is not None and self.adapters is not None) else ())
+            self.kv_tier.prefetch(req.prompt, namespace=ns)
         if tel.enabled:
             tel.gauge("serving/queue_depth", len(self.queue))
         return SchedulerHandle(self, req)
@@ -556,6 +616,11 @@ class DecodeScheduler:
         t0 = tel.now()
         tracing = tel.enabled and getattr(tel, "trace_requests", False)
         self._iter_links = [] if tracing else None
+        # adapter invalidations (page evicted / adapter reloaded elsewhere
+        # in the fleet) drain HERE, on the pump thread — trie surgery never
+        # races a dispatch
+        while self._adapter_invalidations:
+            self._invalidate_adapter_uid(self._adapter_invalidations.popleft())
         self._reap_cancelled()
         admitted = 0
         if self._paused:
@@ -564,10 +629,28 @@ class DecodeScheduler:
             while self.queue and self.queue[0].cancelled:
                 self.queue.popleft().done = True
             if self._prefill is None and self.queue:
-                slot, match = self._acquire_slot(self.queue[0])
-                if slot is not None:
-                    self._begin_prefill(self.queue.popleft(), slot, match)
-                    admitted = 1
+                # FIFO, except a request whose adapter bucket is pinned
+                # SOLID (every page held by live requests) must not
+                # head-of-line-block traffic that needs no page — scan past
+                # such heads to the first admissible request. KV-slot
+                # exhaustion still gates everyone equally: only the first
+                # non-skipped candidate is tried per iteration.
+                pick = None
+                for i, req in enumerate(self.queue):
+                    if req.cancelled:
+                        continue  # reaped when it reaches the head
+                    if (req.adapter_id is not None and self.adapters is not None
+                            and not self.adapters.acquirable(req.adapter_id)):
+                        continue  # its page pool is pinned solid: skip
+                    pick = i
+                    break
+                if pick is not None:
+                    req = self.queue[pick]
+                    slot, match = self._acquire_slot(req)
+                    if slot is not None:
+                        del self.queue[pick]
+                        self._begin_prefill(req, slot, match)
+                        admitted = 1
         else:
             while self.queue and self.cache.active_slots < self.cache.num_slots:
                 req = self.queue.popleft()
@@ -626,6 +709,31 @@ class DecodeScheduler:
         self._iter_links.append(fid)
         return fid
 
+    def _invalidate_adapter_uid(self, uid):
+        """Reclaim every KV/prefix registration of adapter ``uid`` — device
+        trie AND this fleet's host tier — fired via the store's listeners
+        when the uid's page leaves the device or its adapter re-registers
+        (the "reloaded adapter can never serve a stale page" contract)."""
+        dropped = self.radix.invalidate_adapter(uid) if self.radix is not None else 0
+        if self.kv_tier is not None and self.adapters is not None:
+            dropped += self.kv_tier.store.drop_prefix(self.adapters.namespace(uid))
+        tel = self.telemetry
+        if tel.enabled and dropped:
+            tel.counter("serving/adapter_kv_invalidated_tokens", dropped)
+
+    def _release_adapter(self, req):
+        """Unpin a finished/cancelled request's adapter page and account its
+        per-adapter token counter (the PR 4 cardinality cap applies via the
+        store's label table)."""
+        if req.adapter_ref is None:
+            return
+        self.adapters.release(req.adapter_ref)
+        req.adapter_ref = None
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter(f"serving/adapter/{self.adapters.label(req.adapter_id)}"
+                        f"/tokens", len(req.out))
+
     def _release_slot(self, slot):
         """Return a finished/cancelled request's slot: retained (state
         ``cached``) when the radix trie references its prefix, else freed.
@@ -650,6 +758,7 @@ class DecodeScheduler:
                 req.done = True
                 del self.active[slot]
                 self._release_slot(slot)
+                self._release_adapter(req)
                 if tel.enabled:
                     tel.counter("serving/cancelled")
                 if req.trace is not None:
@@ -660,6 +769,7 @@ class DecodeScheduler:
             req.done = True
             # mid-prefill slots are never trie-registered yet -> plain free
             self._release_slot(req.slot)
+            self._release_adapter(req)
             self._prefill = None
             if tel.enabled:
                 tel.counter("serving/cancelled")
@@ -674,9 +784,22 @@ class DecodeScheduler:
         only donor. When the free list is dry, reclaims the LRU cached
         prefix slot, preferring victims other than the matched donor.
         Returns ``(slot, (matched_len, donor))``; slot is None when every
-        slot serves a live request."""
-        match = (self.radix.match(req.prompt) if self.radix is not None
-                 else (0, None))
+        slot serves a live request.
+
+        Adapter requests first PIN their adapter's page resident
+        (hot-loading through the store on a miss); the match then walks
+        that adapter uid's own trie root. A store with every page pinned —
+        or a pool with every slot live — returns slot None and the
+        acquisition retries next iteration (nothing is held across the
+        retry)."""
+        aref = None
+        if req.adapter_id is not None:
+            aref = self.adapters.acquire(req.adapter_id)
+            if aref is None:
+                return None, (0, None)  # every page pinned: retry next iter
+        akey = aref.uid if aref is not None else None
+        match = (self.radix.match(req.prompt, adapter=akey)
+                 if self.radix is not None else (0, None))
         slot = self.cache.alloc(owner=req.rid)
         if slot is None and self.radix is not None:
             victim = self.radix.evict_lru(prefer_not=match[1])
@@ -685,6 +808,11 @@ class DecodeScheduler:
                 if self.telemetry.enabled:
                     self.telemetry.counter("serving/prefix_cache_evict")
                 slot = self.cache.alloc(owner=req.rid)
+        if slot is None:
+            if aref is not None:
+                self.adapters.release(aref)
+            return None, match
+        req.adapter_ref = aref
         return slot, match
 
     def _begin_prefill(self, req, slot, match=(0, None)):
@@ -721,10 +849,14 @@ class DecodeScheduler:
             # hierarchical KV: probe the host tier and restore when it
             # beats the device match (same rounding/cap as the device hit,
             # so restored == device-hit == cold run identical chunk
-            # boundaries and the decode is bit-identical across all three)
+            # boundaries and the decode is bit-identical across all three).
+            # Adapter requests probe under their uid namespace — a base (or
+            # other-adapter) host entry can never restore for them
             hm, entry = 0, None
             if self.kv_tier is not None:
-                hm, entry = self.kv_tier.probe(req.prompt)
+                ns = (self.adapters.namespace(req.adapter_ref.uid)
+                      if req.adapter_ref is not None else ())
+                hm, entry = self.kv_tier.probe(req.prompt, namespace=ns)
                 hm = min(hm, req.prompt.size - 1)
                 hm = (hm // self.prefill_chunk) * self.prefill_chunk
                 if hm < max(self.prefill_chunk, self.kv_tier.min_restore_tokens):
@@ -765,6 +897,9 @@ class DecodeScheduler:
                          cached_tokens=pos, prompt=int(req.prompt.size),
                          **({"restored": True} if restored else {}))
         self.cache.lengths[slot] = pos
+        if req.adapter_id is not None and tel.enabled:
+            tel.counter(f"serving/adapter/{self.adapters.label(req.adapter_id)}"
+                        f"/requests")
         self._prefill = _PrefillState(req, pos)
 
     def _finish_prefill(self, req, tok, last_logits):
@@ -775,6 +910,7 @@ class DecodeScheduler:
         self._prefill = None
         self.active[req.slot] = req
         if self.radix is not None:
+            akey = req.adapter_ref.uid if req.adapter_ref is not None else None
             if self.kv_tier is not None:
                 # a cold/device-hit prefill supersedes this scheduler's own
                 # host copy of the EXACT same prompt (restore normally
@@ -782,8 +918,9 @@ class DecodeScheduler:
                 # chunk, device donor at least as long — leave it behind,
                 # and registering the key on device too would break the
                 # one-tier-per-key invariant)
-                self.kv_tier.discard_exact(req.prompt)
-            self.radix.insert(req.slot, req.prompt)
+                ns = self.adapters.namespace(akey) if akey is not None else ()
+                self.kv_tier.discard_exact(req.prompt, namespace=ns)
+            self.radix.insert(req.slot, req.prompt, adapter=akey)
         req.first_token_ts = tel.now()
         if tel.enabled:
             tel.histogram("serving/ttft_ms", (req.first_token_ts - req.submit_ts) * 1e3)
@@ -860,6 +997,7 @@ class DecodeScheduler:
             if req.slot in self.active:
                 del self.active[req.slot]
             self._release_slot(req.slot)
+            self._release_adapter(req)
             if self.telemetry.enabled:
                 self.telemetry.counter("serving/evicted")
             tr = req.trace
@@ -887,6 +1025,28 @@ class DecodeScheduler:
                 logger.warning("scheduler on_token hook raised", exc_info=True)
 
     # ------------------------------------------------------------------ decode
+    def _adapter_arg(self, rows):
+        """The fused program's ``lora`` argument for this dispatch: a tuple
+        over rank buckets of ``(per-row pool-slot indices (num_slots,),
+        {site: (A_pool, B_pool)})`` — or None when NO live row carries an
+        adapter, in which case the plain (byte-identical pre-adapter)
+        program variant runs and base-only traffic pays nothing. Rows
+        without an adapter index slot 0 (the reserved zero page) of every
+        bucket; which rows carry which adapter is pure runtime data."""
+        if self.adapters is None:
+            return None
+        refs = [(slot, req.adapter_ref) for slot, req in rows
+                if req.adapter_ref is not None]
+        if not refs:
+            return None
+        buckets = self.adapters.bucket_keys()
+        N = self.cache.num_slots
+        idx = {b: np.zeros(N, np.int32) for b in buckets}
+        for slot, ref in refs:
+            idx[ref.bucket][slot] = ref.slot
+        pools = self.adapters.device_pools()
+        return tuple((jnp.asarray(idx[b]), pools[b]) for b in buckets)
+
     def _gather_sampling(self, live):
         """Per-slot sampling-parameter rows for a compiled step program
         (shared by the decode and fused-chunk paths — the bit-identity
@@ -964,12 +1124,14 @@ class DecodeScheduler:
         (seeds, steps, flags, temps, topks, topps, sampling,
          collect) = self._gather_sampling(live)
         K = self.steps_per_sync
-        fn = self._fused_fn(sampling, collect, K, 1)
+        lora = self._adapter_arg(live)
+        fn = self._fused_fn(sampling, collect, K, 1, lora=lora is not None)
+        args = (eng.params, self.cache.pool, jnp.asarray(ids),
+                jnp.asarray(lens), jnp.asarray(spans),
+                jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
         with eng.mesh:
-            out = fn(eng.params, self.cache.pool, jnp.asarray(ids),
-                     jnp.asarray(lens), jnp.asarray(spans),
-                     jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
-                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+            out = fn(*(args + ((lora, ) if lora is not None else ())))
         toks_k, logits_k = self._fetch_block(out, collect, K)
         return self._deliver_block(live, toks_k, logits_k, K), K
 
@@ -1018,12 +1180,14 @@ class DecodeScheduler:
             lens[slot] = self.cache.lengths[slot]
         (seeds, steps, flags, temps, topks, topps, sampling,
          collect) = self._gather_sampling(live)
-        fn = self._spec_fn(sampling, collect, W)
+        lora = self._adapter_arg(live)
+        fn = self._spec_fn(sampling, collect, W, lora=lora is not None)
+        args = (eng.params, self.cache.pool, jnp.asarray(ids),
+                jnp.asarray(lens), jnp.asarray(spans),
+                jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
         with eng.mesh:
-            out = fn(eng.params, self.cache.pool, jnp.asarray(ids),
-                     jnp.asarray(lens), jnp.asarray(spans),
-                     jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
-                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+            out = fn(*(args + ((lora, ) if lora is not None else ())))
         if collect:
             self.cache.pool, toks_k, logits_k = out
             logits_k = np.asarray(jax.device_get(logits_k), np.float32)  # (W, N, V)
@@ -1123,15 +1287,17 @@ class DecodeScheduler:
         # rows, or the prefill row itself once its final chunk lands — a
         # non-final chunk on an otherwise idle pool runs the 1-step variant
         K = self.steps_per_sync if (live or final) else 1
-        fn = self._fused_fn(sampling, collect, K, C)
+        lora = self._adapter_arg(live + [(ps, preq)])
+        fn = self._fused_fn(sampling, collect, K, C, lora=lora is not None)
         tel = self.telemetry
         t0 = tel.now()
         lens[ps] = self.cache.lengths[ps]  # prefix copy and/or earlier chunks
+        args = (eng.params, self.cache.pool, jnp.asarray(ids),
+                jnp.asarray(lens), jnp.asarray(spans),
+                jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
         with eng.mesh:
-            out = fn(eng.params, self.cache.pool, jnp.asarray(ids),
-                     jnp.asarray(lens), jnp.asarray(spans),
-                     jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
-                     jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+            out = fn(*(args + ((lora, ) if lora is not None else ())))
         toks_k, logits_k = self._fetch_block(out, collect, K)
         if tel.enabled:
             # the stall co-resident decode rows eat while a prefill chunk
@@ -1201,7 +1367,7 @@ class DecodeScheduler:
                 else (self._pool_sharding, ) + (self._host_sharding, ) * aux_outs)
         return jax.jit(fn, donate_argnums=donate, out_shardings=outs)
 
-    def _fused_fn(self, sampling, collect, ksteps, chunk):
+    def _fused_fn(self, sampling, collect, ksteps, chunk, lora=False):
         """THE step program: per-row query spans over a fixed ``(num_slots,
         chunk)`` ids block, then the sync's remaining ``ksteps - 1`` decode
         steps in the same on-device loop — one dispatch per scheduler
@@ -1225,8 +1391,19 @@ class DecodeScheduler:
 
         NOTE: the fused per-layer decode kernel (decode_block.py) needs a
         shared position scalar, so the slot-pool step always uses the
-        per-projection path (paged Pallas kernels or XLA)."""
-        key = ("fused", sampling, collect, chunk, ksteps)
+        per-projection path (paged Pallas kernels or XLA).
+
+        ``lora=True`` builds the multi-adapter variant: the program takes a
+        trailing ``lora`` argument (per-bucket pool tensors + per-row slot
+        indices), gathers each row's (A, B) pages ONCE, and threads them
+        through every forward of the sync — first span write and all K-1
+        substeps alike. The plain variant keeps its pre-adapter key and
+        trace, so base-only dispatches run the byte-identical old program;
+        both variants together stay O(1) in adapter count/mix/churn (which
+        rows carry which adapter is runtime data, pool shapes are fixed by
+        the bucket config)."""
+        key = ("fused", sampling, collect, chunk, ksteps) + (("lora", ) if lora
+                                                             else ())
 
         def build():
             model = self.engine.module
@@ -1241,13 +1418,17 @@ class DecodeScheduler:
                 return jnp.argmax(l2, axis=-1).astype(jnp.int32)
 
             def fused(params, pool, ids, lengths, spans, seeds, steps, flags,
-                      temps, topks, topps):
+                      temps, topks, topps, *lora_arg):
+                lops = None
+                if lora_arg:
+                    from ..adapters.batched_lora import gather_rows
+                    lops = gather_rows(lora_arg[0])
                 C = ids.shape[1]
                 N = ids.shape[0]
                 pos = lengths[:, None] + jnp.arange(C)[None, :]
                 logits, pool = model.apply_with_cache(
                     params, ids, pool, 0, position_ids=pos, write_index=lengths,
-                    q_spans=spans)
+                    q_spans=spans, lora_ops=lops)
                 # each row's LAST live column: decode rows column 0, the
                 # prefill row its chunk fill - 1 (dead rows clamp to 0 —
                 # their token is garbage the host never reads)
@@ -1272,7 +1453,7 @@ class DecodeScheduler:
                     logits, pool = model.apply_with_cache(
                         params, tok[:, None], pool, 0,
                         position_ids=(base + k)[:, None], write_index=base + k,
-                        q_spans=live01)
+                        q_spans=live01, lora_ops=lops)
                     l2 = _replicate_logits(logits[:, 0].astype(jnp.float32), tp)
                     nxt = sample(l2, seeds, steps + k, flags, temps, topks, topps)
                     out_toks = jax.lax.dynamic_update_index_in_dim(out_toks, nxt, k, 0)
@@ -1291,7 +1472,7 @@ class DecodeScheduler:
 
         return self._program(key, build)
 
-    def _spec_fn(self, sampling, collect, width):
+    def _spec_fn(self, sampling, collect, width, lora=False):
         """The speculative VERIFY program: one forward over a fixed
         ``(num_slots, width)`` ids block through the span machinery (row
         ``i``'s live columns = its last token + its drafts, per-row
@@ -1304,8 +1485,12 @@ class DecodeScheduler:
         configured width, so the program count stays O(1) in k and in the
         acceptance mix. Column 0's math is the decode program's math (same
         span kernel, same sampling path, same key folding), which is what
-        makes accepted streams bit-identical to non-speculative decode."""
-        key = ("spec", sampling, collect, width)
+        makes accepted streams bit-identical to non-speculative decode.
+        ``lora=True`` is the multi-adapter variant (same contract as
+        :meth:`_fused_fn`): drafts verify through each row's gathered
+        adapter pages, so speculative acceptance stays bit-identical to
+        that adapter's non-speculative stream."""
+        key = ("spec", sampling, collect, width) + (("lora", ) if lora else ())
 
         def build():
             model = self.engine.module
@@ -1318,12 +1503,16 @@ class DecodeScheduler:
                 return jnp.argmax(l2, axis=-1).astype(jnp.int32)
 
             def spec(params, pool, ids, lengths, spans, seeds, steps, flags,
-                     temps, topks, topps):
+                     temps, topks, topps, *lora_arg):
+                lops = None
+                if lora_arg:
+                    from ..adapters.batched_lora import gather_rows
+                    lops = gather_rows(lora_arg[0])
                 C = ids.shape[1]
                 pos = lengths[:, None] + jnp.arange(C)[None, :]
                 logits, pool = model.apply_with_cache(
                     params, ids, pool, 0, position_ids=pos, write_index=lengths,
-                    q_spans=spans)
+                    q_spans=spans, lora_ops=lops)
                 l = _replicate_logits(logits.astype(jnp.float32), tp)  # (N, C, V)
                 toks = jnp.stack([sample(l[:, j], seeds, steps + j, flags,
                                          temps, topks, topps) for j in range(C)])

@@ -70,13 +70,19 @@ class KVTier:
         self.restored_tokens = 0
 
     # ------------------------------------------------------------------ demote
-    def demote(self, slot, tokens):
+    def demote(self, slot, tokens, namespace=()):
         """Copy ``slot``'s registered prefix KV out of the pool and register
         it in the store (called by ``RadixPrefixCache.evict_lru`` BEFORE the
         registration is removed). The slice program dispatches synchronously
         — its output owns fresh buffers, so later pool donations can't
         corrupt it — and the device→host fetch + store put ride the bounded
-        async fetch window."""
+        async fetch window.
+
+        ``namespace``: key prefix scoping the entry (multi-LoRA serving
+        passes the adapter uid's negative-sentinel namespace from
+        ``PagedAdapterStore.namespace``) — sentinels can never equal a real
+        token, so adapter-scoped and base entries share one store but can
+        never cross-match; the entry's host ROWS cover ``tokens`` only."""
         m = len(tokens)
         if m < max(self.sched.prefill_chunk, self.min_restore_tokens, 1):
             # below the restore threshold it could never be restored (the
@@ -87,7 +93,7 @@ class KVTier:
         with self.sched.engine.mesh:
             dev = self._slice_fn()(self.kv.pool, np.int32(slot))
         flat = jax.tree_util.tree_leaves(dev)
-        key = tuple(int(t) for t in tokens)
+        key = tuple(int(t) for t in namespace) + tuple(int(t) for t in tokens)
         ex = self.executor
 
         def fetch():
@@ -103,25 +109,32 @@ class KVTier:
         ex.submit_fetch(fetch)
 
     # ------------------------------------------------------------------ probe
-    def probe(self, tokens, drain=True):
+    def probe(self, tokens, drain=True, namespace=()):
         """Longest host-tier prefix of ``tokens`` under the scheduler's
-        weights version: ``(matched_len, entry)`` or ``(0, None)``.
-        With ``drain``, a MISS joins in-flight demotes and re-probes — a
-        prefix demoted moments ago must be probe-visible — but a hit skips
-        the join, so admissions don't stall on unrelated copy-outs (the
-        bounded-async demote window's whole point). Submit-time look-ahead
-        passes drain=False — advisory only."""
-        m, entry = self.store.probe(tokens, self.kv.weights_version)
+        weights version (scoped to ``namespace`` — the adapter axis):
+        ``(matched_len, entry)`` or ``(0, None)``; ``matched_len`` counts
+        TOKENS (the namespace sentinels are excluded, and a match that dies
+        inside the namespace is a miss). With ``drain``, a MISS joins
+        in-flight demotes and re-probes — a prefix demoted moments ago must
+        be probe-visible — but a hit skips the join, so admissions don't
+        stall on unrelated copy-outs (the bounded-async demote window's
+        whole point). Submit-time look-ahead passes drain=False —
+        advisory only."""
+        ns = tuple(int(t) for t in namespace)
+        key = ns + tuple(int(t) for t in tokens)
+        m, entry = self.store.probe(key, self.kv.weights_version)
         if drain and entry is None and self.executor._fetches:
             self.executor.drain_fetches()
-            m, entry = self.store.probe(tokens, self.kv.weights_version)
-        return m, entry
+            m, entry = self.store.probe(key, self.kv.weights_version)
+        if entry is None or m <= len(ns):
+            return 0, None
+        return m - len(ns), entry
 
-    def prefetch(self, tokens):
+    def prefetch(self, tokens, namespace=()):
         """Submit-time look-ahead: when the prompt's best host match is
         NVMe-spilled, start its disk read now so it overlaps the request's
         queue wait (the restore joins it)."""
-        m, entry = self.probe(tokens, drain=False)
+        m, entry = self.probe(tokens, drain=False, namespace=namespace)
         if entry is not None and entry.spill_path is not None:
             self.store.prefetch(entry)
         return m, entry
@@ -137,7 +150,8 @@ class KVTier:
         collide with the prompt's own device re-registration. Returns
         False when a concurrent restore claimed the entry first (the caller
         falls back to cold prefill)."""
-        leaves = self.store.pop(entry, consume=entry.length <= int(prompt_len))
+        leaves = self.store.pop(
+            entry, consume=self._token_len(entry) <= int(prompt_len))
         if leaves is None:
             return False
         pool_leaves, treedef = jax.tree_util.tree_flatten(self.kv.pool)
@@ -158,6 +172,15 @@ class KVTier:
         self.restores += 1
         self.restored_tokens += int(matched)
         return True
+
+    @staticmethod
+    def _token_len(entry):
+        """Entry length in TOKENS: namespace sentinels (negative ints — the
+        adapter axis) never count against the restoring prompt."""
+        ns = 0
+        while ns < len(entry.key) and entry.key[ns] < 0:
+            ns += 1
+        return entry.length - ns
 
     def _dispatch_restore(self, name):
         leaves, treedef = self._pending
@@ -181,14 +204,15 @@ class KVTier:
             lambda: self.sched._jit_step(
                 lambda pool, tree, s: slot_update(pool, s, tree), 0, (0, )))
 
-    def discard_exact(self, tokens):
+    def discard_exact(self, tokens, namespace=()):
         """Drop this scheduler's own host entry for an exact key about to be
         device-registered (a cold or device-hit prefill superseded it) —
         restore normally consumes the entry, but a match that rounded below
         a chunk or a device donor at least as long leaves it behind, and
         holding both copies would break the one-tier-per-key invariant."""
         self.executor.drain_fetches()
-        self.store.discard(tokens, origin=id(self))
+        self.store.discard(tuple(int(t) for t in namespace)
+                           + tuple(int(t) for t in tokens), origin=id(self))
 
     # ------------------------------------------------------------------ invariants
     def invalidate(self):
@@ -208,7 +232,9 @@ class KVTier:
         self.executor.drain_fetches()
         for slot in radix.registered_slots():
             tokens = radix.registered_tokens(slot)
-            if self.store.contains_exact(tokens, origin=id(self)):
+            ns = radix.adapter_ns(radix.registered_adapter(slot))
+            key = tuple(int(t) for t in ns) + tuple(int(t) for t in tokens)
+            if self.store.contains_exact(key, origin=id(self)):
                 raise AssertionError(
                     f"prefix of slot {slot} is device-registered AND host-"
                     f"demoted by the same scheduler (key length {len(tokens)})")

@@ -426,6 +426,24 @@ class GlobalPrefixStore:
             self._gauge()
             return dropped
 
+    def drop_prefix(self, namespace):
+        """Drop every entry whose key starts with ``namespace`` (the
+        adapter-invalidation path: an adapter uid's negative-sentinel
+        namespace scopes all its demoted prefixes — when its page is
+        evicted/reloaded, its host-tier KV dies with the device
+        registrations). Returns the number of prefix tokens dropped."""
+        ns = tuple(int(t) for t in namespace)
+        if not ns:
+            return 0
+        with self._lock:
+            dropped = 0
+            for entry in [e for e in self._by_key.values()
+                          if e.key[:len(ns)] == ns]:
+                dropped += entry.length - len(ns)
+                self._drop_entry(entry)
+            self._gauge()
+            return dropped
+
     def clear(self):
         with self._lock:
             for entry in list(self._by_key.values()):

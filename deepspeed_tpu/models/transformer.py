@@ -425,6 +425,38 @@ def _embed_layout(x):
     return _constrain(x, P(dp or None, seq, None))
 
 
+def _lora_rank_delta(x2, A, Bm):
+    """One rank-bucket low-rank delta for a batch of per-row adapters
+    (batched mixed-adapter serving, ``deepspeed_tpu/adapters/``): ``x2`` is
+    the site input flattened to (B, T, K); ``A`` (B, K..., r) is the
+    scale-folded down-projection gathered per row from the paged adapter
+    pool (rows with no adapter carry the all-zero slot 0), ``Bm``
+    (B, r, out...) the up-projection. fp32 math end to end — the rounding
+    contract every reference path (solo scheduler run, ``runtime/lora.py``
+    decomposed ops) must share for bit-identity. Returns (B, T, O) fp32."""
+    Bsz = x2.shape[0]
+    A2 = A.reshape(Bsz, -1, A.shape[-1]).astype(jnp.float32)
+    B2 = Bm.reshape(Bsz, Bm.shape[1], -1).astype(jnp.float32)
+    t = jnp.einsum("btk,bkr->btr", x2.astype(jnp.float32), A2)
+    return jnp.einsum("btr,bro->bto", t, B2)
+
+
+def _lora_site_delta(x2, lora_ops, site):
+    """Summed per-row delta over every rank bucket adapting ``site``, or
+    None when no bucket does. ``lora_ops``: tuple of per-bucket dicts
+    ``site -> (A, B)`` (see :class:`Attention` docstring); buckets a row
+    doesn't belong to contribute its all-zero slot-0 pages, so the sum is
+    exactly that row's single adapter's delta."""
+    delta = None
+    for bucket in lora_ops:
+        ab = bucket.get(site)
+        if ab is None:
+            continue
+        d = _lora_rank_delta(x2, ab[0], ab[1])
+        delta = d if delta is None else delta + d
+    return delta
+
+
 def _sdpa_xla(q, k, v, mask_bias, dtype, interior_spec=None):
     """Pure-XLA attention in bhtd: softmax in fp32, big-negative causal bias.
 
@@ -600,10 +632,20 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, kv_cache=None, cache_index=None,
-                 position_ids=None, write_index=None, q_spans=None):
+                 position_ids=None, write_index=None, q_spans=None, lora_ops=None):
         """``attn_mask`` semantics: without a cache it is (B, T) over the
         current tokens; with a cache it is (B, S) over cache slots (True =
         attendable, used for left-pad masking during generation).
+
+        ``lora_ops``: optional per-row batched-LoRA operands (multi-tenant
+        adapter serving, ``deepspeed_tpu/adapters/``): a tuple of per-rank-
+        bucket dicts ``site -> (A, B)`` with A (B, in..., r) scale-folded
+        and B (B, r, out...), already GATHERED per batch row from the paged
+        adapter pools (this layer's slice of the (L, B, ...) stack). Each
+        adapted projection adds ``(x @ A_row) @ B_row`` in fp32 after its
+        base matmul; rows with no adapter carry the all-zero slot-0 pages,
+        so their delta is exactly zero. Sites: q/k/v/o here, gate/up/down
+        in :class:`MLP`.
 
         ``write_index``: optional (B,) int32 per-row cache write positions
         (continuous-batching slot pool — every sequence sits at its own
@@ -641,6 +683,23 @@ class Attention(nn.Module):
             q = HeadProjection(nh, hd, use_bias, cfg.dtype, i8, i8g, name="q_proj")(x)
             k = HeadProjection(nkv, hd, use_bias, cfg.dtype, i8, i8g, name="k_proj")(x)
             v = HeadProjection(nkv, hd, use_bias, cfg.dtype, i8, i8g, name="v_proj")(x)
+
+        if lora_ops:
+            # per-row adapter deltas land on the projection OUTPUTS (before
+            # rope/attention), head-major to match; fp32 math inside the
+            # helper, cast at the add
+            def head_delta(site, heads):
+                d = _lora_site_delta(x, lora_ops, site)
+                if d is None:
+                    return None
+                return d.reshape(B, T, heads, hd).transpose(0, 2, 1, 3)
+            dq, dk, dv = head_delta("q", nh), head_delta("k", nkv), head_delta("v", nkv)
+            if dq is not None:
+                q = q + dq.astype(q.dtype)
+            if dk is not None:
+                k = k + dk.astype(k.dtype)
+            if dv is not None:
+                v = v + dv.astype(v.dtype)
 
         if cfg.pos_embedding == "rope":
             if position_ids is not None:
@@ -858,8 +917,16 @@ class Attention(nn.Module):
             # (exact concat) so the replicated o_proj contracts its full
             # head*hd axis locally — no partial-sum reduction anywhere
             out = _tp_replicate(out)
+        d_o = None
+        if lora_ops:
+            # o_proj delta reads the same bhtd input o_proj consumes
+            o_in = out.transpose(0, 2, 1, 3).reshape(out.shape[0], out.shape[2],
+                                                     nh * hd)
+            d_o = _lora_site_delta(o_in, lora_ops, "o")
         out = OutProjection(H, use_bias, cfg.dtype, cfg.int8_weights,
                             cfg.int8_group_size, name="o_proj")(out)
+        if d_o is not None:
+            out = out + d_o.reshape(out.shape).astype(out.dtype)
         return out, new_cache
 
 
@@ -888,8 +955,15 @@ class MLP(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, lora_ops=None):
         cfg = self.cfg
+
+        def lora_add(y, site, x_in):
+            if not lora_ops:
+                return y
+            d = _lora_site_delta(x_in, lora_ops, site)
+            return y if d is None else y + d.reshape(y.shape).astype(y.dtype)
+
         if cfg.int8_weights:
             dense = partial(QuantDense, use_bias=cfg.norm == "layernorm", dtype=cfg.dtype,
                             groups=cfg.int8_group_size)
@@ -897,12 +971,12 @@ class MLP(nn.Module):
             dense = partial(nn.Dense, use_bias=cfg.norm == "layernorm", dtype=cfg.dtype,
                             param_dtype=jnp.float32, kernel_init=nn.initializers.normal(0.02))
         if cfg.activation in ("swiglu", "geglu"):
-            gate = dense(cfg.ffn_size, name="gate_proj")(x)
-            up = dense(cfg.ffn_size, name="up_proj")(x)
+            gate = lora_add(dense(cfg.ffn_size, name="gate_proj")(x), "gate", x)
+            up = lora_add(dense(cfg.ffn_size, name="up_proj")(x), "up", x)
             act = nn.silu(gate) if cfg.activation == "swiglu" else nn.gelu(gate)
             h = act * up
         else:
-            h = dense(cfg.ffn_size, name="up_proj")(x)
+            h = lora_add(dense(cfg.ffn_size, name="up_proj")(x), "up", x)
             if cfg.activation == "gelu":
                 h = nn.gelu(h)  # tanh approximation (HF "gelu_new")
             elif cfg.activation == "gelu_exact":
@@ -915,7 +989,7 @@ class MLP(nn.Module):
             # bitwise-TP layout: gather the ffn-sharded activation (exact
             # concat) so the replicated down_proj contracts fully locally
             h = _tp_replicate(h)
-        return dense(cfg.hidden_size, name="down_proj")(h)
+        return lora_add(dense(cfg.hidden_size, name="down_proj")(h), "down", h)
 
 
 class Block(nn.Module):
@@ -924,7 +998,8 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, deterministic=True, kv_cache=None,
-                 cache_index=None, position_ids=None, write_index=None, q_spans=None):
+                 cache_index=None, position_ids=None, write_index=None, q_spans=None,
+                 lora_ops=None):
         cfg = self.cfg
         drop = nn.Dropout(rate=cfg.dropout) if cfg.dropout > 0 else None
         if cfg.act_quant_bits:  # QAT activation fake-quant (compression)
@@ -934,7 +1009,7 @@ class Block(nn.Module):
         h = make_norm(cfg, name="attn_norm")(x)
         h, new_cache = Attention(cfg, layer_idx=self.layer_idx, name="attn")(
             h, sin, cos, attn_mask, kv_cache, cache_index, position_ids, write_index,
-            q_spans)
+            q_spans, lora_ops)
         if drop is not None:
             h = drop(h, deterministic=deterministic)
         if cfg.parallel_residual:
@@ -950,7 +1025,7 @@ class Block(nn.Module):
             ff, aux = MoE(cfg, name="moe")(ff_in)
             self.sow("intermediates", "moe_aux_loss", aux)
         else:
-            ff = MLP(cfg, name="mlp")(ff_in)
+            ff = MLP(cfg, name="mlp")(ff_in, lora_ops)
         if drop is not None:
             ff = drop(ff, deterministic=deterministic)
         if cfg.parallel_residual:
@@ -965,7 +1040,7 @@ class CausalLM(nn.Module):
     def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
                  cache_index=None, position_ids=None, return_hidden=False,
                  pld_theta=None, pld_rng=None, ltd_keep=None, ltd_layers=(), ltd_rng=None,
-                 write_index=None, q_spans=None):
+                 write_index=None, q_spans=None, lora_ops=None):
         """``kv_cache``: optional per-layer (k, v) with leading layer dim —
         shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
         stack. Returns logits, or (logits, new_kv_cache) when caching, or the
@@ -1027,7 +1102,7 @@ class CausalLM(nn.Module):
         new_cache = None
         if cfg.scan_layers:
             def scan_body(mdl, carry, xs):
-                layer_cache, layer_idx = xs
+                layer_cache, layer_idx, layer_lora = xs
                 if ltd_active:
                     # scan shares one program across layers, so LTD applies to
                     # every scanned layer (per-layer opt-out needs
@@ -1039,7 +1114,7 @@ class CausalLM(nn.Module):
                 else:
                     y, c = mdl(carry, sin, cos, attn_mask, deterministic,
                                layer_cache, cache_index, position_ids, write_index,
-                               q_spans)
+                               q_spans, layer_lora)
                 return apply_pld(y, carry, layer_idx), c
 
             x, new_cache = nn.scan(
@@ -1048,7 +1123,8 @@ class CausalLM(nn.Module):
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={"partition_name": "layers"},
-            )(block(cfg, name="layers"), x, (kv_cache, jnp.arange(cfg.num_layers)))
+            )(block(cfg, name="layers"), x,
+              (kv_cache, jnp.arange(cfg.num_layers), lora_ops))
         else:
             caches = []
             for i in range(cfg.num_layers):
@@ -1057,6 +1133,8 @@ class CausalLM(nn.Module):
                 # 2 components (k, v) or 3 (+ the int8 tier's scale leaf)
                 layer_cache = (None if kv_cache is None
                                else tuple(comp[i] for comp in kv_cache))
+                layer_lora = (None if lora_ops is None else
+                              jax.tree_util.tree_map(lambda leaf: leaf[i], lora_ops))
                 blk = block(cfg, layer_idx=i, name=f"layer_{i}")
                 if ltd_active and i in ltd_layers:
                     y, c = ltd_apply(
@@ -1066,7 +1144,7 @@ class CausalLM(nn.Module):
                 else:
                     y, c = blk(x, sin, cos, attn_mask, deterministic,
                                layer_cache, cache_index, position_ids, write_index,
-                               q_spans)
+                               q_spans, layer_lora)
                 x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
@@ -1287,17 +1365,23 @@ class CausalLMModel:
                 tuple(jnp.zeros(shape, dt) for _ in range(cfg.num_layers)))
 
     def apply_with_cache(self, params, input_ids, kv_cache, cache_index, cache_mask=None,
-                         position_ids=None, write_index=None, q_spans=None):
+                         position_ids=None, write_index=None, q_spans=None,
+                         lora_ops=None):
         """Forward writing into (and attending over) the KV cache. Returns
         (logits, new_cache). ``cache_mask``: (B, S) attendable cache slots.
         ``write_index``: optional (B,) per-row cache positions (slot-pool
         decode, T == 1 — unless ``q_spans`` widens it); pass ``position_ids``
         alongside it. ``q_spans``: optional (B,) live query counts per row
-        (fused chunked-prefill/decode step; see :class:`Attention`)."""
+        (fused chunked-prefill/decode step; see :class:`Attention`).
+        ``lora_ops``: optional per-row batched-LoRA operands with a LEADING
+        LAYER AXIS — tuple of per-rank-bucket dicts ``site -> (A (L, B,
+        in..., r), B (L, B, r, out...))`` (multi-tenant adapter serving;
+        see :class:`Attention`); scanned models scan the layer axis
+        alongside the cache, unrolled models index it per layer."""
         mutable = ["intermediates"] if self.cfg.num_experts > 0 else False
         out = self.module.apply({"params": params}, input_ids, cache_mask, True, kv_cache,
                                 cache_index, position_ids, write_index=write_index,
-                                q_spans=q_spans, mutable=mutable)
+                                q_spans=q_spans, lora_ops=lora_ops, mutable=mutable)
         if mutable:
             (logits, new_cache), _ = out
         else:

@@ -36,6 +36,67 @@ def _slash(path):
 
 DEFAULT_TARGETS = (r"attn/(q|k|v|o)_proj/kernel", r"mlp/(gate|up|down)_proj/kernel")
 
+# projection-site names of the batched multi-adapter serving path
+# (deepspeed_tpu/adapters/): the leaf names LoRAModel.init_lora mints map
+# onto them 1:1 ("lora_q_proj" -> "q", ...)
+SERVING_SITES = ("q", "k", "v", "o", "gate", "up", "down")
+
+
+def site_adapters(lora_tree):
+    """Flatten a ``LoRAModel`` adapter tree into the serving-site form the
+    paged adapter store registers: ``{site: (a, b)}`` host float32 arrays
+    with a LEADING LAYER AXIS — ``a`` (L, in..., r), ``b`` (L, r, out...).
+    Scanned trees (``layers/...``) already carry the layer dim; unrolled
+    trees (``layer_0/...``) are stacked in layer order. Non-layer adapters
+    (none under DEFAULT_TARGETS) are rejected — the batched serving path
+    gathers per-layer pages."""
+    per_layer = {}  # site -> {layer_idx or None: (a, b)}
+
+    def walk(node, path):
+        for k, v in node.items():
+            p = path + (k, )
+            if isinstance(v, dict) and "a" in v and "b" in v \
+                    and not isinstance(v["a"], dict):
+                # init_lora mints "lora_kernel" under the projection scope
+                # ("layers/attn/q_proj/lora_kernel"): the SITE is the scope
+                # name; a flat "lora_q_proj" spelling is accepted too
+                if k == "lora_kernel" and len(p) >= 2:
+                    scope = p[-2]
+                elif k.startswith("lora_"):
+                    scope = k[len("lora_"):]
+                else:
+                    raise ValueError(f"unrecognized adapter leaf {'/'.join(p)!r}")
+                site = scope[:-len("_proj")] if scope.endswith("_proj") else scope
+                if site not in SERVING_SITES:
+                    raise ValueError(f"adapter site {site!r} has no batched "
+                                     f"serving path (sites: {SERVING_SITES})")
+                root = p[0]
+                if root == "layers":
+                    idx = None  # stacked: layer dim already leading
+                elif root.startswith("layer_"):
+                    idx = int(root[len("layer_"):])
+                else:
+                    raise ValueError(
+                        f"adapter {'/'.join(p)!r} is not under a layer stack; "
+                        f"the batched serving path pages per-layer adapters only")
+                per_layer.setdefault(site, {})[idx] = (
+                    np.asarray(v["a"], np.float32), np.asarray(v["b"], np.float32))
+            elif isinstance(v, dict):
+                walk(v, p)
+
+    walk(lora_tree, ())
+    if not per_layer:
+        raise ValueError("adapter tree holds no lora_* leaves")
+    out = {}
+    for site, layers in per_layer.items():
+        if None in layers:  # scanned
+            out[site] = layers[None]
+        else:
+            order = sorted(layers)
+            out[site] = (np.stack([layers[i][0] for i in order]),
+                         np.stack([layers[i][1] for i in order]))
+    return out
+
 
 def _split_dims(path, ndim, scanned):
     """(n_lead, n_in) split of a kernel's dims under the zoo layouts:

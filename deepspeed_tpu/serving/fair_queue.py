@@ -32,10 +32,11 @@ class QueueFull(Exception):
 
 
 class _Flow:
-    __slots__ = ("key", "weight", "deficit", "queue")
+    __slots__ = ("key", "tp", "weight", "deficit", "queue")
 
-    def __init__(self, key, weight):
+    def __init__(self, key, tp, weight):
         self.key = key
+        self.tp = tp  # (tenant, priority) — the WEIGHT-bearing identity
         self.weight = weight
         self.deficit = 0.0
         self.queue = collections.deque()  # (cost, item, enq_monotonic_ts)
@@ -60,6 +61,7 @@ class FairQueue:
         self._floor = min(self.priority_weights.values())
         self._lock = threading.Lock()
         self._flows = {}                        # key -> _Flow
+        self._siblings = {}                     # (tenant, priority) -> live flow count
         self._rotation = collections.deque()    # _Flow service order
         self._fresh_turn = True                 # rotation head not yet credited
         self._depth = 0
@@ -68,16 +70,28 @@ class FairQueue:
         return (float(self.tenant_weights.get(tenant, 1.0))
                 * float(self.priority_weights.get(priority, self._floor)))
 
-    def push(self, item, tenant, priority, cost=1):
-        """Enqueue ``item``; raises :class:`QueueFull` at the depth bound."""
+    def push(self, item, tenant, priority, cost=1, adapter=None):
+        """Enqueue ``item``; raises :class:`QueueFull` at the depth bound.
+
+        ``adapter``: optional model-variant key (multi-LoRA serving) — it
+        extends the FLOW key, so a tenant's traffic against different
+        adapters forms separate DRR flows: one adapter's backlog cannot
+        starve the same tenant's other variants. The WEIGHT still belongs
+        to the ``(tenant, priority)`` pair: each turn's credit is divided
+        by that pair's live flow count, so spreading a backlog across N
+        adapters round-robins among them WITHOUT multiplying the tenant's
+        bandwidth (a tenant cannot mint share by spraying adapter ids)."""
         cost = max(1, int(cost))
         with self._lock:
             if self._depth >= self.max_depth:
                 raise QueueFull(f"fair queue at max_depth={self.max_depth}")
-            key = (str(tenant), str(priority))
+            tp = (str(tenant), str(priority))
+            key = tp + ((str(adapter), ) if adapter is not None else ())
             flow = self._flows.get(key)
             if flow is None:
-                flow = self._flows[key] = _Flow(key, self._weight(tenant, priority))
+                flow = self._flows[key] = _Flow(key, tp,
+                                                self._weight(tenant, priority))
+                self._siblings[tp] = self._siblings.get(tp, 0) + 1
                 self._rotation.append(flow)
             flow.queue.append((cost, item, time.monotonic()))
             self._depth += 1
@@ -103,11 +117,17 @@ class FairQueue:
                     # emptied flows leave the rotation and forfeit deficit
                     # (standard DRR: idle flows must not bank credit)
                     self._rotation.popleft()
-                    del self._flows[flow.key]
+                    self._drop_flow(flow)
                     self._fresh_turn = True
                     continue
                 if self._fresh_turn:
-                    flow.deficit += self.quantum * flow.weight
+                    # the WEIGHT is per (tenant, priority): with k sibling
+                    # flows (adapter variants) each turn earns 1/k of the
+                    # pair's quantum, so the pair's total service stays
+                    # weight-proportional no matter how many adapters its
+                    # backlog spans (still >0: the loop terminates)
+                    k = max(1, self._siblings.get(flow.tp, 1))
+                    flow.deficit += self.quantum * flow.weight / k
                     self._fresh_turn = False
                 cost = flow.queue[0][0]
                 if flow.deficit < cost:
@@ -120,9 +140,17 @@ class FairQueue:
                 self._depth -= 1
                 if not flow.queue:
                     self._rotation.popleft()
-                    del self._flows[flow.key]
+                    self._drop_flow(flow)
                     self._fresh_turn = True
                 return item
+
+    def _drop_flow(self, flow):
+        del self._flows[flow.key]
+        n = self._siblings.get(flow.tp, 1) - 1
+        if n <= 0:
+            self._siblings.pop(flow.tp, None)
+        else:
+            self._siblings[flow.tp] = n
 
     def __len__(self):
         return self._depth

@@ -112,11 +112,12 @@ class _GatewayRequest:
                  "temperature", "top_k", "top_p", "seed", "tenant", "priority",
                  "cost", "deadline", "stream", "loop", "events", "handle",
                  "cancel_requested", "cancel_reason", "finished", "enq_ts",
-                 "admit_ts", "n_tokens", "trace", "trace_id", "replica")
+                 "admit_ts", "n_tokens", "trace", "trace_id", "replica",
+                 "adapter_id")
 
     def __init__(self, rid, prompt, *, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, seed, tenant, priority, deadline,
-                 stream, loop, trace=None, trace_id=None):
+                 stream, loop, trace=None, trace_id=None, adapter_id=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -143,6 +144,7 @@ class _GatewayRequest:
         self.trace = trace          # RequestTrace (None when tracing is off)
         self.trace_id = trace_id    # request identity echoed as x-request-id
         self.replica = None         # serving replica this request landed on
+        self.adapter_id = adapter_id  # model variant (multi-LoRA serving)
 
 
 class Gateway:
@@ -464,7 +466,7 @@ class Gateway:
                     greq.trace.instant("expired", where="queue")
                 self._post(greq, ("failed", 504, "deadline expired in queue"))
                 continue
-            rep = self.replicas.route(greq.prompt)
+            rep = self.replicas.route(greq.prompt, adapter=greq.adapter_id)
             if rep is None:
                 # eligibility changed between the capacity check and the
                 # pop (drain/sick mutate under the ReplicaSet's own lock):
@@ -482,7 +484,8 @@ class Gateway:
                     eos_token_id=greq.eos_token_id, do_sample=greq.do_sample,
                     temperature=greq.temperature, top_k=greq.top_k,
                     top_p=greq.top_p, seed=greq.seed,
-                    on_token=self._make_on_token(greq), trace=greq.trace)
+                    on_token=self._make_on_token(greq), trace=greq.trace,
+                    adapter_id=greq.adapter_id)
             except ValueError as e:
                 self.stats["rejected"] += 1
                 if greq.trace is not None:
@@ -776,7 +779,7 @@ class Gateway:
         gauges on the Prometheus surface so a scraper sees one coherent
         endpoint."""
         sched = self.scheduler
-        return {
+        out = {
             "gateway/ready": 1.0 if (self.ready and not self.draining) else 0.0,
             "gateway/queue_depth": float(len(self._fair)),
             "gateway/active_requests": float(len(self._active)),
@@ -791,6 +794,15 @@ class Gateway:
                 sum(1 for r in self.replicas if r.available())),
             "serving/tp_size": float(sched.tp_size),
         }
+        if sched.adapters is not None:
+            out.update({
+                "serving/adapters_registered": float(
+                    len(sched.adapters.registered())),
+                "serving/adapters_resident": float(
+                    sched.adapters.stats()["resident"]),
+                "serving/adapter_hit_rate": sched.adapters.hit_rate(),
+            })
+        return out
 
     def _metrics(self):
         sched = self.scheduler
@@ -812,6 +824,8 @@ class Gateway:
                           "slot_occupancy": sched.cache.occupancy(),
                           "compiled_programs": sched.compiled_program_count(),
                           "tp_size": sched.tp_size},
+            "adapters": (sched.adapters.stats()
+                         if sched.adapters is not None else None),
             "replicas": self.replicas.states(),
             "telemetry": self.telemetry.snapshot(),
         }
@@ -858,9 +872,26 @@ class Gateway:
                   or req.get("user") or "anonymous")
         priority = (headers.get(cfg.priority_header.lower())
                     or req.get("priority") or cfg.default_priority)
+        sched = self.scheduler
+        # model variant (multi-LoRA serving): `adapter_id` selects a
+        # registered LoRA adapter; `model` doubles as the OpenAI-shaped
+        # spelling when it names one. Unknown/unavailable ids 400 here —
+        # never after queueing
+        adapter_id = req.get("adapter_id")
+        if adapter_id is None:
+            m = req.get("model")
+            if (isinstance(m, str) and sched.adapters is not None
+                    and m in sched.adapters.registered()):
+                adapter_id = m
+        if adapter_id is not None:
+            if not isinstance(adapter_id, str):
+                raise ValueError("'adapter_id' must be a string")
+            if sched.adapters is None:
+                raise ValueError("multi-LoRA serving is not enabled "
+                                 "(continuous_batching.multi_lora)")
+            sched.adapters.check_registered(adapter_id)
         # capacity pre-check mirrors DecodeScheduler.submit's validation so
         # impossible requests 400 immediately instead of queueing first
-        sched = self.scheduler
         budget = _round_up(max(1, max_tokens), sched.steps_per_sync)
         if len(prompt) >= sched.max_len or len(prompt) + budget > sched.max_len:
             raise ValueError(
@@ -879,6 +910,7 @@ class Gateway:
             priority=str(priority),
             deadline=(time.monotonic() + timeout_s) if timeout_s > 0 else None,
             stream=bool(req.get("stream", False)),
+            adapter_id=adapter_id,
         )
 
     async def _completions(self, headers, body, reader, writer):
@@ -925,7 +957,8 @@ class Gateway:
             # id is still what x-request-id echoes.
             trace.track = f"{trace_id}:{greq.rid}"
         try:
-            self._fair.push(greq, greq.tenant, greq.priority, cost=greq.cost)
+            self._fair.push(greq, greq.tenant, greq.priority, cost=greq.cost,
+                            adapter=greq.adapter_id)
         except QueueFull:
             self.stats["shed_429"] += 1
             if tel.enabled:

@@ -161,6 +161,10 @@ class Replica:
             # store's residency — any replica can restore a prefix any
             # other computed (memory/kv_tier.py)
             "kv_tier": s.kv_tier.stats() if s.kv_tier is not None else None,
+            # multi-LoRA: the fleet-shared paged adapter store (one object,
+            # same numbers from every replica — an adapter loaded through
+            # any replica is resident for all)
+            "adapters": s.adapters.stats() if s.adapters is not None else None,
         }
 
 
@@ -282,21 +286,26 @@ class ReplicaSet:
             del self._sticky[key]
 
     # ---------------------------------------------------------------- dispatch
-    def _sticky_key(self, prompt):
+    def _sticky_key(self, prompt, adapter=None):
+        # the adapter id is part of the prefix identity: a prefix cached
+        # under adapter A on replica 0 is COLD data for adapter B (the
+        # radix roots are per-adapter), so sticky routing must not send
+        # B's matching prompt there expecting a hit
         p = np.asarray(prompt, np.int32).reshape(-1)
-        return p[:self._sticky_chunk].tobytes()
+        return (adapter, p[:self._sticky_chunk].tobytes())
 
-    def route(self, prompt):
+    def route(self, prompt, adapter=None):
         """The replica to place ``prompt`` on, or None when no eligible
         replica has a free slot. Sticky first, least-loaded otherwise; the
         sticky index re-points to wherever placement actually lands, so the
-        NEXT matching prompt follows the freshest cached copy."""
+        NEXT matching prompt follows the freshest cached copy. ``adapter``
+        scopes stickiness per model variant (multi-LoRA serving)."""
         with self._lock:
             candidates = [r for r in self.replicas
                           if r.available() and r.has_capacity()]
             if not candidates:
                 return None
-            key = self._sticky_key(prompt)
+            key = self._sticky_key(prompt, adapter)
             hit = self._sticky.get(key)
             tel = self.telemetry
             if hit is not None:
@@ -332,7 +341,7 @@ class ReplicaSet:
         ``(None, None)`` when the fleet has no free slot. The direct-drive
         entry point for benches/tests; the gateway calls :meth:`route` and
         submits itself (it owns request bookkeeping)."""
-        rep = self.route(prompt)
+        rep = self.route(prompt, adapter=submit_kwargs.get("adapter_id"))
         if rep is None:
             return None, None
         handle = rep.scheduler.submit(prompt, **submit_kwargs)

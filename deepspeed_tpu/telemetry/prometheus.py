@@ -25,6 +25,8 @@ _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 _TENANT_RE = re.compile(r"^gateway/tenant/(?P<tenant>.+)/tokens$")
 _COMM_RE = re.compile(r"^comm/(?P<op>[^/]+)/(?P<group>[^/]+)/bytes$")
 _REPLICA_RE = re.compile(r"^serving/replica/(?P<replica>\d+)/(?P<metric>.+)$")
+_ADAPTER_RE = re.compile(r"^serving/adapter/(?P<adapter>.+)/"
+                         r"(?P<metric>loads|evicts|requests|tokens)$")
 
 _PREFIX = "dstpu_"
 
@@ -69,6 +71,14 @@ def _counter_series(raw_name):
     if m:
         return (_name("serving/replica/" + m.group("metric")) + "_total",
                 [("replica", m.group("replica"))])
+    m = _ADAPTER_RE.match(raw_name)
+    if m:  # per-adapter multi-LoRA counters: one labeled family per metric.
+        # "per_adapter" (not "adapter") keeps the labeled family's name
+        # disjoint from the fleet-total counters (serving/adapter_loads ->
+        # dstpu_serving_adapter_loads_total) — mixing an unlabeled
+        # aggregate into a labeled family would double-count sum() queries
+        return (_name("serving/per_adapter/" + m.group("metric")) + "_total",
+                [("adapter", m.group("adapter"))])
     return _name(raw_name) + "_total", []
 
 

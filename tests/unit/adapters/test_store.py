@@ -1,0 +1,158 @@
+"""PagedAdapterStore unit tests: registration/conversion, rank bucketing,
+pin/evict/zombie residency, version-tagged invalidation listeners, and the
+bitwise pool-page contract (the gathered page IS the registered host value
+scale-folded — the operand half of the mixed-batch bit-identity story)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.adapters.store import PagedAdapterStore, rank_bucket, site_shapes
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+
+def tiny_cfg():
+    return TransformerConfig(vocab_size=128, hidden_size=16, num_layers=2,
+                             num_heads=2, max_seq_len=64, dtype=jnp.float32)
+
+
+def make_sites(cfg, r=4, seed=0):
+    """{site: (a, b)} random host adapters at rank r for every model site."""
+    rng = np.random.default_rng(seed)
+    L, table = site_shapes(cfg)
+    out = {}
+    for site, (in_s, out_s) in table.items():
+        out[site] = (rng.standard_normal((L, ) + in_s + (r, )).astype(np.float32),
+                     rng.standard_normal((L, r) + out_s).astype(np.float32))
+    return out
+
+
+def test_site_shapes_and_bucketing():
+    cfg = tiny_cfg()
+    L, table = site_shapes(cfg)
+    assert L == 2
+    assert set(table) == {"q", "k", "v", "o", "gate", "up", "down"}
+    H, nh, hd, F = 16, 2, 8, cfg.ffn_size
+    assert table["q"] == ((H, ), (nh, hd))
+    assert table["o"] == ((nh, hd), (H, ))
+    assert table["down"] == ((F, ), (H, ))
+    assert rank_bucket(3, [4, 16]) == 4
+    assert rank_bucket(5, [4, 16]) == 16
+    with pytest.raises(ValueError, match="exceeds every configured"):
+        rank_bucket(32, [4, 16])
+
+
+def test_register_validates_and_scale_folds():
+    cfg = tiny_cfg()
+    store = PagedAdapterStore(cfg, pool_slots=2, rank_buckets=(8, ))
+    sites = make_sites(cfg, r=4)
+    v = store.register("t0", sites=sites, alpha=8.0)
+    assert v == 1
+    reg = store.check_registered("t0")
+    assert reg.rank == 4 and reg.bucket == 8
+    # scale alpha/r folded into `a`, rank padded with zeros to the bucket
+    a_host = reg.leaves["q"][0]
+    np.testing.assert_array_equal(a_host[..., :4], sites["q"][0] * (8.0 / 4))
+    assert not a_host[..., 4:].any()
+    # shape mismatch / unknown site rejected loudly
+    bad = dict(sites)
+    bad["q"] = (sites["q"][0][:, :8], sites["q"][1])
+    with pytest.raises(ValueError, match="don't match"):
+        store.register("t1", sites=bad)
+    with pytest.raises(ValueError, match="does not expose"):
+        store.register("t1", sites={"embed": sites["q"]})
+    with pytest.raises(ValueError, match="unknown adapter_id"):
+        store.check_registered("never")
+
+
+def test_acquire_pins_loads_and_pool_page_is_bitwise():
+    cfg = tiny_cfg()
+    store = PagedAdapterStore(cfg, pool_slots=2, rank_buckets=(4, ))
+    sites = make_sites(cfg, r=4, seed=1)
+    store.register("t0", sites=sites, alpha=4.0)
+    ref = store.acquire("t0")
+    assert ref.slot != 0 and ref.bucket == 4 and ref.version == 1
+    # the device pool page is EXACTLY the scale-folded host registration
+    pools = store.device_pools()[4]
+    a_dev = np.asarray(jax.device_get(pools["q"][0][ref.slot]))
+    np.testing.assert_array_equal(a_dev, sites["q"][0] * (4.0 / 4))
+    b_dev = np.asarray(jax.device_get(pools["down"][1][ref.slot]))
+    np.testing.assert_array_equal(b_dev, sites["down"][1])
+    # slot 0 stays the all-zero base page
+    assert not np.asarray(jax.device_get(pools["q"][0][0])).any()
+    # resident re-acquire: no second load
+    ref2 = store.acquire("t0")
+    assert ref2.slot == ref.slot and store.loads == 1 and store.resident_hits == 1
+    store.release(ref)
+    store.release(ref2)
+
+
+def test_lru_evict_fires_listener_and_pins_block_eviction():
+    cfg = tiny_cfg()
+    store = PagedAdapterStore(cfg, pool_slots=2, rank_buckets=(4, ))
+    fired = []
+    store.add_listener(fired.append)
+    for name in ("a", "b", "c"):
+        store.register(name, sites=make_sites(cfg, r=2, seed=ord(name)))
+    ra = store.acquire("a")
+    rb = store.acquire("b")
+    uid_a = ra.uid
+    store.release(rb)  # b unpinned, a still pinned
+    rc = store.acquire("c")  # pool full -> must evict b (LRU unpinned), not a
+    assert rc is not None and store.evicts == 1
+    assert fired == [rb.uid]
+    # a pinned + c pinned: acquiring b again finds NO evictable slot
+    assert store.acquire("b") is None
+    store.release(ra)
+    assert store.acquire("b") is not None  # a released -> evictable
+    assert uid_a in fired  # its eviction fired too
+
+
+def test_reregister_bumps_version_fires_listener_and_zombies():
+    cfg = tiny_cfg()
+    store = PagedAdapterStore(cfg, pool_slots=2, rank_buckets=(4, ))
+    fired = []
+    store.add_listener(fired.append)
+    store.register("t", sites=make_sites(cfg, r=2, seed=5))
+    ref = store.acquire("t")
+    old_uid = ref.uid
+    v2 = store.register("t", sites=make_sites(cfg, r=2, seed=6))
+    assert v2 == 2 and fired == [old_uid]
+    # the old uid's page survives while pinned (zombie), then frees
+    assert old_uid in store._resident
+    ref2 = store.acquire("t")
+    assert ref2.uid != old_uid and ref2.slot != ref.slot
+    store.release(ref)
+    assert old_uid not in store._resident  # last release freed the zombie
+    store.release(ref2)
+    # namespaces are distinct per (id, version) — stale entries unreachable
+    assert store.namespace(old_uid) != store.namespace(ref2.uid)
+    assert store.namespace(ref2.uid)[0] < 0
+    # unregister fires too
+    store.unregister("t")
+    assert fired[-1] == ref2.uid
+    with pytest.raises(ValueError, match="unknown adapter_id"):
+        store.acquire("t")
+
+
+def test_lora_tree_registration_matches_sites_form():
+    """A LoRAModel adapter tree registers identically to the flattened
+    sites form (the runtime/lora.site_adapters round trip)."""
+    from deepspeed_tpu.models.transformer import CausalLMModel
+    from deepspeed_tpu.runtime.lora import LoRAModel, site_adapters
+    cfg = tiny_cfg()
+    model = CausalLMModel(cfg)
+    params = model.init_params(jax.random.key(0))
+    lora = LoRAModel(model, r=2, alpha=4.0)
+    tree = lora.init_lora(params, jax.random.key(1))
+    sites = site_adapters(jax.device_get(tree))
+    assert set(sites) == {"q", "k", "v", "o", "gate", "up", "down"}
+    store = PagedAdapterStore(cfg, pool_slots=1, rank_buckets=(2, ))
+    store.register("via-tree", lora_tree=tree, alpha=4.0)
+    store.register("via-sites", sites=sites, alpha=4.0)
+    t = store.check_registered("via-tree").leaves
+    s = store.check_registered("via-sites").leaves
+    for site in t:
+        np.testing.assert_array_equal(t[site][0], s[site][0])
+        np.testing.assert_array_equal(t[site][1], s[site][1])

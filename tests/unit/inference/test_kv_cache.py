@@ -145,23 +145,27 @@ class _BookkeepingTier:
         self.chunk = chunk
         self.demotes = 0
 
-    def demote(self, slot, tokens):
+    def demote(self, slot, tokens, namespace=()):
         if len(tokens) < self.chunk:
             return  # would round to a zero-length restore
-        self.store.put(tokens, [np.zeros((1, len(tokens), 1), np.uint8)],
+        key = tuple(int(t) for t in namespace) + tuple(int(t) for t in tokens)
+        self.store.put(key, [np.zeros((1, len(tokens), 1), np.uint8)],
                        self.kv.weights_version, origin=id(self))
         self.demotes += 1
 
-    def discard_exact(self, tokens):
-        self.store.discard(tokens, origin=id(self))
+    def discard_exact(self, tokens, namespace=()):
+        self.store.discard(tuple(int(t) for t in namespace)
+                           + tuple(int(t) for t in tokens), origin=id(self))
 
     def invalidate(self):
         return self.store.drop_version(self.kv.weights_version)
 
     def check_invariants(self, radix):
         for slot in radix.registered_slots():
-            if self.store.contains_exact(radix.registered_tokens(slot),
-                                         origin=id(self)):
+            ns = radix.adapter_ns(radix.registered_adapter(slot))
+            key = (tuple(int(t) for t in ns)
+                   + tuple(int(t) for t in radix.registered_tokens(slot)))
+            if self.store.contains_exact(key, origin=id(self)):
                 raise AssertionError(
                     f"slot {slot} prefix device-registered AND host-demoted "
                     f"by the same scheduler")
@@ -433,3 +437,143 @@ def test_bytes_accounting_plain_vs_quantized_layout():
     s = kv_q.alloc()
     kv_q.lengths[s] = 7
     assert kv_q.live_bytes() == 7 * kv_q.bytes_per_token()
+
+
+# ------------------------------------------------------------- adapter axis
+def test_adapter_axis_match_is_structurally_scoped():
+    """A prefix registered under adapter A (or base) must be INVISIBLE to
+    any other adapter's match — the per-adapter trie roots make the wrong
+    hit impossible, not merely checked. Pre-adapter behavior (adapter=None)
+    is byte-for-byte the old single-root trie."""
+    kv = make_pool(num_slots=4)
+    radix = RadixPrefixCache(kv)
+    base, a1, a2 = kv.alloc(), kv.alloc(), kv.alloc()
+    prompt = [1, 2, 3, 4, 5]
+    radix.insert(base, prompt)                 # base root
+    radix.insert(a1, prompt, adapter=101)      # adapter uid 101
+    radix.insert(a2, prompt, adapter=202)
+    radix.check_invariants()
+    # every axis sees ONLY its own registration
+    assert radix.match(prompt) == (5, base)
+    assert radix.match(prompt, adapter=101) == (5, a1)
+    assert radix.match(prompt, adapter=202) == (5, a2)
+    assert radix.match(prompt, adapter=999) == (0, None)
+    assert radix.registered_adapter(a1) == 101
+    assert radix.registered_adapter(base) is None
+    # removal prunes within the right root and drops emptied adapter roots
+    radix.remove(a1)
+    assert radix.match(prompt, adapter=101) == (0, None)
+    assert radix.match(prompt) == (5, base)
+    assert 101 not in radix._roots and 202 in radix._roots
+    radix.check_invariants()
+
+
+def test_invalidate_adapter_reclaims_cached_and_strips_live():
+    """invalidate_adapter (adapter page evicted / reloaded) reclaims that
+    adapter's CACHED slots, strips LIVE slots' registrations (they free
+    instead of retaining when their request ends), and leaves every other
+    adapter untouched."""
+    kv = make_pool(num_slots=4)
+    radix = RadixPrefixCache(kv)
+    cached = kv.alloc()
+    kv.lengths[cached] = 4
+    radix.insert(cached, [1, 2, 3, 4], adapter=7)
+    kv.retain(cached)
+    live = kv.alloc()
+    kv.lengths[live] = 3
+    radix.insert(live, [5, 6, 7], adapter=7)
+    other = kv.alloc()
+    kv.lengths[other] = 2
+    radix.insert(other, [8, 9], adapter=8)
+    kv.retain(other)
+    dropped = radix.invalidate_adapter(7)
+    assert dropped == 4 + 3
+    assert kv.state[cached] == "free"          # cached slot reclaimed
+    assert kv.state[live] == "active"          # live keeps decoding...
+    assert radix.registered_adapter(live) is None  # ...but unregistered
+    assert kv.refs[live] == 0
+    assert radix.match([8, 9], adapter=8) == (2, other)  # untouched
+    radix.check_invariants()
+    assert radix.invalidate_adapter(7) == 0  # idempotent on a gone root
+
+
+def test_adapter_demote_carries_namespace():
+    """Adapter registrations demote under their uid namespace: a host-tier
+    restore can only ever serve the same (adapter, version) — base probes
+    and other-adapter probes miss the entry by key."""
+    kv, radix, store = _tiered(num_slots=2)
+    radix.adapter_ns = lambda a: () if a is None else (-(a) - 1, )
+    s = kv.alloc()
+    kv.lengths[s] = 5
+    radix.insert(s, [1, 2, 3, 4, 5], adapter=3)
+    kv.retain(s)
+    kv.reclaim(radix.evict_lru())
+    ns = (-3 - 1, )
+    assert store.contains_exact(ns + (1, 2, 3, 4, 5), origin=id(radix.tier))
+    assert not store.contains_exact([1, 2, 3, 4, 5])  # base key untouched
+    # probe under the namespace hits; bare (base) probe misses
+    m, entry = store.probe(ns + (1, 2, 3, 4, 5, 6), version=0)
+    assert m == 6 and entry is not None  # 1 sentinel + 5 tokens
+    assert store.probe([1, 2, 3, 4, 5, 6], version=0) == (0, None)
+    # drop_prefix (the invalidate path) clears exactly this namespace
+    assert store.drop_prefix(ns) == 5
+    assert len(store) == 0
+    radix.check_invariants()
+
+
+def test_eviction_storm_with_adapter_axis_never_drifts():
+    """The tiered eviction storm re-run across THREE adapter axes (base +
+    two uids): random admissions/retains/evictions/per-adapter
+    invalidations, extended check_invariants after every operation, and
+    cross-axis matches asserted empty throughout."""
+    rng = np.random.default_rng(23)
+    kv, radix, store = _tiered(num_slots=3, max_len=96, chunk=4)
+    radix.adapter_ns = lambda a: () if a is None else (-(a) - 1, )
+    axes = [None, 11, 22]
+    live = {}
+    for i in range(300):
+        op = rng.integers(0, 5)
+        if op <= 1:  # admit + register on a random axis
+            axis = axes[rng.integers(0, 3)]
+            slot = kv.alloc()
+            if slot is None:
+                victim = radix.evict_lru()
+                if victim is None:
+                    continue
+                kv.reclaim(victim)
+                slot = kv.alloc()
+            prompt = [int(t) for t in rng.integers(0, 9, rng.integers(4, 12))]
+            ns = radix.adapter_ns(axis)
+            m, donor = radix.match(prompt, adapter=axis)
+            # the scheduler's discard-before-insert protocol
+            store.discard(tuple(ns) + tuple(prompt), origin=id(radix.tier))
+            kv.lengths[slot] = len(prompt)
+            radix.insert(slot, prompt, adapter=axis)
+            live[slot] = (prompt, axis)
+        elif op == 2 and live:  # finish -> retain (or free when a
+            # per-adapter invalidation already stripped the registration —
+            # the scheduler's _release_slot refs>0 rule)
+            slot = list(live)[rng.integers(0, len(live))]
+            del live[slot]
+            if kv.refs[slot] > 0:
+                kv.retain(slot)
+            else:
+                kv.free(slot)
+        elif op == 3:  # eviction pressure
+            victim = radix.evict_lru()
+            if victim is not None:
+                kv.reclaim(victim)
+        else:  # per-adapter invalidation (page evict / reload)
+            axis = axes[rng.integers(1, 3)]
+            radix.invalidate_adapter(axis)
+            store.drop_prefix(radix.adapter_ns(axis))
+        radix.check_invariants()
+        # cross-axis isolation: every registration matches ONLY on its axis
+        for slot in radix.registered_slots():
+            tokens = radix.registered_tokens(slot)
+            owner = radix.registered_adapter(slot)
+            for axis in axes:
+                if axis == owner:
+                    continue
+                m, donor = radix.match(tokens, adapter=axis)
+                assert donor != slot, (slot, owner, axis)

@@ -461,3 +461,79 @@ def test_tenant_telemetry_and_queue_wait(tmp_path, baseline):
         assert served["counters"]["gateway/completed"]["total"] == 2
     finally:
         assert gw.close(timeout=60)
+
+
+# ----------------------------------------------------------- multi-LoRA e2e
+def test_adapter_id_threads_gateway_to_scheduler(baseline):
+    """`adapter_id` in the completion body routes through the fair queue's
+    adapter-scoped flow, the replica router, and DecodeScheduler.submit:
+    the adapter stream completes with DIFFERENT tokens than base on the
+    same prompt, base traffic is untouched, per-adapter counters reach
+    /v1/metrics, and an unknown adapter answers 400 before queueing."""
+    params, ref = baseline
+    eng = make_engine(params=params,
+                      continuous_batching={"enabled": True, "num_slots": 2,
+                                           "prefill_chunk": 8})
+    from deepspeed_tpu.runtime.lora import LoRAModel
+    lora = LoRAModel(eng.module, r=2, alpha=4.0)
+    tree = lora.init_lora(jax.device_get(eng.params), jax.random.key(3))
+
+    def bump(node, i=[0]):
+        if isinstance(node, dict) and "a" in node and "b" in node \
+                and not isinstance(node["a"], dict):
+            i[0] += 1
+            return {"a": node["a"],
+                    "b": jax.random.normal(jax.random.key(i[0]), node["b"].shape) * 0.1}
+        return {k: bump(v) for k, v in node.items()}
+    eng.register_adapter("acme", lora_tree=bump(tree), alpha=4.0)
+    gw = Gateway(eng, port=0)
+    gw.start_background()
+    try:
+        st, _, body = post(gw.port, {"prompt": PROMPT, "max_tokens": 8,
+                                     "adapter_id": "acme"})
+        assert st == 200
+        acme_toks = json.loads(body)["choices"][0]["token_ids"]
+        st, _, body = post(gw.port, {"prompt": PROMPT, "max_tokens": 8})
+        assert st == 200
+        base_toks = json.loads(body)["choices"][0]["token_ids"]
+        assert base_toks == list(ref)        # base path untouched
+        assert acme_toks != base_toks        # the adapter actually served
+        # "model" doubles as the OpenAI-shaped spelling when registered
+        st, _, body = post(gw.port, {"prompt": PROMPT, "max_tokens": 8,
+                                     "model": "acme"})
+        assert st == 200
+        assert json.loads(body)["choices"][0]["token_ids"] == acme_toks
+        # unknown adapter: 400 at the door, never queued
+        st, _, body = post(gw.port, {"prompt": PROMPT, "max_tokens": 4,
+                                     "adapter_id": "nope"})
+        assert st == 400
+        assert "unknown adapter" in json.loads(body)["error"]["message"]
+        st, _, body = get(gw.port, "/v1/metrics")
+        metrics = json.loads(body)
+        # the store's stats surface on /v1/metrics even with the sink off
+        # (the per-adapter counters ride the sink and are covered by
+        # tests/unit/adapters/test_batched_lora.py)
+        assert metrics["adapters"]["registered"] == 1
+        assert metrics["adapters"]["loads"] == 1
+        assert metrics["adapters"]["resident"] == 1
+    finally:
+        gw.close()
+
+
+def test_fair_queue_adapter_flows_share_tenant_weight():
+    """Review fix: a tenant spreading its backlog across N adapter flows
+    must NOT earn N quanta per rotation — the (tenant, priority) pair's
+    credit is split across its live flows, so an equal-weight base-only
+    tenant keeps ~half the bandwidth."""
+    q = FairQueue(max_depth=64, quantum=1)
+    for i in range(8):
+        q.push(("a", "x", i), "tenant-a", "standard", cost=1, adapter="v1")
+        q.push(("a", "y", i), "tenant-a", "standard", cost=1, adapter="v2")
+        q.push(("b", i), "tenant-b", "standard", cost=1)
+    first12 = [q.pop() for _ in range(12)]
+    b_share = sum(1 for it in first12 if it[0] == "b")
+    assert 4 <= b_share <= 8, f"tenant-b got {b_share}/12 despite equal weight"
+    # drain fully; sibling accounting must empty cleanly
+    while q.pop() is not None:
+        pass
+    assert len(q) == 0 and not q._siblings and not q._flows

@@ -34,9 +34,15 @@ import sys
 # token; "spills"/"dropped" mark host-tier pressure (a round that spills or
 # drops more at the same stream is a capacity regression); tier_hit_rate /
 # restores / tokens_per_sec keep the higher-is-better default.
+# multi_lora leg notes: adapter swap_ms rides "ms"; "swaps"/"evicts" mark
+# load/rotation churn (more swaps at the same round-robin stream = worse
+# amortization); speedup_vs_rotation / adapter_hit_rate / tokens_per_sec
+# keep the higher-is-better default, and crossover_k is higher-better too
+# (rotation needs LONGER per-tenant runs before it catches the paged path).
 _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
-                 "shed", "evict", "evictions", "miss", "misses", "s", "seconds",
-                 "loss", "ppl", "perplexity", "spill", "spills", "dropped"}
+                 "shed", "evict", "evictions", "evicts", "miss", "misses",
+                 "s", "seconds", "loss", "ppl", "perplexity", "spill",
+                 "spills", "dropped", "swaps"}
 
 
 def _lower_better(path):

@@ -2,7 +2,7 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
@@ -10,6 +10,7 @@
 #   tools/ci_check.sh --rlhf     # RLHF hybrid-engine lane only
 #   tools/ci_check.sh --sharded  # tensor-sharded decode + replica-set lane only
 #   tools/ci_check.sh --hierkv   # hierarchical-KV tier lane only
+#   tools/ci_check.sh --multilora # multi-LoRA adapter-serving lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -104,6 +105,24 @@ hierkv_lane() {
     tests/unit/inference/test_kv_cache.py -q -p no:cacheprovider
 }
 
+multilora_lane() {
+  echo "== multi-LoRA adapter-serving lane =="
+  # paged-adapter serving guards: every row of a heterogeneous-adapter batch
+  # BIT-identical to that adapter's solo run (greedy+sampled x bf16/int8 KV
+  # x tp1/tp2 x 1/2 replicas), base rows bit-identical to the pre-adapter
+  # programs, cross-adapter KV/prefix reuse structurally impossible (per-
+  # adapter trie roots + namespaced host-store keys, adapter-axis eviction
+  # storm in test_kv_cache.py), hot load/evict churn exact, and the
+  # jax.monitoring compile guard: a fresh adapter-count/mix/eviction stream
+  # adds ZERO XLA programs after the rank bucket warms. Runs UNFILTERED (the
+  # bit-identity matrix nodeids are in slow_tests.txt to keep tier-1 in
+  # budget). The matching perf leg is `python bench.py serving`
+  # ("multi_lora" entry: paged vs merged-weight swap rotation).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/adapters \
+    tests/unit/inference/test_kv_cache.py -q -p no:cacheprovider
+}
+
 bench_diff() {
   echo "== bench diff (advisory) =="
   # diff the given fresh bench JSON (or the latest committed round) against
@@ -159,6 +178,10 @@ if [ "${1:-}" = "--hierkv" ]; then
   hierkv_lane
   exit $?
 fi
+if [ "${1:-}" = "--multilora" ]; then
+  multilora_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -195,7 +218,10 @@ sh_rc=$?
 hierkv_lane
 hk_rc=$?
 
+multilora_lane
+ml_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ]
