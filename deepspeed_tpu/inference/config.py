@@ -69,6 +69,35 @@ class HierarchicalKVConfig(DeepSpeedConfigModel):
                                      "copy; 0 = one chunk (the structural floor)")
 
 
+class DisaggregationConfig(DeepSpeedConfigModel):
+    """Disaggregated prefill/decode serving (DistServe/Splitwise on the
+    replica fleet, ``serving/replica.py``): replicas carry a phase role —
+    ``prefill``, ``decode``, or ``mixed`` — the gateway places new prompts
+    only on prefill-capable replicas, and when a prompt's chunked prefill
+    completes on a ``prefill`` replica its KV migrates to a decode replica
+    through the hierarchical-KV host staging layer (``memory/``), where
+    decode resumes bit-identically to a single-replica run. TTFT (prefill
+    capacity) and ITL (decode capacity) become independently tunable; a
+    long prefill can no longer stall co-resident decodes. Requires the
+    chunked-prefill radix path; the prefix store is created automatically
+    when ``hierarchical_kv`` is off. See ``benchmarks/SERVING.md``
+    ("Disaggregated prefill/decode")."""
+
+    enabled = ConfigField(default=False)
+    roles = ConfigField(default=list, help="per-replica phase roles by index "
+                        "(e.g. ['prefill', 'decode']); replicas past the end "
+                        "of the list run 'mixed' (both phases, no migration). "
+                        "At least one prefill-capable AND one decode-capable "
+                        "replica are required when any role is non-mixed. "
+                        "Runtime override: POST /v1/replicas/<i>/role")
+    migrate_min_tokens = ConfigField(default=0, help="colocate threshold: a "
+                                     "prompt SHORTER than this decodes on the "
+                                     "prefill replica that computed it instead "
+                                     "of migrating (the device->host->device "
+                                     "round trip is not worth it for tiny "
+                                     "prompts); 0 migrates everything")
+
+
 class MultiLoRAConfig(DeepSpeedConfigModel):
     """Multi-tenant adapter serving (``deepspeed_tpu/adapters/``): paged
     LoRA store + batched mixed-adapter decode. Adapter (A, B) pages live in
@@ -154,6 +183,11 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
         help="multi-tenant adapter serving: paged LoRA store + batched "
         "mixed-adapter decode (deepspeed_tpu/adapters/; see "
         "benchmarks/SERVING.md)")
+    disaggregation = ConfigField(
+        default=DisaggregationConfig,
+        help="disaggregated prefill/decode: phase-specialized replicas with "
+        "KV migration over the hierarchical-KV transport "
+        "(serving/replica.py; see benchmarks/SERVING.md)")
     replicas = ConfigField(default=1, help="data-parallel scheduler replicas behind "
                            "the gateway (serving/replica.py): N independent slot "
                            "pools (each tp-sharded per the mesh) sharing ONE "

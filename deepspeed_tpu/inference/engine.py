@@ -579,10 +579,14 @@ class InferenceEngine:
                   "spec_ngram_min": cb.spec_ngram_min,
                   "kv_cache_dtype": cb.kv_cache_dtype}
             hk = cb.hierarchical_kv
-            if hk.enabled:
+            dg = cb.disaggregation
+            if hk.enabled or dg.enabled:
                 # ONE store per engine: the scheduler threads it through
                 # _init_kwargs, so every ReplicaSet sibling binds the same
-                # fleet-global host tier (the weight-tree sharing model)
+                # fleet-global host tier (the weight-tree sharing model).
+                # Disaggregated prefill/decode rides the SAME store as its
+                # migration transport, so enabling it without the
+                # hierarchical tier still builds one (the hk knobs apply)
                 from ..memory.prefix_store import GlobalPrefixStore
                 kw["prefix_store"] = GlobalPrefixStore(
                     capacity_bytes=int(hk.host_capacity_mb) << 20,

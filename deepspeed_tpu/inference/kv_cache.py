@@ -146,6 +146,27 @@ class SlotKVCache:
         """Would a request of this shape ever fit a slot?"""
         return prompt_len + max_new_tokens <= self.max_len
 
+    def adopt_rows(self, slot, length, version):
+        """Account ``length`` externally-computed KV rows landing on an
+        ACTIVE ``slot`` (the disaggregated prefill→decode handoff: a decode
+        replica installs rows another replica's prefill wrote). The rows'
+        ``version`` must match this pool's current weights version — the
+        same structural rule that makes cross-version reuse impossible on
+        the retain/insert paths applies to migration."""
+        if self.state[slot] != "active":
+            raise ValueError(f"adopt_rows on non-active slot {slot} "
+                             f"(state {self.state[slot]})")
+        if int(version) != self.weights_version:
+            raise ValueError(
+                f"adopt_rows of KV stamped weights_version {int(version)} onto "
+                f"a pool at version {self.weights_version}: a migrated request "
+                f"whose weights were swapped mid-handoff must fail, not decode "
+                f"on stale rows")
+        if not 0 <= int(length) <= self.max_len:
+            raise ValueError(f"adopt_rows length {length} outside [0, {self.max_len}]")
+        self.lengths[slot] = int(length)
+        self.slot_version[slot] = self.weights_version
+
     def bump_weights_version(self):
         """New weights published: every row computed so far is stale. The
         caller (``DecodeScheduler.swap_weights``) must have already emptied

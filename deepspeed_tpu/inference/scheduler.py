@@ -199,7 +199,8 @@ class _Request:
     __slots__ = ("rid", "prompt", "max_new_tokens", "eos_token_id", "do_sample",
                  "temperature", "top_k", "top_p", "seed", "slot", "out", "logits",
                  "done", "cancelled", "submit_ts", "first_token_ts", "collect_logits",
-                 "on_token", "trace", "adapter_id", "adapter_ref")
+                 "on_token", "trace", "adapter_id", "adapter_ref", "handle",
+                 "migrating", "error")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, seed, collect_logits, submit_ts,
@@ -229,6 +230,17 @@ class _Request:
         # admitted, the pinned AdapterRef its rows gather pages through
         self.adapter_id = adapter_id
         self.adapter_ref = None
+        # disaggregated serving: the handle issued at submit (re-pointed
+        # when the request migrates schedulers) and the in-handoff flag
+        # (True between migrate-out on the prefill replica and admission
+        # on a decode replica — the request is then owned by NO scheduler)
+        self.handle = None
+        self.migrating = False
+        # terminal error (migration failures): done=True with this set
+        # means the request FAILED, not completed — the gateway answers
+        # 500 and SchedulerHandle.result() raises instead of returning a
+        # silently truncated stream
+        self.error = None
 
 
 class SchedulerHandle:
@@ -256,6 +268,10 @@ class SchedulerHandle:
     def result(self):
         while not self._req.done:
             self._sched.step()
+        if self._req.error is not None:
+            # a silently truncated array would be indistinguishable from a
+            # normal EOS completion — fail loudly instead
+            raise RuntimeError(self._req.error)
         return np.asarray(self._req.out, np.int32)
 
     def result_logits(self):
@@ -414,6 +430,16 @@ class DecodeScheduler:
         self._prefill = None  # at most one in-flight _PrefillState
         self.queue = collections.deque()
         self.active = {}  # slot -> _Request
+        # disaggregated prefill/decode (serving/replica.py): when set by the
+        # ReplicaSet, called with (self, req) the moment a chunked prefill's
+        # final fused sync finishes with budget left — returning True means
+        # the fleet took the request for migration to a decode replica (the
+        # hook drove migrate_out; this scheduler is done with it). None (or
+        # a mixed-role fleet returning False) leaves the request decoding
+        # here, byte-identical to the pre-disaggregation path.
+        self.migrate_hook = None
+        self.migrations_out = 0
+        self.migrations_in = 0
         # ``compiled_cache``: an externally-shared program dict (the replica
         # set passes one dict to every replica's scheduler, so N replicas of
         # the same shape share ONE compiled program set — replica count adds
@@ -527,6 +553,8 @@ class DecodeScheduler:
                 f"request needs {req.prompt.size + budget} cache rows > "
                 f"slot capacity {self.max_len}; raise max_out_tokens/num_slots' max_len "
                 f"or shorten the request")
+        handle = SchedulerHandle(self, req)
+        req.handle = handle
         self.queue.append(req)
         if self.kv_tier is not None:
             # hierarchical KV look-ahead: if the prompt's best host-tier
@@ -537,7 +565,7 @@ class DecodeScheduler:
             self.kv_tier.prefetch(req.prompt, namespace=ns)
         if tel.enabled:
             tel.gauge("serving/queue_depth", len(self.queue))
-        return SchedulerHandle(self, req)
+        return handle
 
     def drain(self):
         """Run until every queued/active request finishes."""
@@ -605,6 +633,146 @@ class DecodeScheduler:
             tel.counter("rlhf/weight_swaps")
             tel.counter("rlhf/kv_invalidated_tokens", invalidated)
         return invalidated
+
+    # ------------------------------------------------------------------ migration
+    # Disaggregated prefill/decode (serving/replica.py drives both halves):
+    # a prefill-role replica's scheduler hands a freshly-prefilled request
+    # off through the fleet-shared GlobalPrefixStore — migrate_out demotes
+    # the request's WHOLE KV (prompt rows + the rows its final fused sync
+    # decoded) through the hierarchical tier's compiled tier_slice program,
+    # and a decode replica's admit_migration restores it through
+    # tier_restore into a fresh slot, where decode resumes from the exact
+    # per-row state (write head, absolute step index, sampling seeds ride
+    # the _Request object) — bit-identical to never having moved.
+    def migrate_out(self, req, key, on_ready):
+        """Release ``req`` from this scheduler with its KV parked in the
+        store under ``key`` (called by the ReplicaSet's migrate hook, on
+        this scheduler's pump thread, right after the final prefill sync
+        delivered its tokens). The adapter page pin travels WITH the
+        request — the store is fleet-shared, so the decode replica's rows
+        gather the same resident pages. ``on_ready(entry_or_None)`` fires
+        once the handoff entry is claimable."""
+        slot = req.slot
+        kv_len = int(self.cache.lengths[slot])
+        # demote FIRST, release AFTER: the compiled slice's output owns
+        # fresh buffers (so the slot is reusable the moment this returns),
+        # and a synchronous dispatch failure here propagates while the
+        # request is STILL fully owned by this scheduler (active slot
+        # intact) — the normal sick-replica shedding can fail it, instead
+        # of stranding a request that is owned by nobody and parked nowhere
+        self.kv_tier.demote_request(slot, kv_len, key, on_ready)
+        req.migrating = True
+        del self.active[slot]
+        self._release_slot(slot)  # retained cached: the prompt prefix the
+        # _finish_prefill registration holds stays a donor for siblings
+        self.migrations_out += 1
+        req.slot = None
+        return kv_len
+
+    def _settle_migration(self, record, error=None, discard=True):
+        """Terminal bookkeeping shared by every failed/cancelled handoff
+        path: mark the request done (with ``error`` unless it was a client
+        cancel), drop the parked store entry, release the adapter pin, and
+        account it. One helper so the four settle sites can never drift."""
+        req = record.req
+        if error is not None and not req.cancelled:
+            req.error = error
+        req.done = True
+        req.migrating = False
+        if discard and record.entry is not None:
+            self.kv_tier.store.discard(record.key)
+        self._release_adapter(req)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/cancelled" if req.cancelled
+                        else "serving/migrations_failed")
+        if req.trace is not None:
+            req.trace.instant("cancelled" if req.cancelled else "failed",
+                              where="migration")
+        return "settled"
+
+    def admit_migration(self, record):
+        """Admit a migrated request (runs on THIS scheduler's pump thread —
+        the decode half of the handoff). Returns ``"resumed"`` when the
+        request is decoding here, ``"settled"`` when it ended without a
+        slot (mid-migration cancel, failed demote, stale weights version),
+        or None when no slot could be acquired and it should stay
+        parked. A restore raising on device settles the request as failed
+        FIRST and then re-raises, so the pump's sick-replica handling
+        runs without stranding a request that no scheduler owns."""
+        req = record.req
+        tel = self.telemetry
+        if req.cancelled or record.entry is None:
+            # mid-migration cancel (or a failed demote fetch): both ends'
+            # slots are already free (prefill released at migrate_out;
+            # decode never allocated) — just settle
+            return self._settle_migration(
+                record, error="migration failed: KV handoff device->host "
+                              "fetch failed")
+        if record.version != int(self.cache.weights_version):
+            # weights swapped while the handoff was parked: the KV is stale
+            # by the same structural rule that drops the prefix tier on a
+            # swap — fail the request rather than decode on old-weights KV
+            return self._settle_migration(
+                record, error="migration failed: weights version changed "
+                              "while the handoff was parked (stale KV must "
+                              "not decode)")
+        slot = self.cache.alloc(owner=req.rid)
+        if slot is None and self.radix is not None:
+            victim = self.radix.evict_lru()
+            if victim is not None:
+                self.cache.reclaim(victim)
+                if tel.enabled:
+                    tel.counter("serving/prefix_cache_evict")
+                slot = self.cache.alloc(owner=req.rid)
+        if slot is None:
+            return None  # every slot live: stays parked, retried next pull
+        try:
+            with self.engine.mesh:
+                ok = self.kv_tier.restore_request(record.entry, slot,
+                                                  record.kv_len)
+            if ok:
+                # structural version gate lives in the pool, like
+                # retain/insert
+                self.cache.adopt_rows(slot, record.kv_len, record.version)
+        except Exception:
+            # the record is already consumed: settle the request as failed
+            # and free the slot BEFORE propagating, so the pump's
+            # sick-replica handling runs without leaking the slot or
+            # stranding a request that no scheduler owns
+            self.cache.free(slot)
+            self._settle_migration(
+                record, error="migration failed: KV restore raised on the "
+                              "decode replica")
+            raise
+        if not ok:
+            # claimed/dropped under us (adapter invalidation beat the pull)
+            self.cache.free(slot)
+            return self._settle_migration(
+                record, discard=False,  # pop already consumed/killed it
+                error="migration failed: handoff entry invalidated before "
+                      "the decode replica could claim it")
+        req.slot = slot
+        req.migrating = False
+        self.active[slot] = req
+        self.migrations_in += 1
+        if req.handle is not None:
+            # result() keeps working for direct-drive callers: the handle
+            # now pumps the scheduler that actually owns the request
+            req.handle._sched = self
+        if req.trace is not None and req.trace.enabled:
+            req.trace.instant("migrated", replica_kv_len=record.kv_len)
+        return "resumed"
+
+    def owns(self, req):
+        """Does this scheduler currently hold ``req`` (queued, prefilling,
+        or decoding)? A migrated-out request is owned by NO scheduler while
+        its handoff is parked — the gateway's sick-replica shedding uses
+        this instead of remembering placement, so a replica failing after
+        it handed a request off can no longer kill that request."""
+        return ((self._prefill is not None and self._prefill.req is req)
+                or (req.slot is not None and self.active.get(req.slot) is req)
+                or any(q is req for q in self.queue))
 
     # ------------------------------------------------------------------ loop
     def step(self):
@@ -1334,6 +1502,16 @@ class DecodeScheduler:
                     preq.logits.append(logits_k[k, ps])
                 self._deliver(preq, int(toks_k[k, ps]))
                 delivered += 1
+            # disaggregated serving: a prefill-role replica hands the
+            # freshly-prefilled request to a decode replica here — after
+            # this sync's tokens streamed (they were computed anyway), with
+            # budget left, via the hook the ReplicaSet installed. The hook
+            # runs migrate_out; decode then resumes elsewhere from the
+            # exact per-row state this sync left behind, so the stream is
+            # bit-identical to staying put.
+            if (not preq.done and self.migrate_hook is not None
+                    and self.migrate_hook(self, preq)):
+                pass  # migrated out: slot released, request owned elsewhere
         else:
             self.cache.lengths[ps] = pf.pos
         return delivered, K

@@ -154,6 +154,16 @@ class KVTier:
             entry, consume=self._token_len(entry) <= int(prompt_len))
         if leaves is None:
             return False
+        self._install(leaves, slot, matched)
+        self.restores += 1
+        self.restored_tokens += int(matched)
+        return True
+
+    def _install(self, leaves, slot, rows):
+        """Stage ``leaves``' first ``rows`` rows and write them into
+        ``slot`` (ONE fenced put + the ONE compiled ``tier_restore``
+        program) — the mechanics shared by prefix restore and the
+        whole-request migration handoff. Pure transfer: no counters."""
         pool_leaves, treedef = jax.tree_util.tree_flatten(self.kv.pool)
         if self._stage is None:
             # zeros, not empty: rows past the restored prefix are masked on
@@ -161,17 +171,83 @@ class KVTier:
             # FINITE bit patterns (uninitialized bf16 bytes can be NaN)
             self._stage = [np.zeros(s.shape[:s.ndim - 4] + (1,) + s.shape[s.ndim - 3:],
                                     np.dtype(s.dtype)) for s in pool_leaves]
-        for buf, rows in zip(self._stage, leaves):
-            n = min(matched, rows.shape[rows.ndim - 2])
+        for buf, src in zip(self._stage, leaves):
+            n = min(rows, src.shape[src.ndim - 2])
             buf[(Ellipsis, slice(0, n), slice(None))] = \
-                rows[(Ellipsis, slice(0, n), slice(None))]
+                src[(Ellipsis, slice(0, n), slice(None))]
         self._pending = (self._stage, treedef)
         dev = self.executor.take("restore")  # depth 0: fenced point-of-use put
         self._pending = None
         self.kv.pool = self._restore_fn()(self.kv.pool, dev, np.int32(slot))
-        self.restores += 1
-        self.restored_tokens += int(matched)
+
+    # ------------------------------------------------------------------ migration
+    # Disaggregated prefill/decode (serving/replica.py): the prefill->decode
+    # handoff rides the SAME two compiled programs and the same store as the
+    # prefix tier, at whole-request granularity — the entry's rows cover the
+    # request's full KV (prompt + the tokens its final fused sync decoded),
+    # its key is a synthetic negative-sentinel tuple (adapter namespace
+    # first, so adapter invalidation reclaims parked handoffs too), and it
+    # is pinned host-resident until the decode side claims it.
+    def demote_request(self, slot, rows, key, on_ready):
+        """Copy ``slot``'s first ``rows`` KV rows out of the pool and park
+        them in the store under ``key`` for a decode replica to claim. The
+        slice program dispatches synchronously (its output owns fresh
+        buffers — the slot can be released/reused immediately); the
+        device->host fetch + store put ride the bounded async window, and
+        ``on_ready(entry_or_None)`` fires from the transfer thread once the
+        entry is probe-visible (None: the fetch failed — the caller fails
+        the request instead of parking it forever)."""
+        version = int(self.kv.weights_version)
+        with self.sched.engine.mesh:
+            dev = self._slice_fn()(self.kv.pool, np.int32(slot))
+        flat = jax.tree_util.tree_leaves(dev)
+        ex = self.executor
+
+        def fetch():
+            try:
+                with ex.timed_fetch():
+                    host = [np.asarray(jax.device_get(leaf)) for leaf in flat]
+                rows_h = [np.ascontiguousarray(
+                    x[(Ellipsis, slice(0, rows), slice(None))]) for x in host]
+                entry = self.store.put(key, rows_h, version, origin=id(self),
+                                       pinned=True, length=rows)
+            except Exception:  # noqa: BLE001 — surface as a failed handoff
+                # on_ready(None) already fails THIS request; re-raising
+                # would poison the shared fetch window and resurface at an
+                # unrelated drain point (sicking a healthy admission path
+                # for an error that was already handled)
+                from ..utils.logging import logger
+                logger.warning("KV handoff demote fetch failed", exc_info=True)
+                on_ready(None)
+                return
+            on_ready(entry)
+        ex.submit_fetch(fetch)
+
+    def restore_request(self, entry, slot, rows):
+        """Install a migrated request's ``entry`` at ``slot`` (rows
+        ``[0, rows)``) and consume it — the decode half of the handoff.
+        Returns False when the entry was already claimed/dropped (adapter
+        invalidation or a weight swap beat the restore; the caller fails
+        the request rather than decoding on vanished KV)."""
+        leaves = self.store.pop(entry, consume=True)
+        if leaves is None:
+            return False
+        self._install(leaves, slot, rows)
         return True
+
+    def warmup(self):
+        """Compile ``tier_slice``/``tier_restore`` ahead of the first real
+        demote/restore by round-tripping slot 0's rows onto themselves (a
+        byte-identical self-copy — safe even mid-decode). Disaggregated
+        fleets call this at build so the first migration adds ZERO XLA
+        programs and never trips the gateway's post-warmup recompile
+        watch."""
+        with self.sched.engine.mesh:
+            dev = self._slice_fn()(self.kv.pool, np.int32(0))
+        host = [np.asarray(jax.device_get(leaf))
+                for leaf in jax.tree_util.tree_leaves(dev)]
+        with self.sched.engine.mesh:
+            self._install(host, 0, self.kv.max_len)
 
     @staticmethod
     def _token_len(entry):

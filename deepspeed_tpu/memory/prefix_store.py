@@ -69,9 +69,9 @@ class PrefixEntry:
     replica B's demoted copy sits here)."""
 
     __slots__ = ("eid", "key", "length", "version", "origin", "leaves",
-                 "nbytes", "spill_path", "_meta", "node")
+                 "nbytes", "spill_path", "_meta", "node", "pinned")
 
-    def __init__(self, eid, key, length, version, origin, leaves):
+    def __init__(self, eid, key, length, version, origin, leaves, pinned=False):
         self.eid = eid
         self.key = key
         self.length = int(length)
@@ -82,6 +82,12 @@ class PrefixEntry:
         self.spill_path = None
         self._meta = None   # [(shape, dtype)] while spilled
         self.node = None
+        # pinned entries are exempt from LRU capacity enforcement: a
+        # disaggregated prefill->decode handoff parks a request's WHOLE KV
+        # here for the (short) window until a decode replica restores it —
+        # capacity pressure dropping it would fail the request, not just
+        # cool a cache. Pins die with the entry (pop/discard).
+        self.pinned = bool(pinned)
 
 
 class GlobalPrefixStore:
@@ -161,19 +167,27 @@ class GlobalPrefixStore:
             node = parent
 
     # ------------------------------------------------------------------ put
-    def put(self, tokens, leaves, version, origin=None):
+    def put(self, tokens, leaves, version, origin=None, pinned=False, length=None):
         """Register a demoted prefix (host copies of its KV rows, already
         sliced to the prefix length). An exact-key re-demote replaces the
         older entry (freshest rows win — same MRU bias as the device trie);
-        over-budget host bytes spill/drop LRU-first. Returns the entry."""
+        over-budget host bytes spill/drop LRU-first. Returns the entry.
+
+        ``pinned`` exempts the entry from LRU capacity enforcement (the
+        prefill->decode migration handoff — see :class:`PrefixEntry`);
+        ``length`` overrides the recorded token length when the key is NOT
+        the row-for-row token sequence (migration keys are synthetic
+        sentinels; the rows cover the request's real KV length)."""
         key = tuple(int(t) for t in tokens)
         with self._lock:
             old = self._by_key.get(key)
             if old is not None:
                 self._drop_entry(old)
             self._eid += 1
-            entry = PrefixEntry(f"pfx{self._eid}", key, len(key), version,
-                                origin, [np.ascontiguousarray(x) for x in leaves])
+            entry = PrefixEntry(f"pfx{self._eid}", key,
+                                len(key) if length is None else length, version,
+                                origin, [np.ascontiguousarray(x) for x in leaves],
+                                pinned=pinned)
             node = self._insert_node(key)
             node.entries.add(entry)
             entry.node = node
@@ -200,7 +214,8 @@ class GlobalPrefixStore:
         until a write lands, ``_pending_spill`` serves the bytes."""
         to_write = []
         while self.host_bytes > self.capacity_bytes:
-            resident = [e for e in self._by_key.values() if e.leaves is not None]
+            resident = [e for e in self._by_key.values()
+                        if e.leaves is not None and not e.pinned]
             if len(resident) <= 1:
                 break  # never evict the entry being demoted right now
             victim = min(resident, key=lambda e: self._lru.get(e.eid, 0))

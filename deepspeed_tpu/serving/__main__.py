@@ -32,6 +32,14 @@ def build_parser():
                    help="scheduler replicas behind the gateway "
                         "(continuous_batching.replicas): independent slot "
                         "pools, one weight tree, one compiled program set")
+    p.add_argument("--disagg-roles", default=None,
+                   help="comma-separated per-replica phase roles "
+                        "(prefill/decode/mixed), e.g. 'prefill,decode' — "
+                        "enables continuous_batching.disaggregation: new "
+                        "prompts place on prefill-capable replicas and "
+                        "finished prefills migrate their KV to decode "
+                        "replicas (runtime override: POST "
+                        "/v1/replicas/<i>/role)")
     p.add_argument("--max-queue-depth", type=int, default=None)
     p.add_argument("--default-max-tokens", type=int, default=None)
     p.add_argument("--request-timeout-s", type=float, default=None)
@@ -52,6 +60,12 @@ def main(argv=None):
         cfg["continuous_batching"]["num_slots"] = args.num_slots
     if args.replicas is not None:
         cfg["continuous_batching"]["replicas"] = args.replicas
+    if args.disagg_roles is not None:
+        # merge, don't replace: a config file's migrate_min_tokens (etc.)
+        # must survive the CLI setting the roles
+        dg = cfg["continuous_batching"].setdefault("disaggregation", {})
+        dg["enabled"] = True
+        dg["roles"] = [r.strip() for r in args.disagg_roles.split(",") if r.strip()]
     if args.dtype is not None:
         cfg["dtype"] = args.dtype
     if args.checkpoint is not None:

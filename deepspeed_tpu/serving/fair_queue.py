@@ -144,6 +144,31 @@ class FairQueue:
                     self._fresh_turn = True
                 return item
 
+    def requeue(self, item, tenant, priority, cost=1, adapter=None):
+        """Put a just-popped request BACK at the head of its flow, undoing
+        the pop's accounting (depth and deficit restored, no fresh
+        timestamp-based reordering: the tuple goes to the flow's FRONT).
+
+        The gateway uses this when placement transiently fails AFTER a pop
+        (a replica drained/sicked/changed phase role between the capacity
+        check and the route): shedding an already-accepted request with a
+        503 over a momentary eligibility blip would punish the client for
+        fleet-internal churn. Depth may transiently exceed ``max_depth`` by
+        the requeued item — it was already admitted once."""
+        cost = max(1, int(cost))
+        with self._lock:
+            tp = (str(tenant), str(priority))
+            key = tp + ((str(adapter), ) if adapter is not None else ())
+            flow = self._flows.get(key)
+            if flow is None:
+                flow = self._flows[key] = _Flow(key, tp,
+                                                self._weight(tenant, priority))
+                self._siblings[tp] = self._siblings.get(tp, 0) + 1
+                self._rotation.appendleft(flow)
+            flow.queue.appendleft((cost, item, time.monotonic()))
+            flow.deficit += cost
+            self._depth += 1
+
     def _drop_flow(self, flow):
         del self._flows[flow.key]
         n = self._siblings.get(flow.tp, 1) - 1

@@ -183,6 +183,9 @@ class Gateway:
         # what it always did for the single-replica gateway.
         self.replicas = ReplicaSet.build(engine)
         self.scheduler = self.replicas.primary
+        # disaggregated serving: a finished handoff wakes parked decode
+        # pumps immediately instead of waiting out the poll interval
+        self.replicas.on_migration_ready = self._wake_all
         self._fair = FairQueue(max_depth=config.max_queue_depth,
                                quantum=config.quantum_tokens,
                                tenant_weights=config.tenant_weights,
@@ -353,6 +356,10 @@ class Gateway:
         logger.info("gateway: drained and closed")
 
     # ------------------------------------------------------------------ pump threads
+    def _wake_all(self):
+        """Transfer-thread-safe pump wakeup (migration-ready callback)."""
+        self._wake.set()
+
     def _pump(self, rep):
         """One replica's pump: admit from the fair queue in DRR order
         (dispatch-locked — placement is a fleet-wide decision), step THIS
@@ -368,31 +375,38 @@ class Gateway:
             with self._dispatch_lock:
                 self._enforce_cancellations()
                 self._admit()
-            if not rep.idle() and not rep.sick:
-                try:
+            try:
+                # disaggregated serving: claim parked prefill->decode
+                # handoffs for THIS replica (cancelled ones settle on any
+                # pump; a decode pump that adopts one becomes non-idle and
+                # steps below). Inside the SAME guard as step(): a restore
+                # failing on device must degrade to sick-replica shedding,
+                # not kill this daemon thread and strand its requests
+                self.replicas.admit_migrations(rep)
+                if not rep.idle() and not rep.sick:
                     rep.step()
-                except Exception:  # noqa: BLE001 — fail requests, not the server
-                    logger.exception(f"gateway: replica {rep.idx} scheduler step failed")
-                    self.telemetry.dump_flight("backend_error")
-                    # "other healthy replicas remain BESIDES this one":
-                    # healthy() still counts this not-yet-marked replica, so
-                    # > 1 is the real fleet-keeps-serving test — the LAST
-                    # healthy replica failing must take the fail-and-retry
-                    # path below, not sick the whole fleet into a state only
-                    # a manual resume can leave
-                    if len(self.replicas.healthy()) > 1:
-                        # shed the sick replica, keep the fleet serving:
-                        # its in-flight requests fail, placement avoids it,
-                        # and its pump STOPS stepping it (a persistently-
-                        # raising backend must not spin traceback/flight-
-                        # dump loops or block drain) until resume()
-                        self.replicas.mark_sick(rep.idx, "scheduler step failed")
-                        self._fail_replica_in_flight(rep, "replica step failed")
-                    else:
-                        # single replica (or the last healthy one): today's
-                        # semantics — fail everything, stay up, retry on the
-                        # next admitted request
-                        self._fail_in_flight("scheduler step failed")
+            except Exception:  # noqa: BLE001 — fail requests, not the server
+                logger.exception(f"gateway: replica {rep.idx} scheduler step failed")
+                self.telemetry.dump_flight("backend_error")
+                # "other healthy replicas remain BESIDES this one":
+                # healthy() still counts this not-yet-marked replica, so
+                # > 1 is the real fleet-keeps-serving test — the LAST
+                # healthy replica failing must take the fail-and-retry
+                # path below, not sick the whole fleet into a state only
+                # a manual resume can leave
+                if len(self.replicas.healthy()) > 1:
+                    # shed the sick replica, keep the fleet serving:
+                    # its in-flight requests fail, placement avoids it,
+                    # and its pump STOPS stepping it (a persistently-
+                    # raising backend must not spin traceback/flight-
+                    # dump loops or block drain) until resume()
+                    self.replicas.mark_sick(rep.idx, "scheduler step failed")
+                    self._fail_replica_in_flight(rep, "replica step failed")
+                else:
+                    # single replica (or the last healthy one): today's
+                    # semantics — fail everything, stay up, retry on the
+                    # next admitted request
+                    self._fail_in_flight("scheduler step failed")
             self._settle_done()
             if primary:
                 # every primary iteration, stepped or not: the program set
@@ -443,8 +457,12 @@ class Gateway:
         tel = self.telemetry
         while True:
             if not self.replicas.any_capacity():
-                if self.replicas.all_sick() and len(self._fair):
-                    self._fail_queue("no healthy serving replica")
+                if self.replicas.all_sick():
+                    if len(self._fair):
+                        self._fail_queue("no healthy serving replica")
+                    if self.replicas.pending_migrations():
+                        # parked handoffs have no adopter left either
+                        self.replicas._fail_handoffs()
                 return
             greq = self._fair.pop()
             if greq is None:
@@ -469,14 +487,14 @@ class Gateway:
             rep = self.replicas.route(greq.prompt, adapter=greq.adapter_id)
             if rep is None:
                 # eligibility changed between the capacity check and the
-                # pop (drain/sick mutate under the ReplicaSet's own lock):
-                # shed the popped request — dropping it would strand the
-                # client with no terminal event until transport timeout
-                self.stats["shed_503"] += 1
-                if tel.enabled:
-                    tel.counter("gateway/shed_503")
-                self._post(greq, ("failed", 503,
-                                  "no serving replica available, retry later"))
+                # pop (drain/sick/phase-role mutate under the ReplicaSet's
+                # own lock): requeue at the flow head — the blip is fleet-
+                # internal churn, not client overload, so a 503 here would
+                # shed an already-accepted request for nothing. If the
+                # fleet stays unplaceable the queue bounds still shed new
+                # arrivals with honest Retry-After.
+                self._fair.requeue(greq, greq.tenant, greq.priority,
+                                   cost=greq.cost, adapter=greq.adapter_id)
                 return
             try:
                 handle = rep.scheduler.submit(
@@ -591,11 +609,17 @@ class Gateway:
                 greq.handle.cancel()
 
     def _settle_done(self):
-        """Cancelled requests finish via the scheduler's reap (done without
-        a final on_token): confirm the slot release to the HTTP side."""
+        """Cancelled/failed requests finish via the scheduler's reap (done
+        without a final on_token): confirm the terminal state to the HTTP
+        side — a migration failure answers 500 with its reason, not a
+        phantom "cancelled" the client never asked for."""
         for greq in list(self._active):
             if greq.handle is not None and greq.handle.done and not greq.finished:
-                self._finish(greq, ("cancelled", greq.cancel_reason or "cancelled"))
+                err = greq.handle._req.error
+                if err is not None:
+                    self._finish(greq, ("failed", 500, err))
+                else:
+                    self._finish(greq, ("cancelled", greq.cancel_reason or "cancelled"))
 
     def _fail_in_flight(self, msg):
         for greq in list(self._active):
@@ -605,12 +629,15 @@ class Gateway:
         self._fail_queue(msg)
 
     def _fail_replica_in_flight(self, rep, msg):
-        """Fail ONLY the requests placed on ``rep`` (a sick replica sheds
-        its own work; the rest of the fleet, and the queue, keep going)."""
+        """Fail ONLY the requests ``rep``'s scheduler currently OWNS (a sick
+        replica sheds its own work; the rest of the fleet, and the queue,
+        keep going). Ownership is asked of the scheduler rather than
+        remembered from placement: a request whose prefill ``rep`` ran but
+        whose KV already migrated out is owned by NO scheduler (or by its
+        decode replica), so the prefill replica failing cannot kill it."""
         for greq in list(self._active):
-            if greq.replica is rep:
-                if greq.handle is not None:
-                    greq.handle.cancel()
+            if greq.handle is not None and rep.scheduler.owns(greq.handle._req):
+                greq.handle.cancel()
                 self._finish(greq, ("failed", 500, msg))
 
     def _fail_queue(self, msg):
@@ -632,16 +659,38 @@ class Gateway:
     def _retry_after(self):
         """Advertised backoff, from live state: time for the current backlog
         to drain through the FLEET's slot pools at the measured per-request
-        service time (EMA). Floor 1s; capped; integer seconds per RFC 9110."""
-        depth = (len(self._fair) + len(self._active)
-                 + sum(len(r.scheduler.queue) for r in self.replicas))
-        slots = self.replicas.total_slots()
+        service time (EMA). Floor 1s; capped; integer seconds per RFC 9110.
+
+        Phase-aware under disaggregation: a new request needs a PREFILL
+        slot first and a DECODE slot after, and the two pools are disjoint
+        — so the estimate is the WORSE of (queued work / prefill capacity)
+        and (in-flight + parked-handoff work / decode capacity), not the
+        blended depth over the blended fleet (which under-advertises
+        exactly when one phase is the bottleneck)."""
         ema = self._ema_service_s
-        if ema is None:
-            est = 1 + depth // max(1, slots)
+        cap = int(self.config.retry_after_cap_s)
+
+        def est(depth, slots):
+            if ema is None:
+                return 1 + depth // max(1, slots)
+            return (depth + 1) * ema / max(1, slots)
+
+        if self.replicas.disaggregated():
+            pre_depth = (len(self._fair)
+                         + sum(len(r.scheduler.queue) for r in self.replicas
+                               if r.prefill_capable()))
+            # _active already covers parked handoffs (their handles are
+            # not done) and soon-to-decode prefills — adding
+            # pending_migrations() on top would double-count each parked
+            # request and over-advertise the backoff
+            dec_depth = len(self._active)
+            val = max(est(pre_depth, self.replicas.phase_slots("prefill")),
+                      est(dec_depth, self.replicas.phase_slots("decode")))
         else:
-            est = (depth + 1) * ema / max(1, slots)
-        return max(1, min(int(self.config.retry_after_cap_s), int(est + 0.999)))
+            depth = (len(self._fair) + len(self._active)
+                     + sum(len(r.scheduler.queue) for r in self.replicas))
+            val = est(depth, self.replicas.total_slots())
+        return max(1, min(cap, int(val + 0.999)))
 
     def _next_rid(self):
         with self._rid_lock:
@@ -744,21 +793,24 @@ class Gateway:
         elif method == "GET" and path == "/v1/replicas":
             await self._json(writer, 200, {"replicas": self.replicas.states()})
         elif method == "POST" and path.startswith("/v1/replicas/"):
-            await self._replica_admin(path, writer)
+            await self._replica_admin(path, body, writer)
         elif method == "POST" and path == "/v1/completions":
             await self._completions(headers, body, reader, writer)
         else:
             await self._json(writer, 404, {"error": {"message": f"no route {method} {path}"}})
 
-    async def _replica_admin(self, path, writer):
+    async def _replica_admin(self, path, body, writer):
         """``POST /v1/replicas/<idx>/drain`` stops placement onto a replica
         (in-flight work finishes; resumable); ``.../resume`` re-admits it
-        (clearing drain AND sick — the operator asserting recovery)."""
+        (clearing drain AND sick — the operator asserting recovery);
+        ``.../role`` (body ``{"role": "prefill"|"decode"|"mixed"}``) flips
+        its phase role at runtime — disaggregation's per-replica override
+        (the fleet must keep both phases coverable; violations 400)."""
         parts = path.strip("/").split("/")  # v1 replicas <idx> <action>
-        if len(parts) != 4 or parts[3] not in ("drain", "resume"):
+        if len(parts) != 4 or parts[3] not in ("drain", "resume", "role"):
             await self._json(writer, 404,
                              {"error": {"message": "POST /v1/replicas/<idx>/"
-                                        "{drain|resume}"}})
+                                        "{drain|resume|role}"}})
             return
         try:
             idx = int(parts[2])
@@ -769,8 +821,18 @@ class Gateway:
                              {"error": {"message": f"no replica {parts[2]!r} "
                                         f"(fleet size {len(self.replicas)})"}})
             return
-        state = (self.replicas.drain(idx) if parts[3] == "drain"
-                 else self.replicas.resume(idx))
+        if parts[3] == "role":
+            try:
+                req = json.loads(body.decode("utf-8") or "{}")
+                role = req.get("role") if isinstance(req, dict) else None
+                state = self.replicas.set_role(idx, role)
+            except (ValueError, UnicodeDecodeError,
+                    json.JSONDecodeError) as e:
+                await self._json(writer, 400, {"error": {"message": str(e)}})
+                return
+        else:
+            state = (self.replicas.drain(idx) if parts[3] == "drain"
+                     else self.replicas.resume(idx))
         self._wake.set()
         await self._json(writer, 200, {"replica": state})
 
@@ -794,6 +856,21 @@ class Gateway:
                 sum(1 for r in self.replicas if r.available())),
             "serving/tp_size": float(sched.tp_size),
         }
+        if self.replicas.disaggregated():
+            # phase split + handoff pressure (the decode-side half of the
+            # phase-aware Retry-After, scrapeable): per-replica roles are in
+            # /v1/replicas; migrations_{out,in} fold as {replica=...}
+            # counter series through the telemetry sink
+            out.update({
+                "serving/replicas_prefill_capable": float(
+                    sum(1 for r in self.replicas
+                        if r.available() and r.prefill_capable())),
+                "serving/replicas_decode_capable": float(
+                    sum(1 for r in self.replicas
+                        if r.available() and r.decode_capable())),
+                "serving/migrations_pending": float(
+                    self.replicas.pending_migrations()),
+            })
         if sched.adapters is not None:
             out.update({
                 "serving/adapters_registered": float(
@@ -827,6 +904,16 @@ class Gateway:
             "adapters": (sched.adapters.stats()
                          if sched.adapters is not None else None),
             "replicas": self.replicas.states(),
+            # disaggregated serving rollup (per-replica phase_role and
+            # migrations_{out,in} are in the replicas list above)
+            "disaggregation": ({
+                "roles": [r.phase_role for r in self.replicas],
+                "migrations": sum(r.scheduler.migrations_out
+                                  for r in self.replicas),
+                "pending": self.replicas.pending_migrations(),
+                "failed": self.replicas.migrations_failed,
+                "migrate_min_tokens": self.replicas.migrate_min_tokens,
+            } if self.replicas.disaggregated() else None),
             "telemetry": self.telemetry.snapshot(),
         }
 

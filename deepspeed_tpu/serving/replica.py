@@ -39,6 +39,24 @@ cache): a prefix radix-evicted on any replica demotes there, and ANY
 replica's admission can restore it, so sticky routing misses stop being
 cold prefills.
 
+**Disaggregated prefill/decode** (``continuous_batching.disaggregation``,
+DistServe/Splitwise): replicas carry a phase role — ``prefill``,
+``decode``, or ``mixed`` (the default; a zero-role fleet behaves exactly
+as before). Placement only considers prefill-CAPABLE replicas (``prefill``
+or ``mixed``); when a prompt's chunked prefill completes on a ``prefill``
+replica, the request's whole KV demotes through the shared prefix store
+(``memory/kv_tier.KVTier.demote_request`` — the same two compiled
+tier programs the hierarchical tier uses) and parks in the fleet's
+migration queue, from which decode-capable replicas PULL as their pumps
+find capacity (pull placement self-balances and makes sick-decode
+failover free: a parked handoff is bound to no replica, so any healthy
+decode replica re-places it). Decode resumes bit-identically — the
+sampling seeds fold absolute step indices, the KV rows move byte-exact,
+and the request object (tokens, logits, hooks, adapter pin) travels
+as-is. ``migrate_min_tokens`` colocates short prompts (the handoff round
+trip isn't worth it); a fleet whose decode side vanishes entirely falls
+back to colocating on whatever is left rather than stalling.
+
 Why replicas (vs one bigger pool): each replica is its own scheduler loop —
 on a pod, its own tensor-sharded device group stepping independently; on
 one host, independent pools whose aggregate KV capacity (and radix
@@ -59,12 +77,58 @@ import time
 import numpy as np
 
 
+# handoff-key sentinel: negative (never a real token), far below the
+# adapter-uid namespace sentinels (-(uid)-1); a migration key is
+#   adapter_namespace + (_MIG_SENTINEL, unique_counter)
+# so adapter invalidation (store.drop_prefix on the uid namespace) reclaims
+# parked handoffs too, and no probe of real prompt tokens can ever match one
+_MIG_SENTINEL = -(1 << 30)
+
+_PHASE_ROLES = ("prefill", "decode", "mixed")
+
+
+class _Migration:
+    """One prefill→decode handoff in flight: the request object plus where
+    its KV is parked. ``entry`` stays None until the demote's async
+    device→host fetch lands (``ready`` flips then) — decode pumps only see
+    READY records."""
+
+    __slots__ = ("req", "key", "kv_len", "version", "entry", "ready",
+                 "src_idx", "t_start")
+
+    def __init__(self, req, key, src_idx, t_start):
+        self.req = req
+        self.key = key
+        self.kv_len = 0
+        self.version = 0
+        self.entry = None
+        self.ready = False
+        self.src_idx = src_idx
+        self.t_start = t_start
+
+
+class _FleetPump:
+    """Handle-compatible pump for a migrated-out request: ``result()`` on a
+    request whose handoff is parked must drive the WHOLE fleet (the prefill
+    scheduler alone would spin forever), so migrate-out re-points the
+    handle's scheduler here until a decode replica adopts the request."""
+
+    __slots__ = ("_rs", "engine")
+
+    def __init__(self, rs):
+        self._rs = rs
+        self.engine = rs.primary.engine
+
+    def step(self):
+        return self._rs.pump_once()
+
+
 class Replica:
     """One scheduler + its fleet bookkeeping (placement load signals,
-    health/drain state, throughput EMA). The scheduler itself stays
-    single-threaded: exactly one pump thread calls :meth:`step`."""
+    health/drain state, phase role, throughput EMA). The scheduler itself
+    stays single-threaded: exactly one pump thread calls :meth:`step`."""
 
-    def __init__(self, idx, scheduler, telemetry=None):
+    def __init__(self, idx, scheduler, telemetry=None, phase_role="mixed"):
         self.idx = idx
         self.scheduler = scheduler
         self.telemetry = telemetry if telemetry is not None else scheduler.telemetry
@@ -73,9 +137,23 @@ class Replica:
         self.sick_error = None
         self.dispatched = 0
         self.tokens = 0
+        # disaggregated serving: "prefill" replicas run prefills and hand
+        # finished prompts to the decode side; "decode" replicas receive
+        # migrations and never take fresh placements; "mixed" does both
+        # (and neither migrates nor changes any pre-disaggregation behavior)
+        self.phase_role = phase_role
         self.ema_service_s = None   # per-replica Retry-After-style service EMA
         self.tok_s = 0.0            # EWMA of delivered tokens/sec
         self._last_step_end = None
+
+    # ---------------------------------------------------------------- phase
+    def prefill_capable(self):
+        """Eligible for fresh prompt placement (gateway/FairQueue pops)."""
+        return self.phase_role in ("prefill", "mixed")
+
+    def decode_capable(self):
+        """Eligible to adopt migrated-in decode work."""
+        return self.phase_role in ("decode", "mixed")
 
     # ---------------------------------------------------------------- load
     def busy_slots(self):
@@ -144,6 +222,12 @@ class Replica:
             "status": ("sick" if self.sick else
                        "draining" if self.draining else "active"),
             "error": self.sick_error,
+            # disaggregated serving: this replica's phase role and how many
+            # requests it has handed off / adopted (the gateway's
+            # /v1/replicas + /v1/metrics surface)
+            "phase_role": self.phase_role,
+            "migrations_out": s.migrations_out,
+            "migrations_in": s.migrations_in,
             "num_slots": s.num_slots,
             "active_slots": s.cache.active_slots,
             "cached_slots": s.cache.cached_slots,
@@ -173,7 +257,8 @@ class ReplicaSet:
     pump threads race :meth:`dispatch`/:meth:`route` under the internal
     lock; each replica's ``step`` stays exclusive to its own pump."""
 
-    def __init__(self, replicas, sticky_capacity=2048):
+    def __init__(self, replicas, sticky_capacity=2048, roles=None,
+                 migrate_min_tokens=0):
         if not replicas:
             raise ValueError("ReplicaSet needs at least one replica")
         self.replicas = list(replicas)
@@ -185,6 +270,25 @@ class ReplicaSet:
         self._sticky_capacity = int(sticky_capacity)
         chunk = self.primary.prefill_chunk
         self._sticky_chunk = chunk if chunk > 0 else 64
+        # disaggregated prefill/decode: the fleet-wide handoff queue (pull
+        # model — decode pumps claim READY records as they find capacity)
+        # plus the migrate-time knobs. Hooks install lazily the first time
+        # any replica takes a non-mixed role.
+        self._migrations = collections.deque()
+        self._mig_id = 0
+        self.migrate_min_tokens = max(0, int(migrate_min_tokens))
+        self.migrations_failed = 0
+        self._pump_proxy = _FleetPump(self)
+        self._hooks_installed = False
+        self._warmup_pending = False
+        if roles:
+            for idx, role in enumerate(roles):
+                if idx < len(self.replicas):
+                    self.set_role(idx, role)
+            # build time: no pump threads exist yet, so the constructor IS
+            # the pump-owned context — warm the tier programs here, before
+            # the gateway's recompile watch can arm
+            self._run_pending_warmup(self.replicas[0])
 
     # ---------------------------------------------------------------- build
     @classmethod
@@ -194,10 +298,13 @@ class ReplicaSet:
         pre-replica path), siblings clone its exact configuration and share
         its compiled-program cache — same shapes, same programs, zero new
         XLA compiles per added replica. ``n`` defaults to the engine's
-        ``continuous_batching.replicas``."""
+        ``continuous_batching.replicas``; the ``disaggregation`` config
+        section seeds per-replica phase roles (all-``mixed`` when absent —
+        byte-identical to the pre-disaggregation fleet)."""
         from ..inference.scheduler import DecodeScheduler
+        cb = engine._config.continuous_batching
         if n is None:
-            n = int(getattr(engine._config.continuous_batching, "replicas", 1) or 1)
+            n = int(getattr(cb, "replicas", 1) or 1)
         if n < 1:
             raise ValueError(f"replicas must be >= 1, got {n}")
         primary = engine.scheduler(**scheduler_overrides)
@@ -205,7 +312,13 @@ class ReplicaSet:
         for _ in range(1, n):
             scheds.append(DecodeScheduler(engine, compiled_cache=primary._compiled,
                                           **primary._init_kwargs))
-        return cls([Replica(i, s) for i, s in enumerate(scheds)])
+        dg = getattr(cb, "disaggregation", None)
+        roles = list(getattr(dg, "roles", []) or []) if (
+            dg is not None and dg.enabled) else []
+        mmt = int(getattr(dg, "migrate_min_tokens", 0) or 0) if (
+            dg is not None and dg.enabled) else 0
+        return cls([Replica(i, s) for i, s in enumerate(scheds)],
+                   roles=roles, migrate_min_tokens=mmt)
 
     @property
     def primary(self):
@@ -224,8 +337,26 @@ class ReplicaSet:
         return sum(r.scheduler.num_slots for r in self.replicas
                    if r.available()) or self.replicas[0].scheduler.num_slots
 
+    def phase_slots(self, phase):
+        """Available slots on one side of the phase split (``"prefill"`` /
+        ``"decode"`` capability — mixed counts for both): the gateway's
+        phase-aware Retry-After divides each side's backlog by its own
+        capacity instead of the blended fleet total."""
+        want = (Replica.prefill_capable if phase == "prefill"
+                else Replica.decode_capable)
+        return sum(r.scheduler.num_slots for r in self.replicas
+                   if r.available() and want(r))
+
+    def disaggregated(self):
+        """Any non-mixed role in the fleet (phase-aware paths switch on)."""
+        return any(r.phase_role != "mixed" for r in self.replicas)
+
     def any_capacity(self):
-        return any(r.available() and r.has_capacity() for r in self.replicas)
+        """A fresh prompt can be placed right now: an available
+        PREFILL-capable replica has a free slot (decode-only replicas are
+        not placement targets — that is the disaggregation contract)."""
+        return any(r.available() and r.has_capacity() and r.prefill_capable()
+                   for r in self.replicas)
 
     def healthy(self):
         return [r for r in self.replicas if not r.sick]
@@ -285,6 +416,211 @@ class ReplicaSet:
         for key in [k for k, v in self._sticky.items() if v == idx]:
             del self._sticky[key]
 
+    # ---------------------------------------------------------------- phase roles
+    def set_role(self, idx, role):
+        """Assign replica ``idx`` a phase role (config seeding and the
+        gateway's ``POST /v1/replicas/<i>/role`` runtime override). A
+        non-mixed role requires the migration transport (the hierarchical
+        prefix store — ``continuous_batching.disaggregation.enabled``
+        creates it; ``hierarchical_kv`` also provides it) and a fleet that
+        keeps BOTH phases coverable; violating either reverts and raises."""
+        if role not in _PHASE_ROLES:
+            raise ValueError(f"phase_role must be one of {_PHASE_ROLES}, got {role!r}")
+        rep = self.replicas[idx]
+        if role != "mixed" and self.primary.kv_tier is None:
+            raise ValueError(
+                "phase roles need the hierarchical-KV prefix store as the "
+                "migration transport: enable continuous_batching.disaggregation "
+                "(or hierarchical_kv) so the fleet shares a GlobalPrefixStore")
+        prev, rep.phase_role = rep.phase_role, role
+        if not (any(r.prefill_capable() for r in self.replicas)
+                and any(r.decode_capable() for r in self.replicas)):
+            rep.phase_role = prev
+            raise ValueError(
+                f"role {role!r} on replica {idx} would leave the fleet with no "
+                f"{'prefill' if role == 'decode' else 'decode'}-capable replica "
+                f"(roles: {[r.phase_role for r in self.replicas]})")
+        if role == "decode":
+            with self._lock:
+                self._purge_sticky(idx)  # no fresh placements land here
+        if role != "mixed" and not self._hooks_installed:
+            try:
+                self._install_migration_hooks()
+            except Exception:
+                rep.phase_role = prev  # docstring contract: revert AND raise
+                raise
+        return rep.state()
+
+    def _install_migration_hooks(self):
+        """First non-mixed role: every scheduler gets the migrate hook (it
+        consults the CURRENT role at each prefill completion, so runtime
+        role flips take effect immediately) and the tier-program warmup is
+        FLAGGED for the primary's pump — set_role may run on the gateway's
+        admin (event-loop) thread, and warming inline there would race the
+        pump's concurrent pool updates. The pump executes it at its next
+        ``admit_migrations`` turn, which both pump loops run BEFORE any
+        step that could migrate."""
+        if self.primary.prefill_chunk <= 0:
+            raise ValueError("disaggregated serving requires chunked prefill "
+                             "(prefill_chunk > 0): migration hands off at "
+                             "chunk-prefill completion")
+        for rep in self.replicas:
+            rep.scheduler.migrate_hook = self._maybe_migrate
+        self._warmup_pending = True
+        self._hooks_installed = True
+
+    def _run_pending_warmup(self, rep):
+        """Compile tier_slice/tier_restore into the SHARED program cache
+        (one warmup serves every replica). Runs on a pump-owned turn — for
+        build-time roles that is the constructor (no pumps yet); for a
+        runtime role flip, the primary's next pump turn. A flip on a warm
+        gateway may trip the recompile watch once — an expected compile,
+        visible as exactly these two tier programs in the flight dump."""
+        if self._warmup_pending and rep is self.replicas[0]:
+            self._warmup_pending = False
+            self.primary.kv_tier.warmup()
+
+    # ---------------------------------------------------------------- migration
+    def _maybe_migrate(self, sched, req):
+        """The scheduler-side migrate hook: decide whether the request a
+        prefill sync just finished should hand off to the decode side, and
+        if so drive ``migrate_out``. Runs on the PREFILL replica's pump
+        thread. Returns True when the request was taken."""
+        rep = next((r for r in self.replicas if r.scheduler is sched), None)
+        if rep is None or rep.phase_role != "prefill":
+            return False  # mixed/decode replicas keep their decodes
+        if req.prompt.size < self.migrate_min_tokens:
+            return False  # colocate: the handoff isn't worth a short prompt
+        with self._lock:
+            target_exists = any(r.decode_capable() and r.available()
+                                for r in self.replicas if r is not rep)
+            if not target_exists:
+                return False  # degraded fleet: colocate rather than stall
+            self._mig_id += 1
+            mig_id = self._mig_id
+        ns = (sched.adapters.namespace(req.adapter_ref.uid)
+              if req.adapter_ref is not None else ())
+        key = tuple(ns) + (_MIG_SENTINEL, mig_id)
+        record = _Migration(req, key, rep.idx, time.monotonic())
+        record.version = int(sched.cache.weights_version)
+
+        def on_ready(entry):
+            # transfer-thread callback: the handoff entry is probe-visible
+            # (or the fetch failed — entry None settles the request on the
+            # next pull). Attribute stores are atomic; ready flips LAST.
+            record.entry = entry
+            record.ready = True
+            cb = self.on_migration_ready
+            if cb is not None:
+                cb()
+        record.kv_len = sched.migrate_out(req, key, on_ready)
+        if req.handle is not None:
+            # a parked request is owned by NO scheduler; result() must
+            # drive the fleet until a decode replica adopts it
+            req.handle._sched = self._pump_proxy
+        with self._lock:
+            self._migrations.append(record)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/migrations")
+            tel.counter(f"serving/replica/{rep.idx}/migrations_out")
+        return True
+
+    # gateway wakeup for parked decode pumps (set by Gateway; None = polling
+    # direct-drive callers)
+    on_migration_ready = None
+
+    def pending_migrations(self):
+        return len(self._migrations)
+
+    def admit_migrations(self, rep):
+        """Let ``rep``'s pump claim parked handoffs (called from that pump's
+        thread, once per turn): cancelled/failed records settle on ANY pump;
+        ready records admit onto an available decode-capable replica — or
+        onto ANY available replica when the decode side has vanished
+        entirely (degraded colocation beats stalling the requests).
+        Returns the number of records consumed."""
+        self._run_pending_warmup(rep)  # runtime role flip: warm on the pump
+        if not self._migrations:
+            return 0
+        sched = rep.scheduler
+        consumed = 0
+        while True:
+            record = None
+            settle = False
+            with self._lock:
+                no_decode_side = not any(r.decode_capable() and r.available()
+                                         for r in self.replicas)
+                can_admit = (rep.available() and not rep.sick
+                             and (rep.decode_capable() or no_decode_side))
+                for i, rec in enumerate(self._migrations):
+                    # settle only READY records: a cancel racing the
+                    # in-flight demote fetch must wait for the store put to
+                    # land — settling early would discard nothing and the
+                    # late-landing pinned entry would leak forever
+                    if rec.ready and (rec.req.cancelled or rec.entry is None):
+                        record, settle = rec, True
+                        del self._migrations[i]
+                        break
+                    if rec.ready and can_admit and not rec.req.cancelled:
+                        record = rec
+                        del self._migrations[i]
+                        break
+                if record is None:
+                    return consumed
+            if settle:
+                sched.admit_migration(record)  # settles without a slot
+                if not record.req.cancelled:
+                    self.migrations_failed += 1
+                consumed += 1
+                continue
+            try:
+                outcome = sched.admit_migration(record)
+            except Exception:
+                # the scheduler settled the request before re-raising;
+                # account the fleet-level failure, then let the pump's
+                # sick-replica handling see the error
+                self.migrations_failed += 1
+                raise
+            if outcome == "resumed":
+                consumed += 1
+                rep.dispatched += 1
+                tel = self.telemetry
+                if tel.enabled:
+                    tel.counter(f"serving/replica/{rep.idx}/migrations_in")
+                    tel.counter("serving/migration_tokens", record.kv_len)
+                    tel.histogram("serving/migration_ms",
+                                  (time.monotonic() - record.t_start) * 1e3)
+            elif outcome == "settled":
+                self.migrations_failed += 1
+                consumed += 1
+            else:  # no free slot on this replica: park it again
+                with self._lock:
+                    self._migrations.appendleft(record)
+                return consumed
+
+    def _fail_handoffs(self):
+        """No replica can ever adopt the parked handoffs (the whole fleet is
+        sick/unavailable): settle them as failed instead of leaving their
+        clients waiting on a queue nobody drains. In-flight demote fetches
+        are joined first so their store entries land and can be discarded
+        (a late-landing pinned entry would otherwise leak)."""
+        for rep in self.replicas:
+            tier = rep.scheduler.kv_tier
+            if tier is not None:
+                tier.executor.drain_fetches()
+        with self._lock:
+            records, self._migrations = list(self._migrations), collections.deque()
+        for rec in records:
+            # the primary's settle helper: shared store/adapter refs, and
+            # the same cancel-vs-failure accounting as every other settle
+            # site (a client cancel landing here is a cancel, not a failure)
+            self.primary._settle_migration(
+                rec, error="migration failed: no serving replica available")
+            if not rec.req.cancelled:
+                self.migrations_failed += 1
+        return len(records)
+
     # ---------------------------------------------------------------- dispatch
     def _sticky_key(self, prompt, adapter=None):
         # the adapter id is part of the prefix identity: a prefix cached
@@ -302,7 +638,8 @@ class ReplicaSet:
         scopes stickiness per model variant (multi-LoRA serving)."""
         with self._lock:
             candidates = [r for r in self.replicas
-                          if r.available() and r.has_capacity()]
+                          if r.available() and r.has_capacity()
+                          and r.prefill_capable()]
             if not candidates:
                 return None
             key = self._sticky_key(prompt, adapter)
@@ -310,13 +647,13 @@ class ReplicaSet:
             tel = self.telemetry
             if hit is not None:
                 rep = self.replicas[hit]
-                if rep.available() and rep.has_capacity():
+                if rep.available() and rep.has_capacity() and rep.prefill_capable():
                     self._sticky.move_to_end(key)
                     if tel.enabled:
                         tel.counter("serving/dispatch/sticky")
                     return rep
-                if not rep.available():
-                    del self._sticky[key]  # sick/draining owner: re-home
+                if not rep.available() or not rep.prefill_capable():
+                    del self._sticky[key]  # sick/draining/decode-role owner: re-home
             known = [r.ema_service_s for r in candidates
                      if r.ema_service_s is not None]
             fallback = (sum(known) / len(known)) if known else 1.0
@@ -357,15 +694,39 @@ class ReplicaSet:
             tel.counter(f"serving/replica/{rep.idx}/dispatched")
 
     # ---------------------------------------------------------------- drive (testing/bench)
+    def pump_once(self):
+        """One single-threaded fleet turn: let every replica claim parked
+        handoffs, then step the non-idle ones. Returns whether anything
+        progressed (the gateway's per-replica pump threads do the same two
+        calls per turn, one replica each)."""
+        progressed = False
+        for rep in self.replicas:
+            if self.admit_migrations(rep):
+                progressed = True
+            if not rep.idle() and not rep.sick:
+                rep.step()
+                progressed = True
+        return progressed
+
     def drain_all_work(self):
-        """Single-threaded convenience pump: step every replica until the
-        whole fleet is idle (benches and tests; the gateway runs one pump
-        thread per replica instead)."""
+        """Single-threaded convenience pump: step every replica (and place
+        parked migrations) until the whole fleet is idle (benches and
+        tests; the gateway runs one pump thread per replica instead)."""
         while True:
-            progressed = False
-            for rep in self.replicas:
-                if not rep.idle() and not rep.sick:
-                    rep.step()
-                    progressed = True
-            if not progressed:
+            if self.pump_once():
+                continue
+            if not self._migrations:
                 return
+            # handoffs pending but nothing progressed: either their
+            # device->host fetch is still in flight (join it — ready flips
+            # and the next turn places them) or no replica can ever take
+            # them (fail rather than spin)
+            if any(not rec.ready for rec in list(self._migrations)):
+                for rep in self.replicas:
+                    tier = rep.scheduler.kv_tier
+                    if tier is not None:
+                        tier.executor.drain_fetches()
+                continue
+            if not any(r.available() for r in self.replicas):
+                self._fail_handoffs()
+                continue

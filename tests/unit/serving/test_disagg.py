@@ -1,0 +1,429 @@
+"""Disaggregated prefill/decode: migration correctness guards (ISSUE 14).
+
+The contract: a request whose prefill ran on a ``prefill``-role replica and
+whose KV migrated to a ``decode`` replica through the hierarchical-KV host
+staging layer decodes BIT-identically to the same request on a
+single-replica scheduler — tokens AND logits, greedy and sampled, bf16 and
+int8 KV, radix hit and cold, with and without a LoRA adapter. Plus the
+structure around it: a mid-migration cancel frees both ends' slots, a sick
+decode replica's parked handoffs re-place onto a healthy one, a zero-role
+fleet is behaviorally identical to the pre-disaggregation path, and a warm
+role/migration mix adds ZERO new XLA programs (jax.monitoring-guarded).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.serving import ReplicaSet
+
+_XLA_COMPILES = []  # registered once: jax.monitoring listeners can't detach
+
+
+def _count_xla_compiles():
+    if not _XLA_COMPILES:
+        _XLA_COMPILES.append("registered")
+        jax.monitoring.register_event_duration_secs_listener(
+            lambda name, *a, **kw: _XLA_COMPILES.append(name)
+            if name == "/jax/core/compile/backend_compile_duration" else None)
+    return _XLA_COMPILES
+
+
+def make_engine(params=None, num_slots=4, kv_cache_dtype="auto", roles=None,
+                migrate_min_tokens=0, telemetry=None, **cb_extra):
+    comm._state["mesh"] = None
+    from deepspeed_tpu.telemetry import set_sink
+    set_sink(None)  # sink hermeticity: no cross-test counter bleed
+    cb = {"enabled": True, "num_slots": num_slots,
+          "kv_cache_dtype": kv_cache_dtype}
+    if roles is not None:
+        cb["disaggregation"] = {"enabled": True, "roles": roles,
+                                "migrate_min_tokens": migrate_min_tokens}
+    cb.update(cb_extra)
+    cfg = {"dtype": "float32", "max_out_tokens": 512,
+           "continuous_batching": cb}
+    if telemetry:
+        cfg["telemetry"] = telemetry
+    return deepspeed_tpu.init_inference("tiny", config=cfg, params=params)
+
+
+@pytest.fixture(scope="module")
+def params():
+    eng = make_engine()
+    return jax.device_get(eng.params)
+
+
+_RNG = np.random.default_rng(14)
+# cold + an exact revisit (the revisit radix-hits on the prefill replica)
+PROMPTS = [_RNG.integers(0, 256, 100).astype(np.int32),
+           _RNG.integers(0, 256, 70).astype(np.int32)]
+
+
+def _stream(rs, sampled, max_new=10):
+    """Submit the cold/hit/cold request mix through ``rs`` and drain:
+    returns (tokens, logits) per request."""
+    kw = (dict(do_sample=True, temperature=0.8, top_k=9, seed=123)
+          if sampled else dict(seed=7))
+    handles = []
+    for p in (PROMPTS[0], PROMPTS[0], PROMPTS[1]):  # cold, radix HIT, cold
+        rep, h = rs.dispatch(p, max_new_tokens=max_new, collect_logits=True,
+                             **kw)
+        assert h is not None
+        handles.append(h)
+    rs.drain_all_work()
+    return ([h.result().tolist() for h in handles],
+            [h.result_logits() for h in handles])
+
+
+# ----------------------------------------------------------------- bit-identity
+@pytest.mark.parametrize("kv_dtype", ["bf16", "int8"])
+@pytest.mark.parametrize("sampled", [False, True], ids=["greedy", "sampled"])
+def test_migrated_decode_bit_identical(params, kv_dtype, sampled):
+    """THE acceptance bar: tokens AND logits of a prefill→migrate→decode
+    run equal the single-replica run, across greedy/sampled × bf16/int8 KV
+    × radix hit/cold (the request mix covers hit and cold)."""
+    eng = make_engine(params, kv_cache_dtype=kv_dtype)
+    ref_t, ref_l = _stream(ReplicaSet.build(eng, 1), sampled)
+
+    eng2 = make_engine(params, kv_cache_dtype=kv_dtype,
+                       roles=["prefill", "decode"])
+    rs = ReplicaSet.build(eng2, 2)
+    got_t, got_l = _stream(rs, sampled)
+
+    assert got_t == ref_t
+    for a, b in zip(ref_l, got_l):
+        assert a.shape == b.shape
+        assert (a == b).all(), "migrated logits diverged"
+    # every request really migrated (prefill role never keeps a decode)
+    assert rs.primary.migrations_out == 3
+    assert rs.replicas[1].scheduler.migrations_in == 3
+    assert rs.pending_migrations() == 0
+    # both ends' bookkeeping is clean
+    for rep in rs:
+        rep.scheduler.radix.check_invariants()
+        assert rep.scheduler.cache.active_slots == 0
+
+
+def _adapter_tree(eng, params, seed=1, scale=0.05):
+    """A LoRAModel adapter tree with NONZERO b halves (init_lora's b=0
+    start would make every delta vanish and the test vacuous)."""
+    from deepspeed_tpu.runtime.lora import LoRAModel
+    lora = LoRAModel(eng.module, r=4, alpha=8.0)
+    tree = lora.init_lora(params, jax.random.key(seed))
+
+    def bump(node, i=[seed * 1000]):
+        if isinstance(node, dict) and "a" in node and "b" in node \
+                and not isinstance(node["a"], dict):
+            i[0] += 1
+            return {"a": node["a"],
+                    "b": jax.random.normal(jax.random.key(i[0]),
+                                           node["b"].shape) * scale}
+        return {k: bump(v) for k, v in node.items()}
+    return bump(tree)
+
+
+def test_migrated_decode_with_adapter_bit_identical(params):
+    """Adapter requests migrate with their page pin and namespace: the
+    disaggregated stream equals the single-replica stream for the SAME
+    adapter, and base traffic stays base."""
+    tree = None
+
+    def run(roles, n):
+        nonlocal tree
+        eng = make_engine(params, roles=roles)
+        if tree is None:
+            tree = _adapter_tree(eng, params)
+        eng.register_adapter("tenant-a", lora_tree=tree, alpha=8.0)
+        rs = ReplicaSet.build(eng, n)
+        handles = []
+        for adapter in (None, "tenant-a", "tenant-a"):
+            rep, h = rs.dispatch(PROMPTS[0], max_new_tokens=8, seed=5,
+                                 collect_logits=True, adapter_id=adapter)
+            assert h is not None
+            handles.append(h)
+        rs.drain_all_work()
+        return rs, ([h.result().tolist() for h in handles],
+                    [h.result_logits() for h in handles])
+
+    _, (ref_t, ref_l) = run(None, 1)
+    rs, (got_t, got_l) = run(["prefill", "decode"], 2)
+    assert got_t == ref_t
+    for a, b in zip(ref_l, got_l):
+        assert (a == b).all()
+    assert ref_t[0] != ref_t[1], "adapter output should differ from base"
+    assert rs.primary.migrations_out == 3
+    for rep in rs:
+        rep.scheduler.radix.check_invariants()
+
+
+# ----------------------------------------------------------------- structure
+def _park_one_migration(rs, prompt, **kw):
+    """Submit onto the prefill replica and pump ONLY it until the handoff
+    is parked (ready) in the fleet queue; returns the handle."""
+    rep, h = rs.dispatch(prompt, **kw)
+    assert rep is rs.replicas[0]
+    pre = rs.replicas[0]
+    for _ in range(200):
+        if rs.pending_migrations():
+            break
+        pre.step()
+    assert rs.pending_migrations() == 1
+    # join the async demote fetch so the record is READY (claimable)
+    pre.scheduler.kv_tier.executor.drain_fetches()
+    assert rs._migrations[0].ready and rs._migrations[0].entry is not None
+    return h
+
+
+def test_mid_migration_cancel_frees_both_ends(params):
+    """Cancel while the handoff is parked: the request settles, the store
+    entry dies, and NEITHER replica holds a live slot for it."""
+    eng = make_engine(params, roles=["prefill", "decode"])
+    rs = ReplicaSet.build(eng, 2)
+    h = _park_one_migration(rs, PROMPTS[0], max_new_tokens=16, seed=1)
+    store = rs.primary.kv_tier.store
+    assert len(store) == 1  # the parked handoff entry
+    h.cancel()
+    rs.drain_all_work()
+    assert h.done
+    assert rs.pending_migrations() == 0
+    assert len(store) == 0, "cancelled handoff leaked its store entry"
+    for rep in rs:
+        assert rep.scheduler.cache.active_slots == 0
+        rep.scheduler.radix.check_invariants()
+    # the decode replica never adopted it
+    assert rs.replicas[1].scheduler.migrations_in == 0
+
+    # cancel RACING the in-flight demote fetch (no drain first): the
+    # settle must wait for the store put to land, then discard it — an
+    # early settle would let the late-landing pinned entry leak forever
+    rep, h2 = rs.dispatch(PROMPTS[1], max_new_tokens=16, seed=2)
+    pre = rs.replicas[0]
+    for _ in range(200):
+        if rs.pending_migrations():
+            break
+        pre.step()
+    h2.cancel()  # record may or may not be ready yet — both paths must clean
+    rs.drain_all_work()
+    assert h2.done
+    assert rs.pending_migrations() == 0
+    assert len(store) == 0, "cancel racing the demote fetch leaked the entry"
+
+
+def test_sick_decode_replica_failover_replaces_kv(params):
+    """A parked handoff is bound to NO replica: when the intended decode
+    replica goes sick before adopting it, another decode replica pulls it
+    and the stream completes identically."""
+    eng = make_engine(params)
+    ref = eng.scheduler().submit(PROMPTS[0], max_new_tokens=12,
+                                 seed=9).result().tolist()
+
+    eng2 = make_engine(params, roles=["prefill", "decode", "decode"])
+    rs = ReplicaSet.build(eng2, 3)
+    h = _park_one_migration(rs, PROMPTS[0], max_new_tokens=12, seed=9)
+    rs.mark_sick(1, "injected failure")
+    rs.drain_all_work()
+    assert h.result().tolist() == ref
+    assert rs.replicas[1].scheduler.migrations_in == 0
+    assert rs.replicas[2].scheduler.migrations_in == 1
+    assert rs.migrations_failed == 0
+
+
+def test_prefill_replica_sick_after_handoff_does_not_kill_request(params):
+    """Ownership moves with the KV: once migrated out, the prefill replica
+    failing must not fail the request (DecodeScheduler.owns drives the
+    gateway's shedding; here we assert the scheduler-level truth)."""
+    eng = make_engine(params, roles=["prefill", "decode"])
+    rs = ReplicaSet.build(eng, 2)
+    h = _park_one_migration(rs, PROMPTS[0], max_new_tokens=12, seed=2)
+    req = h._req
+    assert not rs.primary.owns(req), "migrated-out request still owned by prefill"
+    assert not rs.replicas[1].scheduler.owns(req)
+    rs.drain_all_work()
+    assert rs.replicas[1].scheduler.owns(req) or h.done
+
+
+def test_no_decode_target_colocates(params):
+    """Degraded fleet: the decode side drained away → prefill replicas keep
+    serving both phases (colocate) instead of stalling requests."""
+    eng = make_engine(params, roles=["prefill", "decode"])
+    rs = ReplicaSet.build(eng, 2)
+    rs.drain(1)  # decode side gone
+    rep, h = rs.dispatch(PROMPTS[1], max_new_tokens=8, seed=3)
+    assert rep is rs.replicas[0]
+    rs.drain_all_work()
+    assert len(h.result()) == 8
+    assert rs.primary.migrations_out == 0  # colocated, not parked forever
+    assert rs.pending_migrations() == 0
+
+
+def test_migrate_min_tokens_colocates_short_prompts(params):
+    """The migrate-vs-colocate threshold: prompts under it decode where
+    they prefilled even on a 'prefill' replica."""
+    eng = make_engine(params, roles=["prefill", "decode"],
+                      migrate_min_tokens=90)
+    rs = ReplicaSet.build(eng, 2)
+    _, h_short = rs.dispatch(PROMPTS[1], max_new_tokens=6, seed=4)   # 70 tok
+    _, h_long = rs.dispatch(PROMPTS[0], max_new_tokens=6, seed=4)    # 100 tok
+    rs.drain_all_work()
+    assert len(h_short.result()) == 6 and len(h_long.result()) == 6
+    assert rs.primary.migrations_out == 1  # only the long prompt moved
+
+
+def test_zero_role_fleet_identical_to_plain_replicas(params):
+    """disaggregation.enabled with NO role assignments must behave exactly
+    like the pre-disaggregation fleet: no hooks, no migrations, identical
+    token streams."""
+    eng = make_engine(params)
+    rs_ref = ReplicaSet.build(eng, 2)
+    handles = [rs_ref.dispatch(p, max_new_tokens=8, seed=11)[1]
+               for p in PROMPTS]
+    rs_ref.drain_all_work()
+    ref = [h.result().tolist() for h in handles]
+
+    eng2 = make_engine(params, roles=[])
+    rs = ReplicaSet.build(eng2, 2)
+    assert not rs._hooks_installed
+    assert all(r.scheduler.migrate_hook is None for r in rs)
+    handles = [rs.dispatch(p, max_new_tokens=8, seed=11)[1] for p in PROMPTS]
+    rs.drain_all_work()
+    assert [h.result().tolist() for h in handles] == ref
+    assert rs.primary.migrations_out == 0
+
+
+def test_set_role_validation(params):
+    """Role surgery keeps the fleet coverable and needs the transport."""
+    eng = make_engine(params)  # no store
+    rs = ReplicaSet.build(eng, 2)
+    with pytest.raises(ValueError, match="prefix store"):
+        rs.set_role(0, "prefill")
+    with pytest.raises(ValueError, match="phase_role"):
+        rs.set_role(0, "bogus")
+
+    eng2 = make_engine(params, roles=["prefill", "decode"])
+    rs2 = ReplicaSet.build(eng2, 2)
+    # flipping the only decode replica to prefill would strand the fleet
+    with pytest.raises(ValueError, match="decode-capable"):
+        rs2.set_role(1, "prefill")
+    assert rs2.replicas[1].phase_role == "decode"  # reverted
+    # legal runtime flip: both back to mixed
+    rs2.set_role(0, "mixed")
+    rs2.set_role(1, "mixed")
+    assert not rs2.disaggregated()
+
+
+# ----------------------------------------------------------------- compile guard
+def test_migration_cycle_zero_new_programs(params):
+    """jax.monitoring guard: warm the disaggregated fleet (cold prefill,
+    radix hit, migration, decode), then run a FRESH role/length/sampling/
+    migration mix — zero new XLA programs (tier_slice/tier_restore warm at
+    hook install; everything else is the shared O(1) program set)."""
+    compiles = _count_xla_compiles()
+    eng = make_engine(params, roles=["prefill", "decode"])
+    rs = ReplicaSet.build(eng, 2)
+    _stream(rs, sampled=False)
+    _stream(rs, sampled=True)
+    # the fresh mix below runs WITHOUT logits collection (and one request
+    # at a time at the tail): warm those variants too — collect on/off and
+    # the 1-step (non-final chunk, idle pool) program are distinct members
+    # of the O(1) set. FRESH prompts, not PROMPTS: a radix hit would skip
+    # straight to the final chunk and never touch the K=1 variant.
+    wrng = np.random.default_rng(5150)
+    for i in range(2):
+        p = wrng.integers(0, 256, 100).astype(np.int32)  # >= 2 chunks, cold
+        rep, h = rs.dispatch(p, max_new_tokens=6, do_sample=(i % 2 == 0),
+                             temperature=0.7, top_k=5, seed=50 + i)
+        rs.drain_all_work()
+        h.result()
+    before_programs = rs.compiled_program_count()
+    before = len(compiles)
+
+    # fresh mix: new lengths, greedy+sampled interleaved, a role flip, and
+    # more migrations than the warmup saw
+    rng = np.random.default_rng(77)
+    handles = []
+    for i, n in enumerate((33, 81, 64, 97, 12)):
+        p = rng.integers(0, 256, n).astype(np.int32)
+        while True:  # prefill side saturates at 4 slots: pump until placeable
+            rep, h = rs.dispatch(p, max_new_tokens=6, do_sample=(i % 2 == 0),
+                                 temperature=0.7, top_k=5, seed=100 + i)
+            if h is not None:
+                break
+            rs.pump_once()
+        handles.append(h)
+    rs.drain_all_work()
+    rs.set_role(1, "mixed")
+    rs.set_role(1, "decode")
+    rep, h = rs.dispatch(rng.integers(0, 256, 50).astype(np.int32),
+                         max_new_tokens=6, seed=200)
+    handles.append(h)
+    rs.drain_all_work()
+    assert all(hh.done for hh in handles)
+    assert rs.compiled_program_count() == before_programs
+    assert len(compiles) == before, (
+        f"{len(compiles) - before} new XLA programs in a warm migration mix")
+
+
+# ----------------------------------------------------------------- gateway e2e
+def test_gateway_disagg_end_to_end(params, tmp_path):
+    """Disaggregated fleet over HTTP: completions migrate and match the
+    single-scheduler reference, /v1/replicas carries phase_role +
+    migration counters, /v1/metrics rolls the fleet up (JSON + Prometheus),
+    and the role endpoint flips at runtime."""
+    from deepspeed_tpu.serving import Gateway
+    # reference from a SEPARATE plain engine, built FIRST (make_engine
+    # resets the global sink/mesh): submitting through the disaggregated
+    # fleet's primary would itself migrate (and count)
+    ref_eng = make_engine(params, num_slots=2)
+    ref = [int(t) for t in ref_eng.scheduler().submit(
+        [5, 6, 7, 8] * 20, max_new_tokens=6, seed=3).result()]
+    eng = make_engine(params, num_slots=2, replicas=2,
+                      roles=["prefill", "decode"],
+                      telemetry={"enabled": True,
+                                 "output_path": str(tmp_path)})
+    gw = Gateway(eng, port=0, request_timeout_s=60.0)
+    gw.start_background()
+    base = f"http://127.0.0.1:{gw.port}"
+
+    def post(path, body):
+        req = urllib.request.Request(base + path, data=json.dumps(body).encode(),
+                                     headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+    def get(path, headers=None):
+        req = urllib.request.Request(base + path, headers=headers or {})
+        return urllib.request.urlopen(req, timeout=60).read()
+
+    try:
+        outs = [post("/v1/completions",
+                     {"prompt": [5, 6, 7, 8] * 20, "max_tokens": 6, "seed": 3})
+                for _ in range(3)]
+        for out in outs:
+            assert out["choices"][0]["token_ids"] == ref
+        states = json.loads(get("/v1/replicas"))["replicas"]
+        assert [s["phase_role"] for s in states] == ["prefill", "decode"]
+        assert states[0]["migrations_out"] == 3
+        assert states[1]["migrations_in"] == 3
+        m = json.loads(get("/v1/metrics"))
+        assert m["disaggregation"]["roles"] == ["prefill", "decode"]
+        assert m["disaggregation"]["migrations"] == 3
+        assert m["disaggregation"]["pending"] == 0
+        text = get("/v1/metrics", {"Accept": "text/plain"}).decode()
+        assert "dstpu_serving_replicas_prefill_capable 1" in text
+        assert "dstpu_serving_migrations_pending 0" in text
+        assert 'dstpu_serving_replica_migrations_out_total{replica="0"} 3' in text
+        # runtime role flip via the admin endpoint
+        assert post("/v1/replicas/1/role",
+                    {"role": "mixed"})["replica"]["phase_role"] == "mixed"
+        try:
+            post("/v1/replicas/0/role", {"role": "bogus"})
+            assert False, "bogus role should 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        assert gw.close(60), "disaggregated fleet failed to drain"

@@ -39,10 +39,18 @@ import sys
 # amortization); speedup_vs_rotation / adapter_hit_rate / tokens_per_sec
 # keep the higher-is-better default, and crossover_k is higher-better too
 # (rotation needs LONGER per-tenant runs before it catches the paged path).
+# disagg leg notes: migration_ms/itl_*_ms ride "ms"; "degradation" marks
+# the ITL-p95 load-doubling factors (flat == 1.0 is the goal, growth is
+# the regression — "ratio" itself stays direction-neutral: the existing
+# ttft_p95_ratio_rotation_over_paged / slot_ratio_at_equal_hbm are
+# higher-better); "pending"/"failed" mark handoff backpressure/losses (a
+# round that parks or fails more handoffs at the same stream regressed);
+# migrations/tokens_per_sec keep the higher-is-better default.
 _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
                  "shed", "evict", "evictions", "evicts", "miss", "misses",
                  "s", "seconds", "loss", "ppl", "perplexity", "spill",
-                 "spills", "dropped", "swaps"}
+                 "spills", "dropped", "swaps", "degradation", "pending",
+                 "failed"}
 
 
 def _lower_better(path):

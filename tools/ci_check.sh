@@ -11,6 +11,7 @@
 #   tools/ci_check.sh --sharded  # tensor-sharded decode + replica-set lane only
 #   tools/ci_check.sh --hierkv   # hierarchical-KV tier lane only
 #   tools/ci_check.sh --multilora # multi-LoRA adapter-serving lane only
+#   tools/ci_check.sh --disagg   # disaggregated prefill/decode lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -123,6 +124,22 @@ multilora_lane() {
     tests/unit/inference/test_kv_cache.py -q -p no:cacheprovider
 }
 
+disagg_lane() {
+  echo "== disaggregated prefill/decode lane =="
+  # phase-role migration guards, run UNFILTERED (the bit-identity matrix
+  # nodeids live in slow_tests.txt to keep tier-1 in budget): migrated
+  # decode BIT-identical to single-replica (tokens AND logits, greedy +
+  # sampled x bf16/int8 KV x radix hit/cold x with/without adapter),
+  # mid-migration cancel frees both ends' slots + the parked store entry,
+  # sick-decode failover re-places the handoff, zero-role fleet identical
+  # to the plain replica path, and the jax.monitoring compile guard: a
+  # warm role/length/sampling/migration mix adds ZERO XLA programs. The
+  # matching perf leg is `python bench.py serving` ("disagg" entry: ITL
+  # p95 flat while offered prefill load doubles vs the mixed fleet).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/serving/test_disagg.py -q -p no:cacheprovider
+}
+
 bench_diff() {
   echo "== bench diff (advisory) =="
   # diff the given fresh bench JSON (or the latest committed round) against
@@ -182,6 +199,10 @@ if [ "${1:-}" = "--multilora" ]; then
   multilora_lane
   exit $?
 fi
+if [ "${1:-}" = "--disagg" ]; then
+  disagg_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -221,7 +242,10 @@ hk_rc=$?
 multilora_lane
 ml_rc=$?
 
+disagg_lane
+dg_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ]
