@@ -98,6 +98,29 @@ class DisaggregationConfig(DeepSpeedConfigModel):
                                      "prompts); 0 migrates everything")
 
 
+class ExpertOffloadConfig(DeepSpeedConfigModel):
+    """Cold-expert host offload (``deepspeed_tpu/moe/expert_store.py``):
+    MoE expert kernels leave the device param tree at engine build and page
+    through per-(layer, expert) device pools — LRU residency, hot-loads
+    through the shared streaming layer, detect-miss-and-replay dispatch —
+    so a model whose experts exceed HBM still decodes through the
+    continuous-batching scheduler. Exact: replayed steps rewrite every KV
+    row the garbage forward wrote, and all-hot paged output is bit-identical
+    to the in-tree path. Scheduler path only (chunked prefill, scan_layers,
+    expert mesh axis 1). See ``benchmarks/SERVING.md`` ("MoE serving")."""
+
+    enabled = ConfigField(default=False)
+    resident_experts = ConfigField(default=0, help="device pages per layer (the "
+                                   "HBM budget knob): 0 = all experts resident "
+                                   "(paging machinery, no memory saving). Must "
+                                   "be >= moe_top_k — a single token's per-layer "
+                                   "demand — and a step whose per-layer routing "
+                                   "demand exceeds it is served by the backoff "
+                                   "ladder (smaller sync / chunk / row groups), "
+                                   "so undersizing costs replays, not "
+                                   "correctness")
+
+
 class MultiLoRAConfig(DeepSpeedConfigModel):
     """Multi-tenant adapter serving (``deepspeed_tpu/adapters/``): paged
     LoRA store + batched mixed-adapter decode. Adapter (A, B) pages live in
@@ -183,6 +206,11 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
         help="multi-tenant adapter serving: paged LoRA store + batched "
         "mixed-adapter decode (deepspeed_tpu/adapters/; see "
         "benchmarks/SERVING.md)")
+    expert_offload = ConfigField(
+        default=ExpertOffloadConfig,
+        help="cold-expert host offload: page MoE expert kernels through "
+        "LRU device pools so experts bigger than HBM still decode "
+        "(deepspeed_tpu/moe/expert_store.py; see benchmarks/SERVING.md)")
     disaggregation = ConfigField(
         default=DisaggregationConfig,
         help="disaggregated prefill/decode: phase-specialized replicas with "

@@ -138,6 +138,22 @@ class InferenceEngine:
                 f"REPLICATED (uneven head shards would cost bit-identity); "
                 f"choose a tensor degree dividing the kv head count to shard")
         overrides["bitwise_tp"] = tp_eff > 1 and heads_divide
+        # expert parallelism (MoE serving): the `expert` mesh axis shards
+        # the expert kernels and the per-expert FFN batch; the combine
+        # all-gathers (pure concat) so ep>1 logits stay bit-identical to
+        # ep=1. A non-dividing expert count falls back to REPLICATED expert
+        # weights — loudly, mirroring the head-divisibility rule above (the
+        # MoE layer skips its expert constraints when E % ep != 0, and the
+        # planner's divisibility validation relaxes the expert rules).
+        ep_eff = self.mesh.shape[dist.EXPERT_AXIS]
+        n_experts = getattr(model.cfg, "num_experts", 0)
+        self._ep_replicated_fallback = (ep_eff > 1 and n_experts > 0
+                                        and n_experts % ep_eff != 0)
+        if self._ep_replicated_fallback:
+            logger.warning(
+                f"init_inference: mesh expert={ep_eff} but num_experts="
+                f"{n_experts} doesn't divide it — serving REPLICATED expert "
+                f"weights (uneven expert shards would cost bit-identity)")
         self._int8_fused_note = None
         if self._int8_weights and hasattr(model.cfg, "int8_weights"):
             overrides["int8_weights"] = True
@@ -173,6 +189,48 @@ class InferenceEngine:
         overrides = {k: v for k, v in overrides.items() if k in known}
         self.module = type(model)(dataclasses.replace(model.cfg, **overrides))
         self.model_config = self.module.cfg
+
+        # fused decode-block gating (satellite of the MoE serving PR): the
+        # per-layer fused kernel has no expert dispatch, so an int8 MoE
+        # config that would otherwise fuse falls back to the per-projection
+        # path — say so LOUDLY (ready line + warning) instead of the old
+        # silent `num_experts == 0` check in _fused_decode_eligible
+        self._fused_decode_note = None
+        if (self._int8_weights and cfg.fused_decode_block
+                and getattr(self.model_config, "num_experts", 0) > 0):
+            self._fused_decode_note = (
+                f"num_experts={self.model_config.num_experts}: the fused "
+                f"per-layer decode kernel has no expert dispatch; serving "
+                f"the per-projection MoE path")
+            logger.warning("init_inference(int8): fused decode-block disabled — "
+                           + self._fused_decode_note)
+
+        # cold-expert host offload (continuous_batching.expert_offload):
+        # expert kernels leave the device tree at materialization and page
+        # through moe/expert_store.py; only the scheduler path can serve
+        self._expert_offload = (cfg.continuous_batching.expert_offload
+                                if cfg.continuous_batching.expert_offload.enabled
+                                else None)
+        self._expert_host = None
+        self._expert_store = None
+        if self._expert_offload is not None:
+            if getattr(self.model_config, "num_experts", 0) <= 0:
+                raise ValueError("continuous_batching.expert_offload requires a "
+                                 "MoE model (num_experts > 0)")
+            if not getattr(self.model_config, "scan_layers", True):
+                raise ValueError(
+                    "expert_offload requires scan_layers (stacked expert "
+                    "kernels); kernel_inject unrolls the layer stack — "
+                    "disable one of the two")
+            if ep_eff > 1:
+                raise ValueError(
+                    f"expert_offload requires expert mesh axis 1 (got {ep_eff}): "
+                    f"pages replicate across the mesh — shard experts OR page "
+                    f"them, not both")
+            if not materialize:
+                raise ValueError("expert_offload is unsupported for shared-params "
+                                 "engines: expert pages are captured at "
+                                 "materialization")
 
         # the replicated fallback hands the planner NO tensor rules at all:
         # every weight replicates, which trivially preserves bit-identity
@@ -228,6 +286,23 @@ class InferenceEngine:
             desc += (f" int8_fused_qkv={'on' if fused else 'off'}"
                      + (f" ({self._int8_fused_note})"
                         if getattr(self, "_int8_fused_note", None) else ""))
+        n_experts = getattr(self.model_config, "num_experts", 0)
+        if n_experts:
+            ep_eff = self.mesh.shape[dist.EXPERT_AXIS]
+            topk = getattr(self.model_config, "moe_top_k", 0)
+            if ep_eff <= 1:
+                moe = "ep=1"
+            elif getattr(self, "_ep_replicated_fallback", False):
+                moe = (f"ep={ep_eff} (REPLICATED experts: num_experts="
+                       f"{n_experts} doesn't divide the expert degree)")
+            else:
+                moe = f"ep={ep_eff} (expert-sharded, all-gather combine)"
+            desc += f" moe[{n_experts}e top{topk}] {moe}"
+            if getattr(self, "_expert_offload", None) is not None:
+                R = int(self._expert_offload.resident_experts) or n_experts
+                desc += f" expert_offload=on ({R}/{n_experts} resident)"
+        if getattr(self, "_fused_decode_note", None):
+            desc += f" fused_decode=off ({self._fused_decode_note})"
         return desc
 
     # ------------------------------------------------------------------ params
@@ -254,9 +329,42 @@ class InferenceEngine:
             params["layers"] = jax.tree_util.tree_map(stack, *layers)
         return params
 
+    def _strip_experts(self, params, cast=True):
+        """Pop the (host) experts subtree for the cold-expert pager: the
+        expert kernels must never land in HBM — the stripped tree places,
+        and the serving MoE path reads pool pages instead of params. With
+        ``cast`` the leaves follow the same floating->compute-dtype rule
+        placement applies, so paged and in-tree kernels are byte-identical;
+        the int8 path passes ``cast=False`` (quantize_params already
+        emitted the final dtypes — int8 kernels, fp32 scales)."""
+        dtype = np.dtype(jnp.dtype(self.model_config.dtype).name)
+        params = dict(params)
+        params["layers"] = dict(params["layers"])
+        moe = params["layers"]["moe"] = dict(params["layers"]["moe"])
+        experts = moe.pop("experts")
+        def conv(x):
+            x = np.asarray(x)
+            if cast and np.issubdtype(x.dtype, np.floating):
+                return x.astype(dtype)
+            return x
+        self._expert_host = {k: conv(v) for k, v in experts.items()}
+        return params
+
     def _materialize_params(self, params):
         if params is None and self._config.checkpoint:
             params = self._load_checkpoint_host(self._config.checkpoint)
+        if params is None and self._expert_offload is not None and not self._int8_weights:
+            # debug/test path: flax init materializes the FULL tree (experts
+            # included) on the default device once before the host pull —
+            # models whose experts genuinely exceed HBM must pass
+            # params/checkpoint instead
+            logger.warning(
+                "init_inference(expert_offload): no checkpoint/params given; "
+                "random init materializes the full expert tree on device ONCE "
+                "before stripping — pass params/checkpoint for models whose "
+                "experts exceed HBM")
+            params = jax.tree_util.tree_map(np.asarray,
+                                            self.module.init_params(jax.random.key(0)))
         if self._int8_weights and params is None:
             logger.warning("init_inference(int8): no checkpoint/params given; quantizing "
                            "random weights")
@@ -271,10 +379,16 @@ class InferenceEngine:
             # reaches HBM (the point of int8 serving is halving those bytes)
             host = jax.tree_util.tree_map(np.asarray, params)
             params = self.module.quantize_params(self._adapt_layout(host, host=True))
+            if self._expert_offload is not None:
+                # no cast: quantize_params already emitted the final leaf
+                # dtypes (int8 kernels, fp32 scales)
+                params = self._strip_experts(params, cast=False)
             shardings = self.planner.shardings(self.planner.master_specs(params))
             with self.mesh:
                 return jax.device_put(params, shardings)
         params = self._adapt_layout(params)
+        if self._expert_offload is not None and params is not None:
+            params = self._strip_experts(jax.tree_util.tree_map(np.asarray, params))
         shardings = self.planner.shardings(self.planner.master_specs(
             params if params is not None else jax.eval_shape(self.module.init_params, jax.random.key(0))))
         dtype = self.model_config.dtype
@@ -359,8 +473,17 @@ class InferenceEngine:
         raise ValueError(f"checkpoint {path} matches neither layer layout: {err}")
 
     # ------------------------------------------------------------------ forward
+    def _check_offload_path(self, what):
+        if getattr(self, "_expert_host", None) is not None:
+            raise ValueError(
+                f"{what} reads expert weights from the param tree, which is "
+                f"host-resident under continuous_batching.expert_offload — "
+                f"serve through the scheduler path (submit() with "
+                f"continuous_batching.enabled, or engine.scheduler())")
+
     def forward(self, input_ids, attention_mask=None):
         """Full-sequence logits (reference ``InferenceEngine.forward`` :560)."""
+        self._check_offload_path("forward()")
         if "fwd" not in self._compiled:
             self._compiled["fwd"] = jax.jit(self.module.apply)
         with self.mesh:
@@ -597,12 +720,36 @@ class InferenceEngine:
             # the first scheduler() call also flips this on)
             if cb.multi_lora.enabled or self._adapter_store is not None:
                 kw["adapter_store"] = self.adapter_store()
+            # cold-expert offload: ONE paged expert store per engine,
+            # ReplicaSet siblings bind it by reference like the weight tree
+            if self._expert_offload is not None:
+                kw["expert_store"] = self.expert_store()
             kw.update(overrides)
             self._scheduler = DecodeScheduler(self, **kw)
         elif overrides:
             raise ValueError("scheduler already built; overrides must be passed on "
                              "the first scheduler() call")
         return self._scheduler
+
+    def expert_store(self):
+        """The engine's :class:`~deepspeed_tpu.moe.expert_store.PagedExpertStore`
+        (cold-expert offload), built lazily from the host expert pages
+        captured at materialization and the
+        ``continuous_batching.expert_offload`` section. One store per
+        engine — replica schedulers bind it by reference, so a page loaded
+        through any replica is resident for all of them."""
+        if self._expert_store is None:
+            if self._expert_host is None:
+                raise ValueError("expert_offload enabled but no host expert pages "
+                                 "were captured at materialization")
+            from ..moe.expert_store import PagedExpertStore
+            eo = self._expert_offload
+            E = self.model_config.num_experts
+            self._expert_store = PagedExpertStore(
+                self._expert_host, self.model_config.num_layers, E,
+                int(eo.resident_experts) or E, telemetry=self.telemetry,
+                mesh=self.mesh)
+        return self._expert_store
 
     def adapter_store(self):
         """The engine's :class:`~deepspeed_tpu.adapters.PagedAdapterStore`
@@ -767,6 +914,7 @@ class InferenceEngine:
         """Dispatch one generate; returns (device buf, trim(host_buf) ->
         per-row new-token arrays). The KV cache returns to the pool
         immediately (device-side refs; execution order serializes reuse)."""
+        self._check_offload_path("the static-batch generate() path")
         rows = [np.asarray(r, np.int32).reshape(-1) for r in input_ids]
         B = len(rows)
         lens = np.array([len(r) for r in rows], np.int32)

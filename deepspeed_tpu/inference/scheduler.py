@@ -105,6 +105,20 @@ structural error) and installs the new tree, ``resume()`` re-opens
 admission. All host bookkeeping on the scheduler thread; zero new XLA
 programs per cycle. See ``benchmarks/RLHF.md``.
 
+**MoE serving**: models with routed experts decode through the SAME step
+programs — gating + per-token capacity-free top-k dispatch run inside the
+compiled step (``moe/sharded_moe.top_k_serving_weights``: no capacity
+buffers, so a request's logits never depend on co-resident slots), expert
+kernels shard over the ``expert`` mesh axis with an all-gather combine
+(ep>1 bit-identical to the ep=1 replicated program, composed freely with
+tp>1), and ``continuous_batching.expert_offload`` pages cold expert
+kernels through per-(layer, expert) LRU device pools
+(``moe/expert_store.py``) with detect-miss-and-replay dispatch + a
+backoff ladder (:meth:`_call_step`) — exact at any residency, compile
+count O(1) in expert count, routing mix, and churn (every reachable
+variant warms at build via :meth:`warm_programs`). See
+``benchmarks/SERVING.md`` ("MoE serving").
+
 Telemetry (PR-1 sink): gauges ``serving/slot_occupancy``,
 ``serving/batch_efficiency``, ``serving/kv_token_utilization``,
 ``serving/prefix_cache_hit_rate``, ``serving/spec_acceptance_rate``,
@@ -193,6 +207,17 @@ def _sample_slot(seed, step, logits, do_sample, temperature, top_k, top_p):
     key = jax.random.fold_in(jax.random.key(seed), step)
     sampled = jax.random.categorical(key, x).astype(jnp.int32)
     return jnp.where(do_sample, sampled, greedy)
+
+
+class _ExpertOverflow(Exception):
+    """A cold-expert dispatch routed more experts into some layer than the
+    resident pool holds — the step cannot run in one dispatch at this
+    shape. Carries the (donated-through) pool so the caller's state stays
+    consistent before it backs off to a smaller step."""
+
+    def __init__(self, pool):
+        super().__init__("per-layer expert demand exceeds resident_experts")
+        self.pool = pool
 
 
 class _Request:
@@ -315,15 +340,17 @@ class DecodeScheduler:
                  collect_logits=False, steps_per_sync=4, prefill_chunk=64,
                  prefix_cache=True, spec_tokens=0, spec_ngram_max=3,
                  spec_ngram_min=1, kv_cache_dtype="auto", compiled_cache=None,
-                 prefix_store=None, restore_min_tokens=0, adapter_store=None):
+                 prefix_store=None, restore_min_tokens=0, adapter_store=None,
+                 expert_store=None):
         self.engine = engine
         # raw constructor args, so a replica set can clone this scheduler's
         # exact configuration for its sibling replicas (normalization —
         # max_len rounding, chunk clamping — re-runs identically).
-        # ``prefix_store`` AND ``adapter_store`` ride along BY REFERENCE:
-        # every replica's tier client binds the same fleet-global host
-        # store / paged adapter pools, which is what makes a prefix (or an
-        # adapter page) computed/loaded on replica A servable on replica B
+        # ``prefix_store``, ``adapter_store`` AND ``expert_store`` ride
+        # along BY REFERENCE: every replica's tier client binds the same
+        # fleet-global host store / paged pools, which is what makes a
+        # prefix (or an adapter/expert page) computed/loaded on replica A
+        # servable on replica B
         self._init_kwargs = dict(
             num_slots=num_slots, max_len=max_len, prefill_bucket=prefill_bucket,
             collect_logits=collect_logits, steps_per_sync=steps_per_sync,
@@ -331,7 +358,7 @@ class DecodeScheduler:
             spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
             spec_ngram_min=spec_ngram_min, kv_cache_dtype=kv_cache_dtype,
             prefix_store=prefix_store, restore_min_tokens=restore_min_tokens,
-            adapter_store=adapter_store)
+            adapter_store=adapter_store, expert_store=expert_store)
         model = engine.module
         cfg = engine._config
         if max_len is None:
@@ -427,6 +454,29 @@ class DecodeScheduler:
             if self.radix is not None:
                 self.radix.adapter_ns = adapter_store.namespace
             adapter_store.add_listener(self._adapter_invalidations.append)
+        # MoE serving: per-token capacity-free dispatch rides the same step
+        # programs; `expert_stats` makes them return per-layer routed-token
+        # counts (the cold-expert residency signal + load-balance telemetry)
+        self._moe = getattr(engine.model_config, "num_experts", 0) > 0
+        self.experts = expert_store
+        if expert_store is not None:
+            if not self._moe:
+                raise ValueError("expert_store on a dense model (num_experts == 0)")
+            if self.prefill_chunk <= 0:
+                raise ValueError(
+                    "cold-expert offload requires chunked prefill "
+                    "(prefill_chunk > 0): the monolithic prefill path has no "
+                    "expert paging plumbing")
+            topk = int(getattr(engine.model_config, "moe_top_k", 1))
+            if expert_store.resident < topk:
+                raise ValueError(
+                    f"expert_offload.resident_experts={expert_store.resident} < "
+                    f"moe_top_k={topk}: a single token routes to top_k experts "
+                    f"per layer, so the backoff ladder could never terminate")
+        self._moe_stats = self._moe and (expert_store is not None
+                                         or engine.telemetry.enabled)
+        self.expert_replays = 0
+        self.expert_dispatch_tokens = 0
         self._prefill = None  # at most one in-flight _PrefillState
         self.queue = collections.deque()
         self.active = {}  # slot -> _Request
@@ -445,15 +495,17 @@ class DecodeScheduler:
         # the same shape share ONE compiled program set — replica count adds
         # zero XLA programs; jit's own shape cache handles any shape skew)
         self._compiled = {} if compiled_cache is None else compiled_cache
-        # effective tensor parallelism: with tp>1 the step programs pin the
-        # pool's OUTPUT sharding to the layout _init_cache materialized
-        # (head-axis shard over `tensor`) — leaving it to propagation lets
-        # GSPMD re-layout the donated pool between program variants (e.g.
-        # slot axis over `data`), churning reshards across the step mix. At
-        # tp=1 nothing is pinned: the programs are byte-identical to the
-        # unsharded scheduler's.
+        # effective tensor/expert parallelism: with tp>1 (or an expert axis
+        # live for MoE serving) the step programs pin the pool's OUTPUT
+        # sharding to the layout _init_cache materialized (head-axis shard
+        # over `tensor`, replicated elsewhere) — leaving it to propagation
+        # lets GSPMD re-layout the donated pool between program variants
+        # (e.g. slot axis over `data`/`expert`), churning reshards across
+        # the step mix. At tp=ep=1 nothing is pinned: the programs are
+        # byte-identical to the unsharded scheduler's.
         self.tp_size = int(engine.mesh.shape[dist.TENSOR_AXIS])
-        if self.tp_size > 1:
+        self.ep_size = int(engine.mesh.shape[dist.EXPERT_AXIS])
+        if self.tp_size > 1 or self.ep_size > 1:
             from jax.sharding import NamedSharding, PartitionSpec
             self._pool_sharding = jax.tree_util.tree_map(
                 lambda leaf: leaf.sharding, self.cache.pool)
@@ -461,6 +513,9 @@ class DecodeScheduler:
         else:
             self._pool_sharding = None
             self._host_sharding = None
+        # sampling logits replicate before the draw under ANY live shard
+        # axis (jax.random bit-gen is not sharding-invariant)
+        self._shard_deg = max(self.tp_size, self.ep_size)
         self._rid = 0
         self._steps = 0
         # weight-swap protocol (RLHF hybrid engine): pause gates ADMISSION
@@ -482,6 +537,11 @@ class DecodeScheduler:
             self.telemetry.gauges([
                 ("serving/kv_bytes_per_token", self.cache.bytes_per_token(), None),
                 ("serving/kv_cache_capacity_bytes", self.cache.capacity_bytes(), None)])
+        if self.experts is not None:
+            # cold-expert serving warms EVERY variant the replay/backoff
+            # ladder can reach, at build — before any gateway recompile
+            # watch arms — so residency churn never compiles mid-stream
+            self.warm_programs()
 
     # ------------------------------------------------------------------ API
     def submit(self, prompt, max_new_tokens=64, eos_token_id=None, do_sample=False,
@@ -619,6 +679,12 @@ class DecodeScheduler:
         structure/shapes/dtypes (same model, new values) — that is what
         keeps the swap recompile-free; ``version`` is the publisher's tag
         for telemetry/bookkeeping."""
+        if self.experts is not None:
+            raise ValueError(
+                "swap_weights under continuous_batching.expert_offload is "
+                "unsupported: the expert kernels live in the paged store, "
+                "not the param tree, so a tree swap would serve mixed "
+                "weights — rebuild the engine to change MoE weights")
         if self.active or self._prefill is not None:
             raise ValueError(
                 f"swap_weights with {len(self.active)} active slots"
@@ -1272,6 +1338,257 @@ class DecodeScheduler:
                 n_delivered += 1
         return n_delivered
 
+    def _call_step(self, fn, args, lora):
+        """Dispatch ONE step program, owning the MoE serving plumbing:
+
+        - dense models (or MoE with telemetry off and no offload): a plain
+          dispatch, byte-identical to the pre-MoE scheduler;
+        - MoE with stats: the program's trailing per-layer expert-counts
+          output is fetched, recorded, and STRIPPED, so callers unpack the
+          same (pool, tokens[, logits]) shape either way;
+        - cold-expert offload: dispatch against a consistent residency
+          snapshot, diff the routed experts against it, and on a miss
+          hot-load the wanted pages and RE-DISPATCH the same program with
+          the same inputs (the replay rewrites every KV row the garbage
+          forward wrote — results are exact; pools are immutable arrays,
+          so a sibling replica's churn can't corrupt this dispatch).
+
+        Raises :class:`_ExpertOverflow` (carrying the donated-through pool)
+        when a layer's single-step routing demand exceeds the resident
+        pool — the caller backs off to a smaller step.
+        """
+        extra = (lora, ) if lora is not None else ()
+        eng = self.engine
+        if not self._moe_stats:
+            with eng.mesh:
+                return fn(*(args + extra))
+        if self.experts is None:
+            with eng.mesh:
+                out = fn(*(args + extra))
+            self._record_expert_stats(np.asarray(jax.device_get(out[-1])))
+            return out[:-1]
+        replays = 0
+        # hard bound on the replay loop: each round loads at least one page
+        # on this replica, so L*E rounds can only be exceeded by pathological
+        # cross-replica eviction thrash — fail loudly instead of spinning
+        max_replays = 2 * self.experts.num_layers * self.experts.num_experts + 8
+        while True:
+            emap, pools, resident = self.experts.dispatch_operands()
+            with eng.mesh:
+                out = fn(*(args + extra + ((emap, pools), )))
+            counts = np.asarray(jax.device_get(out[-1]))
+            used = counts > 0
+            if not self.experts.missing(used, resident).any():
+                self.experts.touch(used)
+                self._record_expert_stats(counts)
+                return out[:-1]
+            # the donated pool moved forward; replay reads the new buffers
+            args = args[:1] + (out[0], ) + args[2:]
+            if not self.experts.ensure(used):
+                raise _ExpertOverflow(out[0])
+            replays += 1
+            self.expert_replays += 1
+            if self.telemetry.enabled:
+                self.telemetry.counter("serving/expert_replays")
+            if replays > max_replays:
+                raise RuntimeError(
+                    f"cold-expert replay did not converge after {replays} "
+                    f"re-dispatches (cross-replica eviction thrash?); raise "
+                    f"expert_offload.resident_experts")
+
+    def _record_expert_stats(self, counts):
+        """Routing telemetry from one successful dispatch's (L, E) counts:
+        total token->expert assignments and the per-step load-balance gauge
+        (1.0 = tokens spread evenly; 1/E = everything on one expert)."""
+        total = int(counts.sum())
+        self.expert_dispatch_tokens += total
+        tel = self.telemetry
+        if not tel.enabled or total == 0:
+            return
+        tel.counter("serving/expert_dispatch_tokens", total)
+        mx = counts.max(axis=1)
+        tot = counts.sum(axis=1)
+        live = mx > 0
+        if live.any():
+            E = counts.shape[1]
+            balance = float(np.mean(tot[live] / (E * mx[live])))
+            tel.gauge("serving/expert_load_balance", balance)
+
+    # ------------------------------------------------------------------ offload backoff
+    def _decode_backoff(self, live):
+        """Cold-expert pressure path: advance live rows ONE token each, in
+        overflow-safe row groups through the (1-step, width-1) program —
+        group demand shrinks with group size, and a single row needs at
+        most ``top_k`` experts per layer, which the store validated fits.
+        Excluded rows keep span 0 (no KV write, nothing delivered) and
+        simply advance in a later group/sync."""
+        eng = self.engine
+        N = self.cache.num_slots
+        pending = list(live)
+        delivered = 0
+        while pending:
+            group = list(pending)
+            while True:
+                ids = np.zeros((N, 1), np.int32)
+                spans = np.zeros(N, np.int32)
+                lens = np.zeros(N, np.int32)
+                for slot, req in group:
+                    ids[slot, 0] = req.out[-1]
+                    spans[slot] = 1
+                    lens[slot] = self.cache.lengths[slot]
+                (seeds, steps, flags, temps, topks, topps, sampling,
+                 collect) = self._gather_sampling(group)
+                lora = self._adapter_arg(group)
+                fn = self._fused_fn(sampling, collect, 1, 1, lora=lora is not None)
+                args = (eng.params, self.cache.pool, jnp.asarray(ids),
+                        jnp.asarray(lens), jnp.asarray(spans),
+                        jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                        jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+                try:
+                    out = self._call_step(fn, args, lora)
+                    break
+                except _ExpertOverflow as e:
+                    self.cache.pool = e.pool
+                    if len(group) == 1:
+                        raise RuntimeError(
+                            "expert_offload: a single decode row exceeded "
+                            "resident_experts — impossible when "
+                            "resident_experts >= moe_top_k (validated at "
+                            "build); this is a bug")
+                    group = group[:(len(group) + 1) // 2]
+            toks_k, logits_k = self._fetch_block(out, collect, 1)
+            delivered += self._deliver_block(group, toks_k, logits_k, 1)
+            done = {slot for slot, _ in group}
+            pending = [(s, r) for (s, r) in pending if s not in done]
+        return delivered
+
+    def _fused_backoff(self, pf, live):
+        """Cold-expert pressure during a fused chunk sync: feed the prefill
+        row ALONE in shrinking chunk pieces (a piece of ``t`` prompt tokens
+        demands at most ``t * top_k`` experts per layer; one token always
+        fits), then advance the decode rows through the decode backoff so a
+        long constrained prefill can't starve them. Chunk boundaries are
+        preserved upward — pieces only subdivide the chunk the normal path
+        would have fed — so the KV this path writes is byte-identical to
+        the unconstrained sync's."""
+        eng = self.engine
+        preq = pf.req
+        N, C = self.cache.num_slots, self.prefill_chunk
+        ps = preq.slot
+        L = preq.prompt.size
+        delivered = 0
+        chunk_end = min(pf.pos + C, L)
+        while pf.pos < chunk_end:
+            take = chunk_end - pf.pos
+            while True:
+                ids = np.zeros((N, C), np.int32)
+                spans = np.zeros(N, np.int32)
+                lens = np.zeros(N, np.int32)
+                ids[ps, :take] = preq.prompt[pf.pos:pf.pos + take]
+                spans[ps] = take
+                lens[ps] = self.cache.lengths[ps]
+                seeds = np.zeros(N, np.uint32)
+                steps = np.zeros(N, np.int32)
+                flags = np.zeros(N, bool)
+                temps = np.ones(N, np.float32)
+                topks = np.zeros(N, np.int32)
+                topps = np.ones(N, np.float32)
+                seeds[ps] = preq.seed
+                flags[ps] = preq.do_sample
+                temps[ps] = preq.temperature
+                topks[ps] = preq.top_k
+                topps[ps] = preq.top_p
+                lora = self._adapter_arg([(ps, preq)])
+                fn = self._fused_fn(preq.do_sample, preq.collect_logits, 1, C,
+                                    lora=lora is not None)
+                args = (eng.params, self.cache.pool, jnp.asarray(ids),
+                        jnp.asarray(lens), jnp.asarray(spans),
+                        jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
+                        jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+                try:
+                    out = self._call_step(fn, args, lora)
+                    break
+                except _ExpertOverflow as e:
+                    self.cache.pool = e.pool
+                    if take == 1:
+                        raise RuntimeError(
+                            "expert_offload: a single prompt token exceeded "
+                            "resident_experts — impossible when "
+                            "resident_experts >= moe_top_k (validated at "
+                            "build); this is a bug")
+                    take = (take + 1) // 2
+            toks_k, logits_k = self._fetch_block(out, preq.collect_logits, 1)
+            pf.pos += take
+            if pf.pos >= L:
+                self.cache.lengths[ps] = L  # single-step: no substep rows
+                self._finish_prefill(
+                    preq, int(toks_k[0, ps]),
+                    logits_k[0, ps] if (preq.collect_logits and logits_k is not None)
+                    else None)
+                delivered += 1
+                if (not preq.done and self.migrate_hook is not None
+                        and self.migrate_hook(self, preq)):
+                    pass  # migrated out (see _fused_chunk_step)
+            else:
+                self.cache.lengths[ps] = pf.pos
+        if live:
+            delivered += self._decode_backoff(live)
+        return delivered, 1
+
+    def warm_programs(self):
+        """Dispatch every step-program variant the cold-expert replay and
+        backoff ladder can reach — the (K, chunk) primary, its (1, chunk) /
+        (K, 1) / (1, 1) fallbacks, greedy AND sampled, plus the speculative
+        verify when drafting is on — against the live pool with ALL spans
+        zero: no KV row is written, nothing is delivered, so the warm is
+        invisible to traffic. Runs at build (before any gateway recompile
+        watch arms), which is what makes residency churn recompile-free
+        mid-stream. Requests overriding ``collect_logits`` per-call still
+        compile their variant on first use."""
+        N = self.cache.num_slots
+        C = max(1, self.prefill_chunk)
+        K = self.steps_per_sync
+        zeros = np.zeros(N, np.int32)
+        # multi-LoRA composes with offload: warm the lora program variants
+        # too, with every row on the reserved all-zero slot-0 pages (the
+        # backoff ladder otherwise compiles them on its first
+        # adapter-bearing overflow, after the recompile watch armed)
+        lora_args = (None, )
+        if self.adapters is not None:
+            pools = self.adapters.device_pools()
+            lora_args += (tuple((jnp.asarray(np.zeros(N, np.int32)), pools[b])
+                                for b in self.adapters.bucket_keys()), )
+
+        def dispatch(fn, width, lora):
+            args = (self.engine.params, self.cache.pool,
+                    jnp.asarray(np.zeros((N, width), np.int32)),
+                    jnp.asarray(zeros), jnp.asarray(zeros),
+                    jnp.asarray(np.zeros(N, np.uint32)), jnp.asarray(zeros),
+                    jnp.asarray(np.zeros(N, bool)),
+                    jnp.asarray(np.ones(N, np.float32)), jnp.asarray(zeros),
+                    jnp.asarray(np.ones(N, np.float32)))
+            out = self._call_step(fn, args, lora)
+            self.cache.pool = out[0]
+
+        for sampling in (False, True):
+            for lora in lora_args:
+                for ksteps, width in sorted({(K, C), (1, C), (K, 1), (1, 1)}):
+                    dispatch(self._fused_fn(sampling, self.collect_logits, ksteps,
+                                            width, lora=lora is not None),
+                             width, lora)
+                if self.drafter is not None:
+                    dispatch(self._spec_fn(sampling, self.collect_logits,
+                                           self._spec_width,
+                                           lora=lora is not None),
+                             self._spec_width, lora)
+        if self.radix is not None:
+            # the radix slot-copy program (src == dst is the identity copy,
+            # safe against any pool state)
+            with self.engine.mesh:
+                self.cache.pool = self._copy_fn()(
+                    self.cache.pool, jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32))
+
     def _decode_step(self):
         """A pure decode sync: the fused program at chunk width 1 (every
         live row span 1, no prefill row) — ONE on-device step body serves
@@ -1298,8 +1615,13 @@ class DecodeScheduler:
                 jnp.asarray(lens), jnp.asarray(spans),
                 jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
-        with eng.mesh:
-            out = fn(*(args + ((lora, ) if lora is not None else ())))
+        try:
+            out = self._call_step(fn, args, lora)
+        except _ExpertOverflow as e:
+            # a K-step sync's routing union outgrew the expert pool: advance
+            # one token per row in overflow-safe groups instead
+            self.cache.pool = e.pool
+            return self._decode_backoff(live), 1
         toks_k, logits_k = self._fetch_block(out, collect, K)
         return self._deliver_block(live, toks_k, logits_k, K), K
 
@@ -1354,8 +1676,13 @@ class DecodeScheduler:
                 jnp.asarray(lens), jnp.asarray(spans),
                 jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
-        with eng.mesh:
-            out = fn(*(args + ((lora, ) if lora is not None else ())))
+        try:
+            out = self._call_step(fn, args, lora)
+        except _ExpertOverflow as e:
+            # speculation is opportunistic — skip it for this sync and
+            # advance one exact token per row (bit-identical either way)
+            self.cache.pool = e.pool
+            return self._decode_backoff(live), 1
         if collect:
             self.cache.pool, toks_k, logits_k = out
             logits_k = np.asarray(jax.device_get(logits_k), np.float32)  # (W, N, V)
@@ -1464,8 +1791,13 @@ class DecodeScheduler:
                 jnp.asarray(lens), jnp.asarray(spans),
                 jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
-        with eng.mesh:
-            out = fn(*(args + ((lora, ) if lora is not None else ())))
+        try:
+            out = self._call_step(fn, args, lora)
+        except _ExpertOverflow as e:
+            # the chunk's routing demand outgrew the expert pool: feed the
+            # prefill alone in shrinking pieces, then advance decode rows
+            self.cache.pool = e.pool
+            return self._fused_backoff(pf, live)
         toks_k, logits_k = self._fetch_block(out, collect, K)
         if tel.enabled:
             # the stall co-resident decode rows eat while a prefill chunk
@@ -1587,7 +1919,9 @@ class DecodeScheduler:
             model = self.engine.module
             K = ksteps
             V = model.cfg.vocab_size
-            tp = self.tp_size
+            tp = self._shard_deg
+            stats = self._moe_stats
+            offload = self.experts is not None
 
             def sample(l2, seeds, steps, flags, temps, topks, topps):
                 if sampling:
@@ -1596,17 +1930,36 @@ class DecodeScheduler:
                 return jnp.argmax(l2, axis=-1).astype(jnp.int32)
 
             def fused(params, pool, ids, lengths, spans, seeds, steps, flags,
-                      temps, topks, topps, *lora_arg):
+                      temps, topks, topps, *extra):
+                # trailing args in fixed order: adapter operands (when the
+                # `lora` key flag is set), then cold-expert operands (when
+                # the scheduler carries an expert store — fixed per build)
+                i = 0
                 lops = None
-                if lora_arg:
+                if lora:
                     from ..adapters.batched_lora import gather_rows
-                    lops = gather_rows(lora_arg[0])
+                    lops = gather_rows(extra[i])
+                    i += 1
+                eops = extra[i] if offload else None
                 C = ids.shape[1]
                 N = ids.shape[0]
                 pos = lengths[:, None] + jnp.arange(C)[None, :]
-                logits, pool = model.apply_with_cache(
-                    params, ids, pool, 0, position_ids=pos, write_index=lengths,
-                    q_spans=spans, lora_ops=lops)
+
+                def forward(pool, tok_block, pos_block, widx, sp):
+                    """One in-sync forward; returns (logits, pool, counts)
+                    with counts None when stats are off (the non-stats
+                    trace is unchanged from the pre-MoE program)."""
+                    if stats:
+                        return model.apply_with_cache(
+                            params, tok_block, pool, 0, position_ids=pos_block,
+                            write_index=widx, q_spans=sp, lora_ops=lops,
+                            expert_ops=eops, expert_stats=True)
+                    lg, pl = model.apply_with_cache(
+                        params, tok_block, pool, 0, position_ids=pos_block,
+                        write_index=widx, q_spans=sp, lora_ops=lops)
+                    return lg, pl, None
+
+                logits, pool, total_cnt = forward(pool, ids, pos, lengths, spans)
                 # each row's LAST live column: decode rows column 0, the
                 # prefill row its chunk fill - 1 (dead rows clamp to 0 —
                 # their token is garbage the host never reads)
@@ -1620,33 +1973,38 @@ class DecodeScheduler:
                 if collect:
                     out_logits = out_logits.at[0].set(l0)
                 if K == 1:
-                    if collect:
-                        return pool, out_toks, out_logits
-                    return pool, out_toks
+                    out = (pool, out_toks) + ((out_logits, ) if collect else ())
+                    return out + ((total_cnt, ) if stats else ())
                 base = lengths + jnp.maximum(spans, 1) - 1  # per-row write head - 1
                 live01 = jnp.minimum(spans, 1)  # substep spans: drop dead rows' writes
 
                 def body(k, carry):
-                    pool, tok, out_toks, out_logits = carry
-                    logits, pool = model.apply_with_cache(
-                        params, tok[:, None], pool, 0,
-                        position_ids=(base + k)[:, None], write_index=base + k,
-                        q_spans=live01, lora_ops=lops)
+                    if stats:
+                        pool, tok, out_toks, out_logits, total_cnt = carry
+                    else:
+                        pool, tok, out_toks, out_logits = carry
+                    logits, pool, cnt = forward(pool, tok[:, None],
+                                                (base + k)[:, None], base + k,
+                                                live01)
                     l2 = _replicate_logits(logits[:, 0].astype(jnp.float32), tp)
                     nxt = sample(l2, seeds, steps + k, flags, temps, topks, topps)
                     out_toks = jax.lax.dynamic_update_index_in_dim(out_toks, nxt, k, 0)
                     if collect:
                         out_logits = jax.lax.dynamic_update_index_in_dim(
                             out_logits, l2, k, 0)
+                    if stats:
+                        return pool, nxt, out_toks, out_logits, total_cnt + cnt
                     return pool, nxt, out_toks, out_logits
 
-                pool, _, out_toks, out_logits = jax.lax.fori_loop(
-                    1, K, body, (pool, tok0, out_toks, out_logits))
-                if collect:
-                    return pool, out_toks, out_logits
-                return pool, out_toks
+                carry = (pool, tok0, out_toks, out_logits)
+                carry += (total_cnt, ) if stats else ()
+                carry = jax.lax.fori_loop(1, K, body, carry)
+                pool, _, out_toks, out_logits = carry[:4]
+                out = (pool, out_toks) + ((out_logits, ) if collect else ())
+                return out + ((carry[4], ) if stats else ())
 
-            return self._jit_step(fused, 2 if collect else 1, (1, ))
+            return self._jit_step(fused, (1 if collect else 0)
+                                  + (1 if self._moe_stats else 0) + 1, (1, ))
 
         return self._program(key, build)
 
@@ -1672,7 +2030,9 @@ class DecodeScheduler:
 
         def build():
             model = self.engine.module
-            tp = self.tp_size
+            tp = self._shard_deg
+            stats = self._moe_stats
+            offload = self.experts is not None
 
             def sample(l2, seeds, steps, flags, temps, topks, topps):
                 if sampling:
@@ -1681,24 +2041,33 @@ class DecodeScheduler:
                 return jnp.argmax(l2, axis=-1).astype(jnp.int32)
 
             def spec(params, pool, ids, lengths, spans, seeds, steps, flags,
-                     temps, topks, topps, *lora_arg):
+                     temps, topks, topps, *extra):
+                i = 0
                 lops = None
-                if lora_arg:
+                if lora:
                     from ..adapters.batched_lora import gather_rows
-                    lops = gather_rows(lora_arg[0])
+                    lops = gather_rows(extra[i])
+                    i += 1
+                eops = extra[i] if offload else None
                 C = ids.shape[1]
                 pos = lengths[:, None] + jnp.arange(C)[None, :]
-                logits, pool = model.apply_with_cache(
-                    params, ids, pool, 0, position_ids=pos, write_index=lengths,
-                    q_spans=spans, lora_ops=lops)
+                if stats:
+                    logits, pool, cnt = model.apply_with_cache(
+                        params, ids, pool, 0, position_ids=pos,
+                        write_index=lengths, q_spans=spans, lora_ops=lops,
+                        expert_ops=eops, expert_stats=True)
+                else:
+                    logits, pool = model.apply_with_cache(
+                        params, ids, pool, 0, position_ids=pos,
+                        write_index=lengths, q_spans=spans, lora_ops=lops)
                 l = _replicate_logits(logits.astype(jnp.float32), tp)  # (N, C, V)
                 toks = jnp.stack([sample(l[:, j], seeds, steps + j, flags,
                                          temps, topks, topps) for j in range(C)])
-                if collect:
-                    return pool, toks, l.swapaxes(0, 1)
-                return pool, toks
+                out = (pool, toks) + ((l.swapaxes(0, 1), ) if collect else ())
+                return out + ((cnt, ) if stats else ())
 
-            return self._jit_step(spec, 2 if collect else 1, (1, ))
+            return self._jit_step(spec, (1 if collect else 0)
+                                  + (1 if self._moe_stats else 0) + 1, (1, ))
 
         return self._program(key, build)
 
@@ -1717,7 +2086,7 @@ class DecodeScheduler:
 
         def build():
             model = self.engine.module
-            tp = self.tp_size
+            tp = self._shard_deg
 
             def prefill(params, pool, ids, length, slot, seed, do_sample,
                         temperature, top_k, top_p):

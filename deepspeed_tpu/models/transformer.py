@@ -999,7 +999,7 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, deterministic=True, kv_cache=None,
                  cache_index=None, position_ids=None, write_index=None, q_spans=None,
-                 lora_ops=None):
+                 lora_ops=None, expert_ops=None):
         cfg = self.cfg
         drop = nn.Dropout(rate=cfg.dropout) if cfg.dropout > 0 else None
         if cfg.act_quant_bits:  # QAT activation fake-quant (compression)
@@ -1022,8 +1022,16 @@ class Block(nn.Module):
             ff_in = make_norm(cfg, name="mlp_norm")(x)
         if cfg.num_experts > 0:
             from ..moe.layer import MoE
-            ff, aux = MoE(cfg, name="moe")(ff_in)
-            self.sow("intermediates", "moe_aux_loss", aux)
+            if kv_cache is not None:
+                # KV-cache (serving/decode) forward: deterministic per-token
+                # capacity-free dispatch, NO aux-loss sow — the gating
+                # intermediates are training-only, and collecting them here
+                # would force mutable step programs + per-step host traffic
+                ff = MoE(cfg, name="moe")(ff_in, serving=True, q_spans=q_spans,
+                                          expert_ops=expert_ops)
+            else:
+                ff, aux = MoE(cfg, name="moe")(ff_in)
+                self.sow("intermediates", "moe_aux_loss", aux)
         else:
             ff = MLP(cfg, name="mlp")(ff_in, lora_ops)
         if drop is not None:
@@ -1040,7 +1048,7 @@ class CausalLM(nn.Module):
     def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
                  cache_index=None, position_ids=None, return_hidden=False,
                  pld_theta=None, pld_rng=None, ltd_keep=None, ltd_layers=(), ltd_rng=None,
-                 write_index=None, q_spans=None, lora_ops=None):
+                 write_index=None, q_spans=None, lora_ops=None, expert_ops=None):
         """``kv_cache``: optional per-layer (k, v) with leading layer dim —
         shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
         stack. Returns logits, or (logits, new_kv_cache) when caching, or the
@@ -1102,7 +1110,7 @@ class CausalLM(nn.Module):
         new_cache = None
         if cfg.scan_layers:
             def scan_body(mdl, carry, xs):
-                layer_cache, layer_idx, layer_lora = xs
+                layer_cache, layer_idx, layer_lora, layer_experts = xs
                 if ltd_active:
                     # scan shares one program across layers, so LTD applies to
                     # every scanned layer (per-layer opt-out needs
@@ -1114,17 +1122,17 @@ class CausalLM(nn.Module):
                 else:
                     y, c = mdl(carry, sin, cos, attn_mask, deterministic,
                                layer_cache, cache_index, position_ids, write_index,
-                               q_spans, layer_lora)
+                               q_spans, layer_lora, layer_experts)
                 return apply_pld(y, carry, layer_idx), c
 
             x, new_cache = nn.scan(
                 scan_body,
-                variable_axes={"params": 0, "intermediates": 0},
+                variable_axes={"params": 0, "intermediates": 0, "expert_stats": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.num_layers,
                 metadata_params={"partition_name": "layers"},
             )(block(cfg, name="layers"), x,
-              (kv_cache, jnp.arange(cfg.num_layers), lora_ops))
+              (kv_cache, jnp.arange(cfg.num_layers), lora_ops, expert_ops))
         else:
             caches = []
             for i in range(cfg.num_layers):
@@ -1135,6 +1143,8 @@ class CausalLM(nn.Module):
                                else tuple(comp[i] for comp in kv_cache))
                 layer_lora = (None if lora_ops is None else
                               jax.tree_util.tree_map(lambda leaf: leaf[i], lora_ops))
+                layer_experts = (None if expert_ops is None else
+                                 jax.tree_util.tree_map(lambda leaf: leaf[i], expert_ops))
                 blk = block(cfg, layer_idx=i, name=f"layer_{i}")
                 if ltd_active and i in ltd_layers:
                     y, c = ltd_apply(
@@ -1144,7 +1154,7 @@ class CausalLM(nn.Module):
                 else:
                     y, c = blk(x, sin, cos, attn_mask, deterministic,
                                layer_cache, cache_index, position_ids, write_index,
-                               q_spans, layer_lora)
+                               q_spans, layer_lora, layer_experts)
                 x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
@@ -1366,7 +1376,7 @@ class CausalLMModel:
 
     def apply_with_cache(self, params, input_ids, kv_cache, cache_index, cache_mask=None,
                          position_ids=None, write_index=None, q_spans=None,
-                         lora_ops=None):
+                         lora_ops=None, expert_ops=None, expert_stats=False):
         """Forward writing into (and attending over) the KV cache. Returns
         (logits, new_cache). ``cache_mask``: (B, S) attendable cache slots.
         ``write_index``: optional (B,) per-row cache positions (slot-pool
@@ -1377,16 +1387,43 @@ class CausalLMModel:
         LAYER AXIS — tuple of per-rank-bucket dicts ``site -> (A (L, B,
         in..., r), B (L, B, r, out...))`` (multi-tenant adapter serving;
         see :class:`Attention`); scanned models scan the layer axis
-        alongside the cache, unrolled models index it per layer."""
-        mutable = ["intermediates"] if self.cfg.num_experts > 0 else False
+        alongside the cache, unrolled models index it per layer.
+
+        MoE models route through the SERVING dispatch here (per-token
+        capacity-free top-k, :meth:`~deepspeed_tpu.moe.layer.MoE._serving`)
+        and NEVER collect the training-only gating intermediates — the step
+        stays donation-friendly with no mutable-collection host traffic.
+        ``expert_ops``: optional cold-expert paging operands with a leading
+        layer axis ``(expert->page map (L, E), pools {leaf: (L, R, ...)})``.
+        ``expert_stats=True`` additionally returns per-layer routed-token
+        counts ``(L, E) int32`` (the scheduler's residency/telemetry
+        signal) as a third output."""
+        mutable = ["expert_stats"] if expert_stats else False
         out = self.module.apply({"params": params}, input_ids, cache_mask, True, kv_cache,
                                 cache_index, position_ids, write_index=write_index,
-                                q_spans=q_spans, lora_ops=lora_ops, mutable=mutable)
-        if mutable:
-            (logits, new_cache), _ = out
-        else:
+                                q_spans=q_spans, lora_ops=lora_ops,
+                                expert_ops=expert_ops, mutable=mutable)
+        if not expert_stats:
             logits, new_cache = out
-        return logits, new_cache
+            return logits, new_cache
+        (logits, new_cache), mut = out
+        E = self.cfg.num_experts
+        stats = mut.get("expert_stats", {})
+        if self.cfg.scan_layers:
+            # one stacked (L, E) leaf under the scanned "layers" scope
+            leaves = jax.tree_util.tree_leaves(stats)
+            counts = jnp.concatenate([leaf.reshape(-1, E) for leaf in leaves],
+                                     axis=0)
+        else:
+            # unrolled: one (E,) leaf per "layer_<i>" scope — walk NUMERIC
+            # layer order explicitly (pytree flattening sorts keys
+            # lexicographically, which misorders layer_10 vs layer_2)
+            rows = []
+            for i in range(self.cfg.num_layers):
+                rows.extend(jax.tree_util.tree_leaves(stats.get(f"layer_{i}", {})))
+            counts = jnp.concatenate([leaf.reshape(-1, E) for leaf in rows],
+                                     axis=0)
+        return logits, new_cache, counts
 
     def _apply_kwargs(self, rng):
         """Dropout is active iff a step rng is provided and rate > 0."""
@@ -1837,6 +1874,12 @@ class CausalLMModel:
                 rules += [
                     (r"(q|k|v|gate|up)_proj/kernel_q$", (None, None, t)),  # (L, K, N)
                     (r"(q|k|v|gate|up)_proj/kernel_scale$", (None, None, t)),  # (L, G, N)
+                    # int8 expert kernels (L, E, K, N): expert dim over e;
+                    # gate/up columns over t (column-parallel, scales match);
+                    # down stays t-replicated under bitwise (row-parallel)
+                    (r"experts/(gate|up)_proj_(q|scale)$", (None, e, None, t)),
+                    (r"experts/down_proj_(q|scale)$",
+                     (None, e, None, None) if bitwise else (None, e, t, None)),
                     (r"logits_q$", (None, t)),
                     (r"logits_scale$", (None, t)),
                 ]
@@ -1856,6 +1899,9 @@ class CausalLMModel:
             rules += [
                 (r"(q|k|v|gate|up)_proj/kernel_q$", (None, t)),  # (K, N)
                 (r"(q|k|v|gate|up)_proj/kernel_scale$", (None, t)),  # (G, N)
+                (r"experts/(gate|up)_proj_(q|scale)$", (e, None, t)),  # (E, K, N)
+                (r"experts/down_proj_(q|scale)$",
+                 (e, None, None) if bitwise else (e, t, None)),
                 (r"logits_q$", (None, t)),
                 (r"logits_scale$", (None, t)),
             ]

@@ -1,0 +1,254 @@
+"""Paged cold-expert store: per-(layer, expert) device pages with LRU
+hot-load/evict for big-MoE-on-small-mesh serving.
+
+The PR-12 adapter-store pattern applied to EXPERT WEIGHTS: a model whose
+experts exceed HBM keeps every expert's kernels host-resident and pages
+them through fixed-shape device pools — one pool per expert-kernel leaf,
+shaped ``(L, R, ...)`` for ``R = resident_experts`` pages per layer — while
+the step programs gather each layer's page by a runtime ``expert -> slot``
+map. Pool shapes are fixed by config, the map and which experts are
+resident are pure runtime data, so load/evict churn adds ZERO XLA programs
+after the store warms (the one slot-write program compiles at build).
+
+The twist vs adapters: WHICH experts a step needs is decided by per-token
+routing INSIDE the compiled step, so the host cannot pin the exact set
+before dispatch. The protocol (driven by
+:meth:`~deepspeed_tpu.inference.scheduler.DecodeScheduler._call_step`):
+
+1. dispatch with a residency SNAPSHOT (``dispatch_operands``) — pools are
+   immutable jax arrays, so a concurrent hot-load/evict by a sibling
+   replica can never corrupt an in-flight dispatch; it only produces new
+   pool arrays for FUTURE dispatches;
+2. the program returns per-layer routed-token counts; the host diffs them
+   against the snapshot's residency (``missing``);
+3. on a miss, ``ensure`` hot-loads the wanted cold pages — a fenced
+   host→device put through the shared ``memory/streams.py`` layer plus the
+   compiled slot-write — evicting per-layer LRU pages NOT wanted by this
+   dispatch (the wanted set is pinned for the load pass), and the SAME
+   program re-dispatches with the same inputs. Every KV row the garbage
+   forward wrote is rewritten by the replay, so results are exact.
+
+A layer whose single-step routing demand exceeds ``R`` cannot be served in
+one dispatch — ``ensure`` returns False and the scheduler backs off
+(smaller sync, smaller chunk, fewer rows) until demand fits; a single
+token's demand is at most ``top_k``, which the scheduler validates fits at
+build, so the ladder always terminates.
+
+Telemetry (PR-1/8 sink): counters ``serving/expert_loads``,
+``serving/expert_evicts``; histogram ``serving/expert_load_ms``; gauge
+``serving/experts_resident`` (resident fraction of the full L x E page
+set). The scheduler adds the routing-side series (``serving/expert_*``
+dispatch counters, replay counter, load-balance gauge).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class PagedExpertStore:
+    """Paged per-(layer, expert) expert-kernel store (see module docstring).
+
+    ``host_leaves``: the experts subtree popped from the HOST param tree
+    before device placement — ``{leaf_name: np (L, E, ...)}`` in the
+    param-tree naming (fp ``{gate,up,down}_proj``, int8 ``*_q``/``*_scale``,
+    optional ``*_bias``); leaf dtypes are served as-is, so they must already
+    carry the compute-layout dtypes placement would have given them.
+    ``resident_experts``: device pages per layer (``R``); ``R == E`` is the
+    all-hot configuration (paging machinery, full residency). Shared across
+    a ReplicaSet by reference like the weight tree."""
+
+    def __init__(self, host_leaves, num_layers, num_experts, resident_experts,
+                 telemetry=None, mesh=None):
+        if not host_leaves:
+            raise ValueError("expert offload needs a non-empty experts subtree")
+        self.num_layers = int(num_layers)
+        self.num_experts = int(num_experts)
+        self.resident = int(resident_experts)
+        if not 1 <= self.resident <= self.num_experts:
+            raise ValueError(
+                f"expert_offload.resident_experts must be in [1, num_experts="
+                f"{self.num_experts}], got {resident_experts}")
+        self.telemetry = telemetry
+        self.mesh = mesh
+        L, E, R = self.num_layers, self.num_experts, self.resident
+        self._host = {}
+        for name, leaf in host_leaves.items():
+            leaf = np.asarray(leaf)
+            if leaf.shape[:2] != (L, E):
+                raise ValueError(f"expert leaf {name!r} shape {leaf.shape} does not "
+                                 f"lead with (num_layers={L}, num_experts={E})")
+            self._host[name] = leaf
+        self._lock = threading.RLock()
+        # residency state: slot owners (-1 = free), expert->slot map (absent
+        # experts point at slot 0 — any in-range page; the replay protocol
+        # makes the garbage harmless), per-(layer, slot) LRU ticks
+        self._owner = np.full((L, R), -1, np.int64)
+        self._emap = np.zeros((L, E), np.int32)
+        self._res = np.zeros((L, E), bool)
+        self._lru = np.zeros((L, R), np.int64)
+        self._tick = 0
+        self._emap_dev = None
+        self._pending = None  # staged host page for the in-flight load put
+        self.loads = 0
+        self.evicts = 0
+        from ..memory.streams import LayerStreamExecutor
+        # depth 0: hot-load puts are point-of-use FENCED (same pattern as
+        # the adapter store and the KV tier's restore path)
+        self._executor = LayerStreamExecutor(self._dispatch_load, None,
+                                             prefetch_depth=0, fetch_window=1)
+        # deterministic warm state: experts [0, R) resident in every layer,
+        # assembled host-side and placed in ONE put per leaf (per-page
+        # loads here would functionally rewrite the whole pool L*R times)
+        self._pools = {name: self._replicate(jnp.asarray(
+            np.ascontiguousarray(leaf[:, :R])))
+            for name, leaf in self._host.items()}
+        self._owner[:, :] = np.arange(R)[None, :]
+        self._emap[:, :R] = np.arange(R)[None, :]
+        self._res[:, :R] = True
+        self._write = None
+        # compile the slot-write program at build — before any gateway
+        # recompile watch arms — with an identity rewrite of page (0, 0)
+        with self._lock:
+            self._put_page(0, 0, 0)
+
+    # ------------------------------------------------------------------ build
+    def _replicate(self, x):
+        if self.mesh is not None and self.mesh.devices.size > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+            return jax.device_put(x, NamedSharding(self.mesh, PartitionSpec()))
+        return jax.device_put(x)
+
+    def _write_fn(self):
+        if self._write is None:
+            def write(pools, layer, slot, new):
+                # NOT donated: an in-flight step program (this replica's
+                # replay, or a sibling replica) may still read the old pools
+                return {k: pools[k].at[layer, slot].set(new[k]) for k in pools}
+            kw = {}
+            if self.mesh is not None and self.mesh.devices.size > 1:
+                from jax.sharding import NamedSharding, PartitionSpec
+                repl = NamedSharding(self.mesh, PartitionSpec())
+                kw["out_shardings"] = {k: repl for k in sorted(self._pools)}
+            self._write = jax.jit(write, **kw)
+        return self._write
+
+    def _dispatch_load(self, name):
+        return jax.device_put(self._pending)
+
+    # ------------------------------------------------------------------ paging
+    def _put_page(self, layer, slot, expert):
+        """Stage expert ``expert``'s layer-``layer`` host page and write it
+        into pool ``slot``: fenced host→device put through the shared
+        streaming layer + the ONE compiled slot-write (layer/slot are
+        runtime scalars). Caller holds the lock."""
+        self._pending = {name: leaf[layer, expert]
+                         for name, leaf in self._host.items()}
+        ctx = self.mesh if self.mesh is not None else _NullCtx()
+        with ctx:
+            dev = self._executor.take("expert_page")  # fenced put
+            self._pools = self._write_fn()(self._pools, jnp.asarray(layer, jnp.int32),
+                                           jnp.asarray(slot, jnp.int32), dev)
+        self._pending = None
+
+    def _load(self, layer, expert):
+        """Hot-load expert ``expert``'s layer-``layer`` page into a free (or
+        LRU-evicted) slot. Caller holds the lock and has checked demand fits."""
+        free = np.flatnonzero(self._owner[layer] < 0)
+        if free.size:
+            slot = int(free[0])
+        else:
+            slot = int(np.argmin(self._lru[layer]))
+            victim = int(self._owner[layer, slot])
+            self._res[layer, victim] = False
+            self.evicts += 1
+            if self.telemetry is not None and self.telemetry.enabled:
+                self.telemetry.counter("serving/expert_evicts")
+        t0 = time.perf_counter()
+        self._put_page(layer, slot, expert)
+        self._owner[layer, slot] = expert
+        self._emap[layer, expert] = slot
+        self._res[layer, expert] = True
+        self._tick += 1
+        self._lru[layer, slot] = self._tick
+        self._emap_dev = None
+        self.loads += 1
+        if self.telemetry is not None and self.telemetry.enabled:
+            self.telemetry.counter("serving/expert_loads")
+            self.telemetry.histogram("serving/expert_load_ms",
+                                     (time.perf_counter() - t0) * 1e3)
+            self.telemetry.gauge("serving/experts_resident", self.resident_fraction())
+
+    def dispatch_operands(self):
+        """Consistent residency snapshot for ONE dispatch: ``(expert->slot
+        map (L, E) device int32, pools {leaf: (L, R, ...)}, resident (L, E)
+        host bool)``. The pools are immutable arrays, so later loads/evicts
+        (this replica's replay loop or a sibling's) produce NEW arrays and
+        can never corrupt a dispatch holding this snapshot; miss detection
+        must diff against THIS snapshot's ``resident``, not live state."""
+        with self._lock:
+            if self._emap_dev is None:
+                self._emap_dev = self._replicate(jnp.asarray(self._emap))
+            return self._emap_dev, dict(self._pools), self._res.copy()
+
+    def missing(self, used, resident_snapshot):
+        """(L, E) bool: experts the dispatch routed to but its snapshot did
+        not hold. ``used``: counts > 0 from the program's expert_stats."""
+        return np.asarray(used, bool) & ~resident_snapshot
+
+    def ensure(self, used):
+        """Make every expert in ``used`` (L, E bool) resident. The wanted
+        set is pinned for this pass — eviction only takes per-layer LRU
+        pages OUTSIDE it. Returns False (loading nothing further) when some
+        layer wants more than ``resident_experts`` pages at once: the
+        caller's backoff ladder shrinks the step until demand fits."""
+        used = np.asarray(used, bool)
+        with self._lock:
+            if int(used.sum(axis=1).max(initial=0)) > self.resident:
+                return False
+            for layer, expert in zip(*np.nonzero(used & ~self._res)):
+                # pin: mark wanted residents most-recent so LRU eviction
+                # inside this pass can only take pages outside `used[layer]`
+                wanted_slots = self._emap[layer][used[layer] & self._res[layer]]
+                self._tick += 1
+                self._lru[layer, wanted_slots] = self._tick
+                self._load(int(layer), int(expert))
+            return True
+
+    def touch(self, used):
+        """LRU bump for a successful dispatch's routed experts, so hot
+        experts outlive cold ones."""
+        used = np.asarray(used, bool)
+        with self._lock:
+            for layer in range(self.num_layers):
+                slots = self._emap[layer][used[layer] & self._res[layer]]
+                if slots.size:
+                    self._tick += 1
+                    self._lru[layer, slots] = self._tick
+
+    # ------------------------------------------------------------------ introspection
+    def resident_fraction(self):
+        return float(self._res.mean())
+
+    def pool_bytes(self):
+        return int(sum(p.nbytes for p in self._pools.values()))
+
+    def stats(self):
+        with self._lock:
+            return {"num_experts": self.num_experts,
+                    "resident_experts": self.resident,
+                    "resident_fraction": round(self.resident_fraction(), 4),
+                    "pool_bytes": self.pool_bytes(),
+                    "loads": self.loads, "evicts": self.evicts}
+
+
+class _NullCtx:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False

@@ -14,7 +14,7 @@ import flax.linen as nn
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..comm import comm as dist
-from .sharded_moe import top_k_gating
+from .sharded_moe import top_k_gating, top_k_serving_weights
 
 
 def _expert_constraint(x, spec):
@@ -24,6 +24,79 @@ def _expert_constraint(x, spec):
     if dist.has_mesh() and dist.get_mesh().shape[dist.EXPERT_AXIS] > 1:
         return dist.constrain(x, spec)
     return x
+
+
+def _ep_size():
+    """Live size of the ``expert`` mesh axis from this trace context."""
+    if not dist.has_mesh() or dist.EXPERT_AXIS in dist.get_manual_axes():
+        return 1
+    return dist.get_mesh().shape[dist.EXPERT_AXIS]
+
+
+def _tp_live():
+    if not dist.has_mesh() or dist.TENSOR_AXIS in dist.get_manual_axes():
+        return False
+    return dist.get_mesh().shape[dist.TENSOR_AXIS] > 1
+
+
+def _deq(q, s, dtype):
+    """Dequantize a batched int8 expert kernel (E, K, N) with per-group
+    scales (E, G, N) to ``dtype``."""
+    E, k, n = q.shape
+    G = s.shape[1]
+    return (q.astype(dtype).reshape(E, G, k // G, n)
+            * s[:, :, None, :].astype(dtype)).reshape(E, k, n)
+
+
+def expert_ffn(x, kernels, activation, dtype, bitwise_tp=False, keep_expert_axis=False):
+    """Batched expert FFN math on EXPLICIT kernel leaves.
+
+    ``x``: (E, C, H) per-expert token buffers (the leading axis matches the
+    kernels' leading expert — or pool-page — axis). ``kernels``: a dict in
+    the param-tree leaf naming: ``{gate,up,down}_proj`` fp kernels or their
+    int8 ``*_q``/``*_scale`` pairs (detected by key), plus optional
+    ``up_bias``/``down_bias``. Shared by :class:`Experts` (weights from the
+    param tree, possibly expert-sharded) and the cold-expert paged pools
+    (``moe/expert_store.py``, weights gathered from resident device pages):
+    ONE math path, so offloaded and in-tree experts can never diverge.
+
+    ``bitwise_tp``: serving all-gather layout — re-replicate the
+    ffn-sharded activation over ``tensor`` before the down projection so
+    its full contraction runs shard-local (no partial-sum reduction; the
+    tp>1 == tp=1 bit-identity contract). ``keep_expert_axis`` preserves the
+    leading axis's ``expert`` sharding through that constraint."""
+    use_bias = "down_bias" in kernels
+    glu = activation in ("swiglu", "geglu")
+    if "up_proj_q" in kernels:
+        uk = _deq(kernels["up_proj_q"], kernels["up_proj_scale"], dtype)
+        dk = _deq(kernels["down_proj_q"], kernels["down_proj_scale"], dtype)
+        gk = (_deq(kernels["gate_proj_q"], kernels["gate_proj_scale"], dtype)
+              if glu else None)
+    else:
+        uk = kernels["up_proj"].astype(dtype)
+        dk = kernels["down_proj"].astype(dtype)
+        gk = kernels["gate_proj"].astype(dtype) if glu else None
+    x = x.astype(dtype)
+    if activation in ("swiglu", "geglu"):
+        g = jnp.einsum("ech,ehf->ecf", x, gk)
+        u = jnp.einsum("ech,ehf->ecf", x, uk)
+        act = nn.silu(g) if activation == "swiglu" else nn.gelu(g)
+        h = act * u
+    else:
+        h = jnp.einsum("ech,ehf->ecf", x, uk)
+        if use_bias and "up_bias" in kernels:
+            h = h + kernels["up_bias"][:, None, :].astype(h.dtype)
+        h = nn.gelu(h) if activation == "gelu" else nn.relu(h)
+    if bitwise_tp and _tp_live():
+        # serving bitwise-TP: gather the ffn-sharded activation (exact
+        # concat over `tensor`) so the replicated down_proj contracts fully
+        # locally — same move MLP._tp_replicate makes on the dense path
+        e_axis = dist.EXPERT_AXIS if (keep_expert_axis and _ep_size() > 1) else None
+        h = dist.constrain(h, P(e_axis, None, None))
+    out = jnp.einsum("ecf,efh->ech", h, dk)
+    if use_bias:
+        out = out + kernels["down_bias"][:, None, :].astype(out.dtype)
+    return out
 
 
 class Experts(nn.Module):
@@ -39,6 +112,7 @@ class Experts(nn.Module):
     int8: bool = False
     int8_groups: int = 0  # scale-group SIZE (0 = default rule, 128)
     use_bias: bool = False  # Megatron-style biased expert FFNs
+    bitwise_tp: bool = False  # serving all-gather layout (see expert_ffn)
 
     def _qparam(self, name, k, n):
         E = self.num_experts
@@ -48,45 +122,39 @@ class Experts(nn.Module):
         s = self.param(name + "_scale", nn.initializers.ones, (E, G, n), jnp.float32)
         return q, s
 
-    def _deq(self, q, s):
-        E, k, n = q.shape
-        G = s.shape[1]
-        return (q.astype(self.dtype).reshape(E, G, k // G, n)
-                * s[:, :, None, :].astype(self.dtype)).reshape(E, k, n)
-
-    @nn.compact
-    def __call__(self, x):  # x: (E, C, H)
+    def _kernels(self):
+        """Declare this module's kernel/bias params and return them in the
+        leaf-name dict :func:`expert_ffn` consumes (one math path for
+        in-tree and paged-pool experts)."""
         init = nn.initializers.normal(0.02)
         E, H, F = self.num_experts, self.hidden, self.ffn
-        x = x.astype(self.dtype)
+        glu = self.activation in ("swiglu", "geglu")
+        kernels = {}
         if self.int8:
-            gk = self._deq(*self._qparam("gate_proj", H, F))
-            uk = self._deq(*self._qparam("up_proj", H, F))
-            dk = self._deq(*self._qparam("down_proj", F, H))
+            # gate declared unconditionally (matching the fp branch): the
+            # param tree must not depend on the activation family
+            for name, k, n in (("gate_proj", H, F), ("up_proj", H, F),
+                               ("down_proj", F, H)):
+                kernels[name + "_q"], kernels[name + "_scale"] = self._qparam(name, k, n)
         else:
-            gate_k = self.param("gate_proj", init, (E, H, F), jnp.float32)
-            up_k = self.param("up_proj", init, (E, H, F), jnp.float32)
-            down_k = self.param("down_proj", init, (E, F, H), jnp.float32)
-            gk, uk, dk = (k.astype(self.dtype) for k in (gate_k, up_k, down_k))
+            kernels["gate_proj"] = self.param("gate_proj", init, (E, H, F), jnp.float32)
+            kernels["up_proj"] = self.param("up_proj", init, (E, H, F), jnp.float32)
+            kernels["down_proj"] = self.param("down_proj", init, (E, F, H), jnp.float32)
         if self.use_bias:  # Megatron-style biased expert FFNs
-            down_b = self.param("down_bias", nn.initializers.zeros, (E, H), jnp.float32)
-        if self.activation in ("swiglu", "geglu"):
-            # no up_bias here: the glu branch never applies one, so declaring
-            # it would add a dead trainable param to every biased glu model
-            g = jnp.einsum("ech,ehf->ecf", x, gk)
-            u = jnp.einsum("ech,ehf->ecf", x, uk)
-            act = nn.silu(g) if self.activation == "swiglu" else nn.gelu(g)
-            h = act * u
-        else:
-            h = jnp.einsum("ech,ehf->ecf", x, uk)
-            if self.use_bias:
-                up_b = self.param("up_bias", nn.initializers.zeros, (E, F), jnp.float32)
-                h = h + up_b[:, None, :].astype(h.dtype)
-            h = nn.gelu(h) if self.activation == "gelu" else nn.relu(h)
-        out = jnp.einsum("ecf,efh->ech", h, dk)
-        if self.use_bias:
-            out = out + down_b[:, None, :].astype(out.dtype)
-        return out
+            kernels["down_bias"] = self.param("down_bias", nn.initializers.zeros,
+                                              (E, H), jnp.float32)
+            if not glu:
+                # no up_bias on the glu branch: it never applies one, so
+                # declaring it would add a dead trainable param
+                kernels["up_bias"] = self.param("up_bias", nn.initializers.zeros,
+                                                (E, F), jnp.float32)
+        return kernels
+
+    @nn.compact
+    def __call__(self, x, keep_expert_axis=False):  # x: (E, C, H)
+        return expert_ffn(x, self._kernels(), self.activation, self.dtype,
+                          bitwise_tp=self.bitwise_tp,
+                          keep_expert_axis=keep_expert_axis)
 
 
 class MoE(nn.Module):
@@ -109,7 +177,23 @@ class MoE(nn.Module):
         return P(tuple(axes) if axes else None, None)
 
     @nn.compact
-    def __call__(self, x):  # x: (B, T, H)
+    def __call__(self, x, serving=False, q_spans=None, expert_ops=None):
+        """``x``: (B, T, H). Training (default) returns ``(output,
+        aux_loss)`` through the capacity-buffered dispatch. ``serving=True``
+        (the KV-cache forward — slot-pool decode, chunked prefill, static
+        generate) routes per token with NO capacity competition (see
+        :func:`~deepspeed_tpu.moe.sharded_moe.top_k_serving_weights`) and
+        returns ``output`` alone: no aux loss is sown, every token is a
+        pure function of itself, and ep>1 sharded compute is bit-identical
+        to the ep=1 replicated program (all-gather combine in fixed expert
+        order). ``q_spans``: per-row live query counts (padding columns are
+        excluded from the expert-usage stats). ``expert_ops``: cold-expert
+        paging operands for THIS layer — ``(expert->page map (E,), pools
+        {leaf: (R, ...)})`` gathered from the
+        :class:`~deepspeed_tpu.moe.expert_store.PagedExpertStore`; the
+        expert params are then host-resident and never read."""
+        if serving:
+            return self._serving(x, q_spans, expert_ops)
         cfg = self.cfg
         B, T, H = x.shape
         N, E = B * T, cfg.num_experts
@@ -143,3 +227,82 @@ class MoE(nn.Module):
         if dist.has_mesh():
             out = dist.constrain(out, self._token_spec(B, T))
         return out.reshape(B, T, H), aux_loss
+
+    def _serving(self, x, q_spans, expert_ops):
+        """Serving forward: per-token capacity-free top-k dispatch.
+
+        Bitwise-EP discipline (the PR-10 layout rule applied to the expert
+        axis): per-expert FFNs run batched over the leading expert axis —
+        sharded over ``expert`` when it divides ``num_experts``, each shard
+        computing its experts' FULL (H, F) contractions — then the (E, N, H)
+        expert outputs ALL-GATHER to replicated (pure concatenation) and the
+        combine accumulates in fp32 over a FIXED increasing-expert-index
+        loop. No cross-shard reduction ever happens, so ep>1 logits are
+        bit-identical to the ep=1 replicated program's; a non-dividing
+        expert count skips the constraints entirely (loud replicated
+        fallback, the engine's ready line says so).
+
+        Cold-expert offload: with ``expert_ops`` the R resident pool pages
+        compute physically and the logical (E, N, H) outputs gather through
+        the expert->page map, so the combine runs in the SAME expert order
+        as the in-tree path — offloaded all-hot output is bit-identical to
+        non-offloaded, and a page miss only garbles tokens routed to the
+        missing expert (the scheduler detects it via the sown counts and
+        re-dispatches after the hot-load; every KV row the garbage forward
+        wrote is rewritten by the replay).
+
+        Sows per-layer ``(E,)`` int32 routed-token counts into the
+        ``expert_stats`` collection (live columns only, per ``q_spans``) —
+        the residency/replay signal and the load-balance telemetry. The
+        collection is opt-in ``mutable``; when the caller doesn't open it,
+        the sow is dropped and XLA dead-code-eliminates the counts."""
+        cfg = self.cfg
+        B, T, H = x.shape
+        N, E = B * T, cfg.num_experts
+        k = cfg.moe_top_k
+        tokens = x.reshape(N, H)
+
+        gate_w = self.param("gate", nn.initializers.normal(0.02), (H, E), jnp.float32)
+        logits = tokens.astype(jnp.float32) @ gate_w
+        weights = top_k_serving_weights(logits, k)  # (N, E) fp32, per-token
+
+        if q_spans is not None:
+            valid = (jnp.arange(T)[None, :] < q_spans[:, None]).reshape(N)
+        else:
+            valid = jnp.ones((N, ), bool)
+        counts = jnp.sum((weights > 0) & valid[:, None], axis=0,
+                         dtype=jnp.int32)  # (E,)
+        self.sow("expert_stats", "counts", counts)
+
+        ep_ok = _ep_size() > 1 and E % _ep_size() == 0
+        if expert_ops is None:
+            xin = jnp.broadcast_to(tokens[None].astype(cfg.dtype), (E, N, H))
+            if ep_ok:
+                xin = dist.constrain(xin, P(dist.EXPERT_AXIS, None, None))
+            eo = Experts(E, H, cfg.ffn_size, cfg.activation, cfg.dtype,
+                         int8=getattr(cfg, "int8_weights", False),
+                         int8_groups=getattr(cfg, "int8_group_size", 0),
+                         use_bias=getattr(cfg, "moe_expert_bias", False),
+                         bitwise_tp=getattr(cfg, "bitwise_tp", False),
+                         name="experts")(xin, keep_expert_axis=ep_ok)
+            if ep_ok:
+                eo = dist.constrain(eo, P(dist.EXPERT_AXIS, None, None))
+                # all-gather (exact concat) so the combine below reduces
+                # over the FULL expert axis locally on every shard
+                eo = dist.constrain(eo, P(None, None, None))
+        else:
+            emap, pools = expert_ops  # (E,) int32 map, {leaf: (R, ...)} pages
+            R = jax.tree_util.tree_leaves(pools)[0].shape[0]
+            xin = jnp.broadcast_to(tokens[None].astype(cfg.dtype), (R, N, H))
+            phys = expert_ffn(xin, pools, cfg.activation, cfg.dtype,
+                              bitwise_tp=getattr(cfg, "bitwise_tp", False))
+            eo = jnp.take(phys, emap, axis=0)  # (E, N, H) logical expert outputs
+
+        # fixed-order fp32 combine: a strictly sequential expert-index walk
+        # gives every program variant (ep1/ep2, in-tree/paged) the same
+        # float addition order — einsum would leave the reduction order to
+        # each program's XLA schedule
+        acc = jnp.zeros((N, H), jnp.float32)
+        for e in range(E):
+            acc = acc + weights[:, e:e + 1] * eo[e].astype(jnp.float32)
+        return acc.astype(cfg.dtype).reshape(B, T, H)

@@ -78,3 +78,41 @@ def top_k_gating(logits, k, capacity_factor, min_capacity=4, rng=None, noise_std
 
     drop_frac = 1.0 - kept / (N * k)
     return dispatch, combine, aux_loss, drop_frac
+
+
+def top_k_serving_weights(logits, k):
+    """Per-token combine weights for SERVING: deterministic, capacity-free
+    top-k routing.
+
+    The training path (:func:`top_k_gating`) buffers tokens into per-expert
+    capacity slots, so a token's position — and whether it is DROPPED — is a
+    ``cumsum`` over every other token in the batch. That is fine for a loss
+    but poison for a slot-pool decode step: a request's logits would depend
+    on which other requests (and which garbage padding rows) share the
+    dispatch. Serving instead computes, per token independently:
+
+    - softmax probabilities over the router logits (fp32),
+    - the same iterative-argmax top-k selection the training gate uses
+      (deterministic, ties resolve to the lowest expert index),
+    - combine weight = the selected expert's probability, renormalized over
+      the selected k (the Mixtral/top-2 normalization, reference
+      sharded_moe.py:303) — no capacity, nothing ever dropped.
+
+    Returns ``(N, E)`` fp32 weights that are zero outside each token's
+    top-k. Every token's row is a pure function of its own logits, which is
+    what makes scheduler results slot/batch-independent and lets dead
+    (span-0) pool rows carry garbage without perturbing live rows.
+    """
+    N, E = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    masked = logits.astype(jnp.float32)
+    weights = jnp.zeros((N, E), jnp.float32)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        weights = weights + m * probs
+        masked = jnp.where(m > 0, -jnp.inf, masked)
+    if k > 1:
+        denom = jnp.sum(weights, axis=-1, keepdims=True)
+        weights = weights / jnp.maximum(denom, 1e-9)
+    return weights

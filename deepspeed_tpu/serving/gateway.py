@@ -855,7 +855,18 @@ class Gateway:
             "serving/replicas_available": float(
                 sum(1 for r in self.replicas if r.available())),
             "serving/tp_size": float(sched.tp_size),
+            "serving/ep_size": float(sched.ep_size),
         }
+        if sched.experts is not None:
+            out.update({
+                "serving/experts_resident": sched.experts.resident_fraction(),
+                "serving/expert_loads": float(sched.experts.loads),
+                "serving/expert_evicts": float(sched.experts.evicts),
+                # replays are per-scheduler state (the store is fleet-shared
+                # but each replica runs its own replay loop): sum the fleet
+                "serving/expert_replays": float(
+                    sum(r.scheduler.expert_replays for r in self.replicas)),
+            })
         if self.replicas.disaggregated():
             # phase split + handoff pressure (the decode-side half of the
             # phase-aware Retry-After, scrapeable): per-replica roles are in
@@ -900,9 +911,12 @@ class Gateway:
                           "queue_depth": len(sched.queue),
                           "slot_occupancy": sched.cache.occupancy(),
                           "compiled_programs": sched.compiled_program_count(),
-                          "tp_size": sched.tp_size},
+                          "tp_size": sched.tp_size,
+                          "ep_size": sched.ep_size},
             "adapters": (sched.adapters.stats()
                          if sched.adapters is not None else None),
+            "expert_store": (sched.experts.stats()
+                             if sched.experts is not None else None),
             "replicas": self.replicas.states(),
             # disaggregated serving rollup (per-replica phase_role and
             # migrations_{out,in} are in the replicas list above)

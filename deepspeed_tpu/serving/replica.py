@@ -238,6 +238,10 @@ class Replica:
             "tok_s": round(self.tok_s, 2),
             "ema_service_s": self.ema_service_s,
             "tp_size": s.tp_size,
+            "ep_size": s.ep_size,
+            # cold-expert paging (MoE serving): the fleet-shared store —
+            # a page hot-loaded through any replica is resident for all
+            "expert_store": s.experts.stats() if s.experts is not None else None,
             "prefix_cache_hit_rate": (round(s.radix.hit_rate(), 4)
                                       if s.radix is not None else None),
             # hierarchical KV tier (fleet-global host store shared by every

@@ -46,11 +46,17 @@ import sys
 # higher-better); "pending"/"failed" mark handoff backpressure/losses (a
 # round that parks or fails more handoffs at the same stream regressed);
 # migrations/tokens_per_sec keep the higher-is-better default.
+# moe leg notes: "loads"/"replays" mark cold-expert paging churn (more
+# hot-loads or replay dispatches at the same stream = worse residency
+# amortization; "evicts" already rides the adapter token), and "programs"
+# marks mid-stream compile counts (new_programs_mid_stream must stay 0);
+# tokens_per_sec / resident_fraction / *_over_* ratios keep the
+# higher-is-better default.
 _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
                  "shed", "evict", "evictions", "evicts", "miss", "misses",
                  "s", "seconds", "loss", "ppl", "perplexity", "spill",
                  "spills", "dropped", "swaps", "degradation", "pending",
-                 "failed"}
+                 "failed", "loads", "replays", "programs"}
 
 
 def _lower_better(path):

@@ -12,6 +12,7 @@
 #   tools/ci_check.sh --hierkv   # hierarchical-KV tier lane only
 #   tools/ci_check.sh --multilora # multi-LoRA adapter-serving lane only
 #   tools/ci_check.sh --disagg   # disaggregated prefill/decode lane only
+#   tools/ci_check.sh --moe      # MoE serving (expert-parallel decode) lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -140,6 +141,24 @@ disagg_lane() {
     tests/unit/serving/test_disagg.py -q -p no:cacheprovider
 }
 
+moe_lane() {
+  echo "== MoE serving lane =="
+  # expert-parallel decode guards, run UNFILTERED under the forced
+  # multi-CPU-device backend (the bit-identity matrix nodeids live in
+  # slow_tests.txt to keep tier-1 in budget): ep=2/ep=4/ep2xtp2 scheduler
+  # decode BIT-identical to the ep=1 replicated program (greedy + sampled
+  # x radix hit/cold x spec on/off x bf16/int8 KV), non-dividing expert
+  # counts fall back replicated LOUDLY, cold-expert offload (all-hot AND
+  # half-resident churn) bit-identical to the in-tree path with ZERO new
+  # XLA programs over a fresh routing/residency mix (jax.monitoring), and
+  # apply_with_cache never collects training-only intermediates. The
+  # matching perf leg is `python bench.py serving` ("moe" entry: top-k
+  # stream vs dense-equivalent-FLOPs + the residency sweep).
+  timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" python -m pytest \
+    tests/unit/inference/test_moe_decode.py -q -p no:cacheprovider
+}
+
 bench_diff() {
   echo "== bench diff (advisory) =="
   # diff the given fresh bench JSON (or the latest committed round) against
@@ -203,6 +222,10 @@ if [ "${1:-}" = "--disagg" ]; then
   disagg_lane
   exit $?
 fi
+if [ "${1:-}" = "--moe" ]; then
+  moe_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -245,7 +268,10 @@ ml_rc=$?
 disagg_lane
 dg_rc=$?
 
+moe_lane
+me_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ]
