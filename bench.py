@@ -367,6 +367,10 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
                lambda: _kv_int8_bench(make, num_slots, max_new, seed))
     _guard_leg(results, "observability",
                lambda: _observability_bench(make, max_new, seed))
+    _guard_leg(results, "capacity",
+               lambda: _capacity_bench(make, max_new, seed,
+                                       sample_every=int(os.environ.get(
+                                           "BENCH_SERVING_CAPACITY", "8"))))
     return results
 
 
@@ -403,6 +407,64 @@ def _observability_bench(make, max_new, seed):
             "trace_json_written": os.path.exists(eng.telemetry.trace_path),
             "flight_dump_written": bool(dump) and os.path.exists(dump),
         }
+    finally:
+        set_sink(None)
+
+
+def _capacity_bench(make, max_new, seed, sample_every=8, n_requests=6):
+    """Capacity-observability leg (telemetry/capacity.py): the same warmed
+    decode stream with fenced roofline sampling effectively NEVER vs every
+    1/``sample_every`` syncs (BENCH_SERVING_CAPACITY) — sink enabled in
+    BOTH arms, so the ratio isolates the fencing tax from the sink's
+    pre-existing per-step cost (which the observability leg already
+    reports). The instrumented-vs-off tokens/sec ratio carries the
+    acceptance bar (>= 0.87x), alongside the live serving MFU /
+    HBM-bandwidth-utilization / goodput gauges and the host-gap share of
+    wall time the run measured."""
+    from deepspeed_tpu.telemetry import set_sink
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 50257, 32).astype(np.int32)
+               for _ in range(n_requests)]
+
+    def run(tel_cfg):
+        set_sink(None)
+        eng = make(True, telemetry=tel_cfg)
+        sched = eng.scheduler(num_slots=4)
+        sched.submit(prompts[0], max_new_tokens=8).result()  # warm programs
+        t0 = time.perf_counter()
+        hs = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+        toks = sum(len(h.result()) for h in hs)
+        return eng, sched, toks / (time.perf_counter() - t0)
+
+    try:
+        off_dir = tempfile.mkdtemp(prefix="bench_cap_off_")
+        off_eng, _, off_tps = run({"enabled": True, "output_path": off_dir,
+                                   "capacity_sample_every": 1 << 20})
+        off_eng.telemetry.close()
+        tdir = tempfile.mkdtemp(prefix="bench_cap_")
+        eng, sched, on_tps = run({"enabled": True, "output_path": tdir,
+                                  "capacity_sample_every": sample_every})
+        snap = eng.telemetry.snapshot()
+        gauges = snap.get("gauges", {})
+        cap = sched.capacity
+        out = {
+            "tokens_per_sec_off": round(off_tps, 1),
+            "tokens_per_sec_instrumented": round(on_tps, 1),
+            # the contract number: sampled fencing must cost < 13%
+            "instrumented_ratio": round(on_tps / max(off_tps, 1e-9), 3),
+            "sample_every": sample_every,
+            "capacity_samples": cap.samples if cap is not None else 0,
+            "mfu": round(gauges.get("serving/mfu", 0.0), 6),
+            "hbm_bw_util": round(gauges.get("serving/hbm_bw_util", 0.0), 6),
+            "goodput_fraction": round(gauges.get("serving/goodput_fraction",
+                                                 1.0), 4),
+            "host_gap_total_s": (round(sched._gap.total_gap_s, 4)
+                                 if sched._gap is not None else None),
+            "programs_registered": (len(cap.programs) if cap is not None
+                                    else 0),
+        }
+        eng.telemetry.close()
+        return out
     finally:
         set_sink(None)
 

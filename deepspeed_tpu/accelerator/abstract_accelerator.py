@@ -94,6 +94,11 @@ class DeepSpeedAccelerator(abc.ABC):
     def is_triton_supported(self):
         return False
 
+    def peak_hbm_bandwidth(self):
+        """Peak per-device memory bandwidth (bytes/s) for roofline math;
+        subclasses with real numbers override (see tpu_accelerator)."""
+        return 1e11
+
     def use_host_timers(self):
         return True
 

@@ -56,3 +56,6 @@ class CPU_Accelerator(DeepSpeedAccelerator):
 
     def peak_flops(self, dtype=jnp.bfloat16):
         return 1e12
+
+    def peak_hbm_bandwidth(self):
+        return 5e10  # nominal DDR-class bandwidth; keeps roofline math finite

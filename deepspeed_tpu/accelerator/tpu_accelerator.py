@@ -97,3 +97,19 @@ class TPU_Accelerator(DeepSpeedAccelerator):
             if k in kind:
                 return v
         return 275e12
+
+    def peak_hbm_bandwidth(self):
+        """Peak per-chip HBM bandwidth (bytes/s) for roofline math
+        (best-effort by kind, same convention as :meth:`peak_flops`)."""
+        kind = self.device_kind().lower()
+        table = {
+            "v5 lite": 819e9,
+            "v5litepod": 819e9,
+            "v4": 1228e9,
+            "v5p": 2765e9,
+            "v6": 1640e9,  # trillium
+        }
+        for k, v in table.items():
+            if k in kind:
+                return v
+        return 1228e9

@@ -142,6 +142,7 @@ gauges ``serving/adapters_resident``, ``serving/adapter_pool_bytes``,
 
 import collections
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -531,7 +532,35 @@ class DecodeScheduler:
         self._iter = 0
         self._iter_links = None  # list while a traced sync is in flight
         self.telemetry = engine.telemetry
+        # set by serving/replica.py when this scheduler serves in a fleet;
+        # request traces stamp it so the migration-aware trace_summary view
+        # can pair prefill and decode replicas per request
+        self.replica_idx = None
+        # serving capacity accounting (telemetry/capacity.py): per-program
+        # roofline registry + sampled fenced timing + host-gap attribution.
+        # Only built on an enabled sink — the disabled path allocates
+        # nothing and every hook below gates on `self.capacity is None`.
+        self.capacity = None
+        self._gap = None
+        self._sync_seq = 0
+        self._cap_sample = False
+        self._goodput_spec_seen = 0
         if self.telemetry.enabled:
+            from ..accelerator import get_accelerator
+            from ..telemetry.capacity import (CapacityMeter, CapacityModel,
+                                              HostGapTracker)
+            accel = get_accelerator()
+            n_dev = max(1, int(np.prod(list(engine.mesh.shape.values()))))
+            self.capacity = CapacityMeter(
+                self.telemetry,
+                CapacityModel(engine.model_config, self.cache.bytes_per_token(),
+                              int(num_slots), tp_size=self.tp_size,
+                              ep_size=self.ep_size),
+                peak_flops=accel.peak_flops(),
+                peak_hbm_bw=accel.peak_hbm_bandwidth(),
+                n_devices=n_dev,
+                sample_every=getattr(self.telemetry, "capacity_sample_every", 32))
+            self._gap = HostGapTracker(self.telemetry)
             # the KV tier's HBM price tag: int8 should show ~half the bytes
             # per resident token of an "auto" bf16 pool
             self.telemetry.gauges([
@@ -726,13 +755,28 @@ class DecodeScheduler:
         # request is STILL fully owned by this scheduler (active slot
         # intact) — the normal sick-replica shedding can fail it, instead
         # of stranding a request that is owned by nobody and parked nowhere
+        t0 = time.perf_counter() if self._gap is not None else 0.0
         self.kv_tier.demote_request(slot, kv_len, key, on_ready)
+        if self._gap is not None:
+            self._gap.add("tier_transfer", time.perf_counter() - t0)
+        if self.capacity is not None:
+            # goodput: the demoted KV bytes are pure handoff traffic —
+            # no request token comes out of moving them
+            self.capacity.account(
+                0, wasted_bytes=kv_len * self.cache.bytes_per_token())
         req.migrating = True
         del self.active[slot]
         self._release_slot(slot)  # retained cached: the prompt prefix the
         # _finish_prefill registration holds stays a donor for siblings
         self.migrations_out += 1
         req.slot = None
+        if req.trace is not None and req.trace.enabled:
+            # the prefill half of the handoff, stamped with THIS replica —
+            # trace_summary --requests pairs it with the decode replica's
+            # "migrated" instant to print the route + migration latency
+            req.trace.mark("migration")
+            req.trace.instant("migrate_out", replica=self.replica_idx,
+                              kv_len=kv_len)
         return kv_len
 
     def _settle_migration(self, record, error=None, discard=True):
@@ -794,9 +838,16 @@ class DecodeScheduler:
         if slot is None:
             return None  # every slot live: stays parked, retried next pull
         try:
+            t0 = time.perf_counter() if self._gap is not None else 0.0
             with self.engine.mesh:
                 ok = self.kv_tier.restore_request(record.entry, slot,
                                                   record.kv_len)
+            if self._gap is not None:
+                self._gap.add("tier_transfer", time.perf_counter() - t0)
+            if ok and self.capacity is not None:
+                # the restore half of the handoff: traffic, not tokens
+                self.capacity.account(
+                    0, wasted_bytes=record.kv_len * self.cache.bytes_per_token())
             if ok:
                 # structural version gate lives in the pool, like
                 # retain/insert
@@ -827,7 +878,12 @@ class DecodeScheduler:
             # now pumps the scheduler that actually owns the request
             req.handle._sched = self
         if req.trace is not None and req.trace.enabled:
-            req.trace.instant("migrated", replica_kv_len=record.kv_len)
+            # close the handoff as a span (parked + transfer time, started
+            # at migrate_out's mark) and stamp the adopting replica
+            req.trace.phase("migration", replica=self.replica_idx,
+                            kv_len=record.kv_len)
+            req.trace.instant("migrated", replica=self.replica_idx,
+                              replica_kv_len=record.kv_len)
         return "resumed"
 
     def owns(self, req):
@@ -850,6 +906,15 @@ class DecodeScheduler:
         t0 = tel.now()
         tracing = tel.enabled and getattr(tel, "trace_requests", False)
         self._iter_links = [] if tracing else None
+        # sampled fenced-timing window (telemetry/capacity.py): every Nth
+        # sync the next dispatch is fenced and timed for the live MFU /
+        # bandwidth / roofline gauges; between samples the async dispatch
+        # pipeline is untouched
+        cap = self.capacity
+        if cap is not None:
+            self._sync_seq += 1
+            self._cap_sample = cap.should_sample(self._sync_seq)
+        gap = self._gap
         # adapter invalidations (page evicted / adapter reloaded elsewhere
         # in the fleet) drain HERE, on the pump thread — trie surgery never
         # races a dispatch
@@ -893,6 +958,10 @@ class DecodeScheduler:
                     continue
                 self._admit(req)
                 admitted += 1
+        if gap is not None:
+            # everything since t0 was host-side admission work (the trie
+            # probe inside _acquire_slot re-files its share)
+            gap.add("admission", tel.now() - t0)
         if admitted and tel.enabled:
             tel.counter("serving/admitted", admitted)
         fused = self._prefill is not None
@@ -922,6 +991,17 @@ class DecodeScheduler:
                         ("serving/kv_token_utilization", self.cache.token_utilization(),
                          None),
                         ("serving/kv_bytes_live", self.cache.live_bytes(), None)])
+            if cap is not None:
+                # goodput: tokens delivered vs computed-then-discarded.
+                # Speculative rejected columns fold in here (as the delta
+                # of drafted - accepted this sync); MoE miss replays and
+                # migration/restore traffic account at their own sites.
+                rejected = ((self.spec_drafted - self.spec_accepted)
+                            - self._goodput_spec_seen)
+                self._goodput_spec_seen += rejected
+                live_lens = [self.cache.lengths[s] for s in self.active]
+                ctx = (sum(live_lens) / len(live_lens)) if live_lens else 0.0
+                cap.account(delivered, wasted_tokens=max(0, rejected), ctx=ctx)
         if tracing:
             # the shared per-iteration span (pump-thread track): request
             # phases that landed this sync flow-link to it via _iter_links
@@ -1032,8 +1112,16 @@ class DecodeScheduler:
             if aref is None:
                 return None, (0, None)  # every page pinned: retry next iter
         akey = aref.uid if aref is not None else None
-        match = (self.radix.match(req.prompt, adapter=akey)
-                 if self.radix is not None else (0, None))
+        if self.radix is not None:
+            t0 = time.perf_counter() if self._gap is not None else 0.0
+            match = self.radix.match(req.prompt, adapter=akey)
+            if self._gap is not None:
+                # the probe ran inside the admission region already stamped
+                # by step(): re-file its share so buckets stay disjoint
+                self._gap.add("trie_probe", time.perf_counter() - t0,
+                              steal_from="admission")
+        else:
+            match = (0, None)
         slot = self.cache.alloc(owner=req.rid)
         if slot is None and self.radix is not None:
             victim = self.radix.evict_lru(prefer_not=match[1])
@@ -1087,6 +1175,7 @@ class DecodeScheduler:
             # Adapter requests probe under their uid namespace — a base (or
             # other-adapter) host entry can never restore for them
             hm, entry = 0, None
+            tier_t0 = time.perf_counter() if self._gap is not None else 0.0
             if self.kv_tier is not None:
                 ns = (self.adapters.namespace(req.adapter_ref.uid)
                       if req.adapter_ref is not None else ())
@@ -1100,6 +1189,11 @@ class DecodeScheduler:
                 with self.engine.mesh:
                     restored = self.kv_tier.restore(entry, slot, hm,
                                                     req.prompt.size)
+            if self._gap is not None and self.kv_tier is not None:
+                # host-tier probe + restore run inside the admission region
+                # already stamped by step(): re-file their share
+                self._gap.add("tier_transfer", time.perf_counter() - tier_t0,
+                              steal_from="admission")
             if restored:
                 pos = hm
                 if tel.enabled:
@@ -1289,6 +1383,7 @@ class DecodeScheduler:
         collect); ``steps`` is each row's ABSOLUTE step index, so results
         are K/fused-invariant."""
         N = self.cache.num_slots
+        t0 = time.perf_counter() if self._gap is not None else 0.0
         seeds = np.zeros(N, np.uint32)
         steps = np.zeros(N, np.int32)
         flags = np.zeros(N, bool)
@@ -1306,6 +1401,8 @@ class DecodeScheduler:
             topps[slot] = req.top_p
             sampling = sampling or req.do_sample
             collect = collect or req.collect_logits
+        if self._gap is not None:
+            self._gap.add("sampling_host", time.perf_counter() - t0)
         return seeds, steps, flags, temps, topks, topps, sampling, collect
 
     def _fetch_block(self, out, collect, K):
@@ -1319,6 +1416,10 @@ class DecodeScheduler:
             logits_k = None
         toks_k = np.asarray(jax.device_get(toks_k)).reshape(K, self.cache.num_slots)
         self._steps += K
+        if self._gap is not None:
+            # the device_get above was the sync fence: the device is idle
+            # from here until the next _dispatch closes the gap
+            self._gap.sync_end(time.perf_counter())
         return toks_k, logits_k
 
     def _deliver_block(self, live, toks_k, logits_k, K):
@@ -1327,6 +1428,7 @@ class DecodeScheduler:
         [len, len+K)); tokens past EOS/budget were computed but are
         discarded. Returns tokens delivered."""
         n_delivered = 0
+        t0 = time.perf_counter() if self._gap is not None else 0.0
         for slot, req in live:
             self.cache.lengths[slot] += K
             for k in range(K):
@@ -1336,7 +1438,43 @@ class DecodeScheduler:
                     req.logits.append(logits_k[k, slot])
                 self._deliver(req, int(toks_k[k, slot]))
                 n_delivered += 1
+        if self._gap is not None:
+            self._gap.add("on_token", time.perf_counter() - t0)
         return n_delivered
+
+    def _dispatch(self, fn, call_args, step_args):
+        """Hand ONE compiled program to the device. Owns the capacity hooks:
+        closes the open host gap (the device stops being idle the moment the
+        dispatch is enqueued) and, on a sampled sync, fences the dispatch —
+        ``block_until_ready`` on the input pool (drain outstanding work) and
+        on the result — so the measured wall time is this program's device
+        time alone. The fence touches only arrays the pipeline already owns:
+        zero new XLA programs. ``step_args`` is the canonical step-argument
+        tuple (pool at [1], lens at [3], spans at [4]) used for batch-shape
+        recovery; ``call_args`` is what the program actually takes."""
+        cap = self.capacity
+        if self._gap is not None:
+            self._gap.dispatch(time.perf_counter())
+        if cap is None or not self._cap_sample:
+            with self.engine.mesh:
+                return fn(*call_args)
+        # one fenced dispatch per sampled sync, even across MoE replays
+        self._cap_sample = False
+        from ..telemetry.capacity import program_shape
+        key = cap.key_for(fn)
+        jax.block_until_ready(step_args[1])
+        t0 = time.perf_counter()
+        with self.engine.mesh:
+            out = fn(*call_args)
+        jax.block_until_ready(out)
+        dur = time.perf_counter() - t0
+        if key is not None:
+            spans = np.asarray(step_args[4])
+            lens = np.asarray(step_args[3])
+            live_ctx = lens[spans > 0] if spans.shape == lens.shape else lens
+            width, ksteps = program_shape(key)
+            cap.observe_dispatch(key, dur, live_ctx, width, ksteps)
+        return out
 
     def _call_step(self, fn, args, lora):
         """Dispatch ONE step program, owning the MoE serving plumbing:
@@ -1358,13 +1496,10 @@ class DecodeScheduler:
         pool — the caller backs off to a smaller step.
         """
         extra = (lora, ) if lora is not None else ()
-        eng = self.engine
         if not self._moe_stats:
-            with eng.mesh:
-                return fn(*(args + extra))
+            return self._dispatch(fn, args + extra, args)
         if self.experts is None:
-            with eng.mesh:
-                out = fn(*(args + extra))
+            out = self._dispatch(fn, args + extra, args)
             self._record_expert_stats(np.asarray(jax.device_get(out[-1])))
             return out[:-1]
         replays = 0
@@ -1374,8 +1509,7 @@ class DecodeScheduler:
         max_replays = 2 * self.experts.num_layers * self.experts.num_experts + 8
         while True:
             emap, pools, resident = self.experts.dispatch_operands()
-            with eng.mesh:
-                out = fn(*(args + extra + ((emap, pools), )))
+            out = self._dispatch(fn, args + extra + ((emap, pools), ), args)
             counts = np.asarray(jax.device_get(out[-1]))
             used = counts > 0
             if not self.experts.missing(used, resident).any():
@@ -1390,6 +1524,14 @@ class DecodeScheduler:
             self.expert_replays += 1
             if self.telemetry.enabled:
                 self.telemetry.counter("serving/expert_replays")
+                # goodput: a miss-replay re-runs the whole step program and
+                # discards the garbage forward — every column dispatched this
+                # round was wasted work (the replay recomputes it)
+                if self.capacity is not None and counts.size:
+                    L = max(1, self.experts.num_layers)
+                    topk = max(1, getattr(self.experts, "top_k", 1) or 1)
+                    self.capacity.account(
+                        0, wasted_tokens=float(counts.sum()) / (L * topk))
             if replays > max_replays:
                 raise RuntimeError(
                     f"cold-expert replay did not converge after {replays} "
@@ -1860,6 +2002,10 @@ class DecodeScheduler:
                 fn = self._compiled.get(key)
                 if fn is None:
                     fn = self._compiled[key] = builder()
+        if self.capacity is not None:
+            # roofline registry (telemetry/capacity.py): idempotent, so a
+            # shared-cache replica registers its siblings' programs too
+            self.capacity.register(key, fn)
         return fn
 
     def _jit_step(self, fn, aux_outs, donate):

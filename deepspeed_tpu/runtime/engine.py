@@ -306,6 +306,18 @@ class DeepSpeedEngine:
         if self.telemetry.enabled and self.telemetry.slo_config.get("objectives"):
             from ..telemetry import SLOEngine
             self._slo = SLOEngine(self.telemetry, self.telemetry.slo_config)
+        # on-demand XLA profiling (telemetry/profiler.py): captures
+        # requested via request_profile() start at the next REPORT boundary
+        # (never mid-dispatch); telemetry.profile_report_s > 0 auto-arms one
+        # capture of that duration at the first report interval
+        self.profiler = None
+        if self.telemetry.enabled:
+            from ..telemetry.profiler import XlaProfiler
+            self.profiler = XlaProfiler(self.telemetry.output_path)
+            auto_s = float(getattr(self._config.telemetry,
+                                   "profile_report_s", 0.0) or 0.0)
+            if auto_s > 0.0:
+                self.profiler.request(auto_s)
         self._fwd_since_step = 0  # facade micro-steps since the last step()
         self._facade_t0 = None
 
@@ -1564,6 +1576,24 @@ class DeepSpeedEngine:
             tel.gauges(scalars)
             if self._slo is not None:
                 self._slo.maybe_evaluate()
+            if self.profiler is not None:
+                # report-boundary capture point: starts a pending
+                # request_profile() and reaps an overdue capture
+                started = self.profiler.maybe_capture(tag="report")
+                if started is not None:
+                    log_dist(f"xla profile capture started: {started}", [0])
+
+    def request_profile(self, duration_s=1.0):
+        """Arm a duration-bounded XLA device-trace capture that begins at
+        the next report interval (``steps_per_print`` boundary) — traces
+        land under the telemetry output path, one ``xla_trace_*`` directory
+        per capture. Raises when telemetry is disabled; raises
+        :class:`~deepspeed_tpu.telemetry.profiler.ProfileBusy` when a
+        capture is already in flight or pending."""
+        if self.profiler is None:
+            raise RuntimeError("request_profile requires telemetry.enabled "
+                               "(the trace needs an output path)")
+        self.profiler.request(duration_s)
 
     # ------------------------------------------------------------------ data
     def deepspeed_io(self, dataset, batch_size=None, route=None, data_sampler=None, collate_fn=None, num_local_io_workers=None):

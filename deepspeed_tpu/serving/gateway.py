@@ -240,6 +240,13 @@ class Gateway:
         # interrupting a flush on the same thread would self-deadlock on
         # the non-reentrant io lock; the pump thread performs the dump
         self._flight_request = None
+        # on-demand XLA profiling (POST /v1/debug/profile): duration-bounded
+        # captures written next to the flight dumps; one per process — a
+        # second request while one is in flight gets 409
+        self.profiler = None
+        if self.telemetry.enabled:
+            from ..telemetry.profiler import XlaProfiler
+            self.profiler = XlaProfiler(self.telemetry.output_path)
 
     # ------------------------------------------------------------------ lifecycle
     def start_background(self, timeout=120.0):
@@ -317,8 +324,11 @@ class Gateway:
     def close(self, timeout=None):
         """begin_drain + wait_drained, for tests/benches."""
         self.begin_drain()
-        return self.wait_drained(timeout if timeout is not None
+        done = self.wait_drained(timeout if timeout is not None
                                  else self.config.drain_timeout_s + 30)
+        if self.profiler is not None:
+            self.profiler.stop()  # a capture must not outlive the gateway
+        return done
 
     async def _serve(self, ready_cb):
         self._loop = asyncio.get_running_loop()
@@ -418,6 +428,10 @@ class Gateway:
                 if self._flight_request is not None:
                     reason, self._flight_request = self._flight_request, None
                     self.telemetry.dump_flight(reason)
+                if self.profiler is not None:
+                    # belt-and-braces deadline: stops an overdue capture
+                    # even if its timer thread was lost
+                    self.profiler.poll()
             if rep.idle() or rep.sick:
                 if self.draining and not len(self._fair) and not self._active:
                     break
@@ -790,6 +804,28 @@ class Gateway:
                                  {"path": dump,
                                   "note": "file lands after the recorder's "
                                           "post-window elapses"})
+        elif method == "POST" and path == "/v1/debug/profile":
+            if self.profiler is None:
+                await self._json(writer, 503,
+                                 {"error": {"message": "telemetry disabled: "
+                                            "no profile output path"}})
+            else:
+                try:
+                    req = json.loads(body) if body else {}
+                except ValueError:
+                    req = {}
+                duration_s = float(req.get("duration_ms", 1000.0) or 1000.0) / 1e3
+                from ..telemetry.profiler import ProfileBusy
+                try:
+                    trace_dir = self.profiler.start(duration_s, tag="ondemand")
+                except ProfileBusy as e:
+                    await self._json(writer, 409, {"error": {"message": str(e)}})
+                else:
+                    await self._json(writer, 200,
+                                     {"path": trace_dir,
+                                      "duration_ms": duration_s * 1e3,
+                                      "note": "trace files land when the "
+                                              "capture window elapses"})
         elif method == "GET" and path == "/v1/replicas":
             await self._json(writer, 200, {"replicas": self.replicas.states()})
         elif method == "POST" and path.startswith("/v1/replicas/"):
@@ -918,6 +954,18 @@ class Gateway:
             "expert_store": (sched.experts.stats()
                              if sched.experts is not None else None),
             "replicas": self.replicas.states(),
+            # capacity rollup (telemetry/capacity.py): per-compiled-program
+            # roofline table + goodput + host-gap totals for the primary
+            # scheduler; the live gauges are in the telemetry snapshot
+            "capacity": ({
+                "programs": sched.capacity.program_table(),
+                "goodput_fraction": sched.capacity.goodput_fraction,
+                "samples": sched.capacity.samples,
+                "host_gaps": sched._gap.gaps,
+                "host_gap_total_s": round(sched._gap.total_gap_s, 6),
+                "profiling": (self.profiler.active
+                              if self.profiler is not None else None),
+            } if sched.capacity is not None else None),
             # disaggregated serving rollup (per-replica phase_role and
             # migrations_{out,in} are in the replicas list above)
             "disaggregation": ({
@@ -1235,6 +1283,7 @@ class Gateway:
 
     # ------------------------------------------------------------------ HTTP writing
     _REASONS = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                409: "Conflict",
                 413: "Content Too Large", 429: "Too Many Requests",
                 431: "Request Header Fields Too Large",
                 503: "Service Unavailable", 504: "Gateway Timeout",

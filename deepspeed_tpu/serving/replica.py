@@ -131,6 +131,10 @@ class Replica:
     def __init__(self, idx, scheduler, telemetry=None, phase_role="mixed"):
         self.idx = idx
         self.scheduler = scheduler
+        # request traces stamp the replica that executed each phase (the
+        # migration-aware tools/trace_summary.py --requests view pairs a
+        # prefill replica with the decode replica that adopted the handoff)
+        scheduler.replica_idx = idx
         self.telemetry = telemetry if telemetry is not None else scheduler.telemetry
         self.draining = False
         self.sick = False
@@ -236,6 +240,13 @@ class Replica:
             "dispatched": self.dispatched,
             "tokens": self.tokens,
             "tok_s": round(self.tok_s, 2),
+            # capacity accounting (telemetry/capacity.py): this replica's
+            # own pump-thread host-gap totals and goodput — per-replica
+            # because each pump fences and attributes independently
+            "goodput_fraction": (round(s.capacity.goodput_fraction, 5)
+                                 if s.capacity is not None else None),
+            "host_gap_total_s": (round(s._gap.total_gap_s, 4)
+                                 if s._gap is not None else None),
             "ema_service_s": self.ema_service_s,
             "tp_size": s.tp_size,
             "ep_size": s.ep_size,

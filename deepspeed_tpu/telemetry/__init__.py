@@ -2,8 +2,9 @@
 windowed histograms with JSONL + Perfetto/Chrome-trace export), plus the
 production observability layer on top of it: request-scoped tracing
 (``tracing``), the SLO burn-rate engine (``slo``), the anomaly flight
-recorder (``flight_recorder``), and Prometheus text exposition
-(``prometheus``).
+recorder (``flight_recorder``), Prometheus text exposition
+(``prometheus``), serving roofline/goodput/host-gap capacity accounting
+(``capacity``), and on-demand XLA device profiling (``profiler``).
 
 See ``benchmarks/OBSERVABILITY.md`` for the config keys, the event schema,
 and how to open the exported trace in Perfetto.
@@ -13,3 +14,5 @@ from .sink import TelemetrySink, get_sink, set_sink  # noqa: F401
 from .tracing import RequestTrace, extract_trace_context, make_trace_id  # noqa: F401
 from .slo import DEFAULT_SERVING_OBJECTIVES, SLOEngine  # noqa: F401
 from .flight_recorder import FlightRecorder  # noqa: F401
+from .capacity import CapacityMeter, CapacityModel, HostGapTracker  # noqa: F401
+from .profiler import ProfileBusy, XlaProfiler  # noqa: F401

@@ -1,0 +1,346 @@
+"""Serving capacity accounting: per-program roofline registry, sampled
+fenced dispatch timing, host-gap attribution, and goodput.
+
+Training has reported MFU since PR 1 (``runtime/engine``'s interval
+gauges), but serving had no utilization accounting at all — an operator
+could see tok/s fall without any way to tell *device is slow* apart from
+*host is starving the device*. This module closes that gap with three
+cooperating pieces, all owned by the scheduler's pump thread and all
+no-ops when the telemetry sink is disabled:
+
+- :class:`CapacityModel` — analytic FLOPs and HBM bytes per dispatched
+  step program, derived from the model config and the dispatch's batch
+  shape (live rows, per-row context, query columns, K substeps). The
+  numbers count what the DEVICE executes (the full padded slot block),
+  which is what makes the live MFU/bandwidth gauges roofline-honest and
+  lets a test cross-check them against ``jit(...).lower().cost_analysis()``.
+
+- :class:`CapacityMeter` — the per-compiled-program registry. Every
+  program the scheduler builds (fused/spec/prefill/copy/tier_slice/
+  tier_restore, LoRA variants included) registers here at warm/build
+  time; a *sampled* fenced-timing window (every ``sample_every``-th sync,
+  default 1/32 — the async dispatch pipeline is never fenced on the hot
+  path) turns one dispatch's wall time into ``serving/mfu``,
+  ``serving/hbm_bw_util``, and a per-program-kind roofline classification
+  gauge (``serving/roofline/<kind>``: analytic arithmetic intensity over
+  the machine balance — >= 1 means compute-bound, < 1 bandwidth-bound).
+  Sampling uses only ``block_until_ready`` on arrays the program already
+  produced, so it adds ZERO new XLA programs after warmup. The meter also
+  owns goodput: useful vs wasted token-FLOPs (speculative rejected
+  columns, MoE miss-replay dispatches, migration/restore traffic
+  converted at the machine balance) rolled into the
+  ``serving/goodput_fraction`` gauge.
+
+- :class:`HostGapTracker` — device-idle attribution for the pump thread.
+  The gap between one sync's fence and the next dispatch is pure host
+  time; the scheduler stamps its admission / trie-probe / sampling-host /
+  on_token-delivery / tier-transfer sections into the open gap and the
+  tracker emits a ``serving/host_gap_ms`` histogram plus per-bucket
+  ``serving/host_gap/<bucket>_ms`` counters whose sum equals the measured
+  gap exactly (residue lands in ``other``; over-attribution from timer
+  overlap is scaled back proportionally).
+
+Everything here is stdlib + numpy on the host side; the only device
+interaction is the sampled fence.
+"""
+
+import numpy as np
+
+# host-gap attribution buckets, in emission order. "other" is the residue
+# between the measured gap and the stamped sections — it absorbs pump-loop
+# overhead, GIL waits, and anything not explicitly instrumented.
+GAP_BUCKETS = ("admission", "trie_probe", "sampling_host", "on_token",
+               "tier_transfer", "other")
+
+_GATED_ACTS = ("swiglu", "geglu")
+
+
+def _cfg(model_config, name, default=None):
+    return getattr(model_config, name, default)
+
+
+class CapacityModel:
+    """Analytic FLOPs/HBM-bytes for one transformer step dispatch.
+
+    All coefficients are precomputed from the model config at build so the
+    per-sample cost is a handful of float multiplies. ``matmul_flops_per_col``
+    counts every projection, the ACTIVE expert MLPs (``moe_top_k`` of
+    ``num_experts``; dense models count one), and the LM head — per query
+    column, full slot block (the program computes padded rows too).
+    Attention score/value FLOPs scale with each live row's context and are
+    added per dispatch."""
+
+    __slots__ = ("matmul_flops_per_col", "attn_flops_per_ctx_tok",
+                 "weight_read_bytes", "kv_bytes_per_token", "num_slots")
+
+    def __init__(self, model_config, kv_bytes_per_token, num_slots,
+                 tp_size=1, ep_size=1):
+        h = int(_cfg(model_config, "hidden_size", 0) or 0)
+        L = int(_cfg(model_config, "num_layers", 0) or 0)
+        nh = int(_cfg(model_config, "num_heads", 1) or 1)
+        kvh = int(_cfg(model_config, "kv_heads", nh) or nh)
+        hd = int(_cfg(model_config, "head_size", max(1, h // max(1, nh))))
+        ffn = int(_cfg(model_config, "ffn_size", 4 * h) or 4 * h)
+        V = int(_cfg(model_config, "vocab_size", 0) or 0)
+        E = int(_cfg(model_config, "num_experts", 0) or 0)
+        topk = int(_cfg(model_config, "moe_top_k", 1) or 1)
+        act = str(_cfg(model_config, "activation", "gelu"))
+        mlp_mats = 3 if act in _GATED_ACTS else 2
+
+        attn_proj = L * (h * hd * (nh + 2 * kvh)  # qkv
+                         + nh * hd * h)           # o
+        mlp_active = L * mlp_mats * h * ffn * (min(topk, E) if E > 0 else 1)
+        mlp_total = L * mlp_mats * h * ffn * (E if E > 0 else 1)
+        lm_head = h * V
+        active_params = attn_proj + mlp_active + lm_head
+        # 2 FLOPs per MAC; per query column the program runs every matmul
+        self.matmul_flops_per_col = 2.0 * active_params
+        # QK^T + AV: 2 matmuls x 2 FLOPs x (heads*head_dim) per context
+        # token per query column, per layer
+        self.attn_flops_per_ctx_tok = 4.0 * L * nh * hd
+        # active weights read once per on-device step (the K-step loop
+        # re-reads them each iteration); router/embeddings are noise
+        dtype_bytes = 2  # serving compute dtype is bf16/int8-dequant — 2B
+        # is the honest upper bound either way
+        try:
+            dtype_bytes = np.dtype(
+                np.asarray(0, _cfg(model_config, "dtype")).dtype).itemsize
+        except Exception:  # noqa: BLE001 — unknown dtype: keep the bf16 bound
+            pass
+        self.weight_read_bytes = float((attn_proj + mlp_active + lm_head)
+                                       * dtype_bytes)
+        del mlp_total
+        self.kv_bytes_per_token = float(kv_bytes_per_token)
+        self.num_slots = int(num_slots)
+
+    def dispatch_cost(self, live_ctx, width, ksteps):
+        """(flops, hbm_bytes) for ONE step dispatch: ``width`` query columns
+        over the full slot block plus ``ksteps - 1`` single-column substeps,
+        with ``live_ctx`` the live rows' context lengths (attention + KV
+        traffic scale with these)."""
+        ksteps = max(1, int(ksteps))
+        cols_full = self.num_slots * (max(1, int(width)) + (ksteps - 1))
+        ctx_sum = float(np.sum(live_ctx)) if len(live_ctx) else 0.0
+        cols_per_row = max(1, int(width)) + (ksteps - 1)
+        flops = (cols_full * self.matmul_flops_per_col
+                 + cols_per_row * ctx_sum * self.attn_flops_per_ctx_tok)
+        bytes_ = ksteps * (self.weight_read_bytes
+                           + ctx_sum * self.kv_bytes_per_token)
+        return flops, bytes_
+
+    def flops_per_token(self, ctx):
+        """Per useful token at context ``ctx`` — the goodput unit."""
+        return (self.matmul_flops_per_col
+                + float(ctx) * self.attn_flops_per_ctx_tok)
+
+
+def program_shape(key):
+    """(width, ksteps) batch shape encoded in a compiled-program cache key:
+    fused keys carry (chunk, ksteps), spec keys carry the draft width (the
+    verify program scores ``width`` columns in one pass); everything else
+    (prefill/copy/tier ops) is shape-accounted as a single column."""
+    if isinstance(key, tuple) and len(key) >= 5 and key[0] == "fused":
+        return int(key[3]), int(key[4])
+    if isinstance(key, tuple) and len(key) >= 4 and key[0] == "spec":
+        return int(key[3]), 1
+    return 1, 1
+
+
+def _program_kind(key):
+    """Registry kind for a compiled-program cache key: the key's leading
+    tag (``fused``/``spec``/``prefill``/``copy``/``tier_slice``/...),
+    ``+lora`` suffixed for adapter variants."""
+    if isinstance(key, tuple):
+        kind = str(key[0])
+        if key and key[-1] == "lora":
+            kind += "+lora"
+        return kind
+    return str(key)
+
+
+class CapacityMeter:
+    """Per-compiled-program roofline registry + sampled fenced timing +
+    goodput accounting. One instance per scheduler; only built when the
+    sink is enabled (the disabled path allocates nothing)."""
+
+    def __init__(self, sink, model, *, peak_flops, peak_hbm_bw, n_devices=1,
+                 sample_every=32):
+        self.sink = sink
+        self.model = model
+        self.peak_flops = float(peak_flops) * max(1, int(n_devices))
+        self.peak_hbm_bw = float(peak_hbm_bw) * max(1, int(n_devices))
+        # machine balance: FLOPs/byte at the roofline ridge point
+        self.balance = self.peak_flops / max(1.0, self.peak_hbm_bw)
+        self.sample_every = max(1, int(sample_every))
+        self.programs = {}      # key -> {"kind", "samples", "mfu", "bw", ...}
+        self._by_id = {}        # id(fn) -> key
+        self.samples = 0
+        # goodput accumulators (token-FLOPs)
+        self.useful_flops = 0.0
+        self.wasted_flops = 0.0
+
+    # ---------------------------------------------------------------- registry
+    def register(self, key, fn):
+        """Idempotently register a compiled program under its cache key —
+        called from the scheduler's program-cache lookup, so shared-cache
+        replicas register the same fn once per scheduler at zero cost."""
+        if id(fn) in self._by_id:
+            return
+        self._by_id[id(fn)] = key
+        self.programs.setdefault(
+            key, {"kind": _program_kind(key), "samples": 0,
+                  "mfu": 0.0, "hbm_bw_util": 0.0, "intensity": 0.0})
+
+    def key_for(self, fn):
+        return self._by_id.get(id(fn))
+
+    def should_sample(self, sync_seq):
+        return sync_seq % self.sample_every == 0
+
+    # ---------------------------------------------------------------- sampling
+    def observe_dispatch(self, key, dur_s, live_ctx, width, ksteps):
+        """Fold one fenced dispatch sample into the live gauges. ``dur_s``
+        is the fence-to-fence wall time of the dispatch alone."""
+        if dur_s <= 0.0:
+            return
+        flops, bytes_ = self.model.dispatch_cost(live_ctx, width, ksteps)
+        mfu = flops / dur_s / self.peak_flops
+        bw = bytes_ / dur_s / self.peak_hbm_bw
+        intensity = flops / max(1.0, bytes_)
+        self.samples += 1
+        ent = self.programs.get(key)
+        if ent is None:
+            self.register(key, object())  # unkeyed dispatch: still account
+            ent = self.programs[key]
+        ent["samples"] += 1
+        ent["mfu"] = mfu
+        ent["hbm_bw_util"] = bw
+        ent["intensity"] = intensity
+        sink = self.sink
+        if sink is not None and sink.enabled:
+            sink.gauge("serving/mfu", mfu)
+            sink.gauge("serving/hbm_bw_util", bw)
+            # >= 1: compute-bound (intensity past the ridge); < 1: the
+            # program is bandwidth-bound at this batch shape
+            sink.gauge(f"serving/roofline/{ent['kind']}",
+                       intensity / max(1e-9, self.balance))
+            sink.counter("serving/capacity_samples")
+
+    # ---------------------------------------------------------------- goodput
+    def account(self, useful_tokens, wasted_tokens=0, ctx=0.0,
+                wasted_bytes=0.0):
+        """Fold one sync's goodput inputs: tokens delivered to requests,
+        tokens computed-then-discarded (rejected speculative columns, MoE
+        miss replays), and pure-traffic waste (migration demote/restore,
+        evicted-then-recomputed prefixes) in bytes — converted to
+        FLOP-equivalents at the machine balance so one fraction covers
+        both compute and bandwidth waste."""
+        ft = self.model.flops_per_token(ctx)
+        self.useful_flops += max(0, useful_tokens) * ft
+        wasted = max(0, wasted_tokens) * ft
+        if wasted_bytes > 0.0:
+            wasted += float(wasted_bytes) * self.balance
+        self.wasted_flops += wasted
+        sink = self.sink
+        if sink is not None and sink.enabled:
+            if wasted > 0.0:
+                sink.counter("serving/goodput/wasted_token_flops", int(wasted))
+            total = self.useful_flops + self.wasted_flops
+            if total > 0.0:
+                sink.gauge("serving/goodput_fraction",
+                           self.useful_flops / total)
+
+    @property
+    def goodput_fraction(self):
+        total = self.useful_flops + self.wasted_flops
+        return self.useful_flops / total if total > 0.0 else 1.0
+
+    # ---------------------------------------------------------------- snapshot
+    def program_table(self):
+        """Registry view for ``/v1/metrics`` extra surfaces / debugging:
+        per-program kind, sample count, last MFU/bandwidth/roofline class."""
+        out = {}
+        for key, ent in self.programs.items():
+            out[str(key)] = {
+                "kind": ent["kind"], "samples": ent["samples"],
+                "mfu": round(ent["mfu"], 5),
+                "hbm_bw_util": round(ent["hbm_bw_util"], 5),
+                "bound": ("compute" if ent["intensity"] >= self.balance
+                          else "bandwidth"),
+            }
+        return out
+
+
+class HostGapTracker:
+    """Device-idle (host-gap) attribution for one pump thread.
+
+    Lifecycle per sync: the scheduler calls :meth:`sync_end` when a
+    dispatch's results are fenced on the host (the device goes idle),
+    stamps host sections into the open gap via :meth:`add`, and calls
+    :meth:`dispatch` the moment the next program is handed to the device —
+    closing the gap, normalizing attribution so the per-bucket counters
+    sum EXACTLY to the measured gap, and emitting the histogram. All
+    methods are single-float arithmetic; the tracker is only constructed
+    when the sink is enabled."""
+
+    __slots__ = ("sink", "_open_ts", "_acc", "gaps", "total_gap_s")
+
+    def __init__(self, sink):
+        self.sink = sink
+        self._open_ts = None
+        self._acc = {b: 0.0 for b in GAP_BUCKETS if b != "other"}
+        self.gaps = 0
+        self.total_gap_s = 0.0
+
+    def sync_end(self, ts):
+        """Device results just landed on the host: the idle gap opens."""
+        self._open_ts = ts
+
+    def add(self, bucket, dur, steal_from=None):
+        """Stamp ``dur`` seconds of host work into ``bucket``.
+        ``steal_from`` moves the time out of an ENCLOSING section (e.g. the
+        trie probe runs inside the admission region) so nested timers never
+        double-count. The debit may land before the enclosing section is
+        stamped — the accumulator is allowed to go negative and is floored
+        at :meth:`dispatch`, so stamp order doesn't matter."""
+        if dur <= 0.0:
+            return
+        self._acc[bucket] += dur
+        if steal_from is not None:
+            self._acc[steal_from] -= dur
+
+    def dispatch(self, ts):
+        """The next program is being handed to the device: close the gap,
+        emit, and reset. A dispatch before any sync (warmup) just clears
+        the accumulators."""
+        open_ts, self._open_ts = self._open_ts, None
+        acc = self._acc
+        if open_ts is None:
+            for b in acc:
+                acc[b] = 0.0
+            return
+        gap = max(0.0, ts - open_ts)
+        for b in acc:  # floor deferred-steal debits (see :meth:`add`)
+            if acc[b] < 0.0:
+                acc[b] = 0.0
+        attributed = sum(acc.values())
+        if attributed > gap > 0.0:
+            # timer overlap / clock skew: scale back so the invariant
+            # "buckets sum to the measured gap" holds exactly
+            scale = gap / attributed
+            for b in acc:
+                acc[b] *= scale
+            attributed = gap
+        other = max(0.0, gap - attributed)
+        self.gaps += 1
+        self.total_gap_s += gap
+        sink = self.sink
+        if sink is not None and sink.enabled:
+            sink.histogram("serving/host_gap_ms", gap * 1e3)
+            for b, v in acc.items():
+                if v > 0.0:
+                    sink.counter(f"serving/host_gap/{b}_ms", v * 1e3)
+            if other > 0.0:
+                sink.counter("serving/host_gap/other_ms", other * 1e3)
+        for b in acc:
+            acc[b] = 0.0

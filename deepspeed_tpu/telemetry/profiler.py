@@ -1,0 +1,132 @@
+"""On-demand XLA device profiling, duration-bounded and race-safe.
+
+A burn-rate alert or a flight-recorder trip tells an operator *when*
+something went wrong; a real device trace tells them *what the device was
+doing*. This module wraps ``jax.profiler.start_trace``/``stop_trace`` in a
+small manager so a capture can be requested safely from any thread:
+
+- ``POST /v1/debug/profile`` (serving gateway) starts a capture of a
+  bounded duration; a second request while one is in flight gets 409.
+- The training engine polls :meth:`maybe_capture` at its report interval,
+  so a capture requested mid-run (``engine.request_profile(...)``) starts
+  at a step boundary instead of mid-dispatch.
+
+Traces land next to the flight dumps (the sink's ``output_path``), one
+directory per capture (``xla_trace_<seq>_<tag>/``), in the standard
+XLA/TensorBoard layout (``plugins/profile/<run>/*.xplane.pb``). Stopping
+is belt-and-braces: a daemon timer fires at the deadline AND
+:meth:`poll` (called from the gateway pump / engine report path) stops an
+overdue capture even if the timer thread was lost."""
+
+import os
+import threading
+import time
+
+
+class ProfileBusy(RuntimeError):
+    """A capture is already in flight (HTTP surfaces map this to 409)."""
+
+
+_MAX_DURATION_S = 120.0
+
+
+class XlaProfiler:
+    """Duration-bounded ``jax.profiler`` capture manager (one per process
+    surface: the gateway and the training engine each own one, writing
+    under the same telemetry output path)."""
+
+    def __init__(self, output_path):
+        self.output_path = output_path
+        self._lock = threading.Lock()
+        self._active = None      # {"dir", "deadline", "tag"} while capturing
+        self._seq = 0
+        self._pending = None     # requested duration awaiting a boundary
+        self.captures = []       # directories of completed captures
+
+    # ---------------------------------------------------------------- capture
+    def start(self, duration_s=1.0, tag="ondemand"):
+        """Begin a capture; returns the trace directory. Raises
+        :class:`ProfileBusy` when one is already in flight."""
+        duration_s = min(max(0.05, float(duration_s)), _MAX_DURATION_S)
+        safe = "".join(c if c.isalnum() or c in "-_" else "_" for c in str(tag))
+        with self._lock:
+            if self._active is not None:
+                raise ProfileBusy(
+                    f"a profile capture is already in flight "
+                    f"({self._active['dir']})")
+            self._seq += 1
+            trace_dir = os.path.join(self.output_path,
+                                     f"xla_trace_{self._seq:03d}_{safe}")
+            os.makedirs(trace_dir, exist_ok=True)
+            import jax
+            jax.profiler.start_trace(trace_dir)
+            self._active = {"dir": trace_dir, "tag": safe,
+                            "deadline": time.monotonic() + duration_s}
+        timer = threading.Timer(duration_s, self._stop_if_due, args=(True, ))
+        timer.daemon = True
+        timer.start()
+        return trace_dir
+
+    def _stop_if_due(self, force=False):
+        with self._lock:
+            active = self._active
+            if active is None:
+                return None
+            if not force and time.monotonic() < active["deadline"]:
+                return None
+            self._active = None
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — a failed stop must not
+                pass           # wedge the manager (capture dir stays partial)
+            self.captures.append(active["dir"])
+            return active["dir"]
+
+    def poll(self):
+        """Stop an overdue capture (cheap; call from pump/report loops).
+        Returns the finished trace dir when this call stopped one."""
+        if self._active is None:
+            return None
+        return self._stop_if_due(force=False)
+
+    def stop(self):
+        """Force-stop the in-flight capture (process shutdown)."""
+        return self._stop_if_due(force=True)
+
+    @property
+    def active(self):
+        a = self._active
+        return dict(a) if a is not None else None
+
+    # ------------------------------------------------------- training boundary
+    def request(self, duration_s=1.0):
+        """Ask for a capture at the next report boundary (training engine).
+        Raises :class:`ProfileBusy` when one is in flight or pending."""
+        with self._lock:
+            if self._active is not None or self._pending is not None:
+                raise ProfileBusy("a profile capture is already in flight "
+                                  "or pending")
+            self._pending = min(max(0.05, float(duration_s)), _MAX_DURATION_S)
+
+    def maybe_capture(self, tag="report"):
+        """Report-interval hook: start the pending capture, if any. Also
+        stops an overdue one. Returns the trace dir when a capture began."""
+        self.poll()
+        with self._lock:
+            pending, self._pending = self._pending, None
+        if pending is None:
+            return None
+        return self.start(pending, tag=tag)
+
+
+def trace_artifacts(trace_dir):
+    """The device-trace artifact files under one capture directory (the
+    ``.xplane.pb`` / ``.trace.json.gz`` files TensorBoard loads) — what
+    the tests and the gateway response use to prove the capture is real."""
+    out = []
+    for root, _dirs, files in os.walk(trace_dir):
+        for f in files:
+            if f.endswith((".xplane.pb", ".trace.json.gz", ".trace.json")):
+                out.append(os.path.join(root, f))
+    return sorted(out)

@@ -11,9 +11,11 @@ a standard scraper pointed at ``GET /v1/metrics`` with the usual
   per-tenant/per-group/per-replica metric-name explosion.
 - gauges   -> ``# TYPE ... gauge`` (``serving/replica/<id>/...`` gauges
   fold into labeled series the same way).
-- histograms -> ``# TYPE ... summary`` (the sink keeps windowed quantiles,
-  not cumulative buckets): ``{quantile="0.5|0.95|0.99"}`` + ``_sum`` +
-  ``_count``.
+- histograms -> ``# TYPE ... summary`` (windowed quantiles:
+  ``{quantile="0.5|0.95|0.99"}`` + ``_sum`` + ``_count``) PLUS a parallel
+  ``<name>_hist`` native histogram family — lifetime cumulative
+  ``_bucket``/``le`` counts on the sink's fixed ladder, so external
+  alerting can compute its own quantiles over any rate() window.
 
 Everything is prefixed ``dstpu_`` and sanitized to the metric-name charset.
 Stdlib-only by design (same budget as the gateway).
@@ -140,6 +142,20 @@ def render(snapshot, extra_gauges=None):
             lines.append(f'{name}{{quantile="{q}"}} {_fmt(h[key])}')
         lines.append(f"{name}_sum {_fmt(h['sum'])}")
         lines.append(f"{name}_count {_fmt(h['count'])}")
+        # native histogram alongside the summary (a metric can't be both
+        # types, so the bucketed family rides a ``_hist`` suffix): lifetime
+        # cumulative counts on the sink's fixed ladder — external alerting
+        # computes its own quantiles over ANY window via rate(), which the
+        # sliding-window summary can't offer
+        buckets = h.get("buckets")
+        if buckets:
+            hname = name + "_hist"
+            header(hname, "histogram")
+            for le, cum in buckets:
+                lines.append(f'{hname}_bucket{{le="{_fmt(le)}"}} {_fmt(cum)}')
+            lines.append(f'{hname}_bucket{{le="+Inf"}} {_fmt(h["count"])}')
+            lines.append(f"{hname}_sum {_fmt(h['sum'])}")
+            lines.append(f"{hname}_count {_fmt(h['count'])}")
 
     uptime = snapshot.get("uptime_s")
     if uptime is not None:

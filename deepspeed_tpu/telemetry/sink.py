@@ -57,6 +57,7 @@ import json
 import os
 import threading
 import time
+from bisect import bisect_right
 from collections import deque
 
 # cap on retained chrome-trace events; beyond it new spans still reach the
@@ -100,6 +101,13 @@ def _percentile(ordered, q):
 # covers between (chunks-1)/chunks and 1x the configured window
 _HIST_CHUNKS = 6
 
+# cumulative-bucket ladder for Prometheus native histograms (ms-scale
+# latencies are the dominant unit; the +Inf bucket is implicit). Lifetime
+# counts, like count/sum — external alerting can rate() them over any
+# window, which the sliding-window quantiles can't offer.
+HIST_BUCKET_BOUNDS = (0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+                      250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
 
 class _WindowedHistogram:
     """Sliding-window value distribution with bounded memory.
@@ -115,7 +123,7 @@ class _WindowedHistogram:
     window."""
 
     __slots__ = ("window_s", "chunk_cap", "chunk_s", "attrs", "count", "sum",
-                 "window_seen", "_chunks", "_seed")
+                 "window_seen", "_chunks", "_seed", "bucket_counts")
 
     def __init__(self, window_s, max_samples, attrs=None):
         self.window_s = max(1e-3, float(window_s))
@@ -127,6 +135,9 @@ class _WindowedHistogram:
         self.window_seen = 0    # observations currently inside the window
         self._chunks = deque()  # (chunk_start_ts, seen_in_chunk, [samples])
         self._seed = 0x9E3779B9
+        # lifetime per-bucket counts on the fixed ladder (+Inf implicit at
+        # the end) — the Prometheus-native histogram series
+        self.bucket_counts = [0] * (len(HIST_BUCKET_BOUNDS) + 1)
 
     def _rand(self, n):
         # LCG (numerical recipes constants): reproducible, allocation-free
@@ -143,6 +154,7 @@ class _WindowedHistogram:
         self.count += 1
         self.sum += value
         self.window_seen += 1
+        self.bucket_counts[bisect_right(HIST_BUCKET_BOUNDS, value)] += 1
         if not self._chunks or ts - self._chunks[-1][0] >= self.chunk_s:
             self._chunks.append([ts, 1, [value]])
             return
@@ -253,6 +265,11 @@ class TelemetrySink:
         # it before building RequestTrace objects / iteration spans)
         self.trace_requests = bool(_cfg_get(config, "request_tracing", True))
         self.slo_config = dict(_cfg_get(config, "slo", None) or {})
+        # roofline/goodput capacity accounting (telemetry/capacity.py):
+        # fence-and-time every Nth scheduler sync (1 = every sync — tests
+        # only; 0/absent = the 1/32 default)
+        self.capacity_sample_every = max(1, int(
+            _cfg_get(config, "capacity_sample_every", 32) or 32))
         self._monitor = monitor
         self._lock = threading.RLock()
         self._io_lock = threading.Lock()  # serializes JSONL appends/trace writes
@@ -551,7 +568,8 @@ class TelemetrySink:
         hists = {}
         for name, h in self._hists.items():
             samples, seen = h.window_samples(ts)
-            hists[name] = (list(samples), seen, h.count, h.sum, h.attrs)
+            hists[name] = (list(samples), seen, h.count, h.sum, h.attrs,
+                           list(h.bucket_counts))
         return counters, hists
 
     def _summarize(self, counters, hists, ts):
@@ -560,7 +578,7 @@ class TelemetrySink:
         for name, (count, total, attrs) in counters.items():
             out.append({"type": "counter", "name": name, "count": count, "total": total,
                         "ts": ts, **({"attrs": attrs} if attrs else {})})
-        for name, (samples, seen, count, total, attrs) in hists.items():
+        for name, (samples, seen, count, total, attrs, _buckets) in hists.items():
             out.append(summarize_histogram(name, samples, ts, count=count,
                                            total=total, window_seen=seen,
                                            window_s=self.hist_window_s,
@@ -701,13 +719,23 @@ class TelemetrySink:
         counters = {name: {"count": c, "total": t}
                     for name, (c, t, _attrs) in counters_raw.items()}
         hists = {}
-        for name, (samples, seen, count, total, attrs) in hists_raw.items():
+        for name, (samples, seen, count, total, attrs,
+                   buckets) in hists_raw.items():
             line = summarize_histogram(name, samples, ts, count=count,
                                        total=total, window_seen=seen,
                                        window_s=self.hist_window_s, attrs=attrs)
             line.pop("type")
             line.pop("name")
             line.pop("ts")
+            # lifetime cumulative bucket counts on the fixed ladder — what
+            # telemetry/prometheus.py renders as native ``_bucket``/``le``
+            # series (the +Inf bucket equals ``count``)
+            cum = []
+            running = 0
+            for le, n in zip(HIST_BUCKET_BOUNDS, buckets):
+                running += n
+                cum.append([le, running])
+            line["buckets"] = cum
             hists[name] = line
         return {"counters": counters, "gauges": gauges, "histograms": hists,
                 "uptime_s": round(self.now(), 3)}

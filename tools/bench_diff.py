@@ -56,7 +56,11 @@ _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
                  "shed", "evict", "evictions", "evicts", "miss", "misses",
                  "s", "seconds", "loss", "ppl", "perplexity", "spill",
                  "spills", "dropped", "swaps", "degradation", "pending",
-                 "failed", "loads", "replays", "programs"}
+                 "failed", "loads", "replays", "programs", "gap"}
+# capacity-leg directionality: "gap" (host_gap_total_s — device idle time)
+# reads lower-is-better; mfu / hbm_bw_util / goodput_fraction /
+# instrumented_ratio stay on the higher-is-better default, so a sampled-
+# fencing overhead regression (ratio falling) flags without special-casing
 
 
 def _lower_better(path):

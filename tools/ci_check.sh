@@ -2,7 +2,7 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
@@ -13,6 +13,7 @@
 #   tools/ci_check.sh --multilora # multi-LoRA adapter-serving lane only
 #   tools/ci_check.sh --disagg   # disaggregated prefill/decode lane only
 #   tools/ci_check.sh --moe      # MoE serving (expert-parallel decode) lane only
+#   tools/ci_check.sh --capacity # serving capacity/roofline + profiling lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -36,6 +37,7 @@ guards() {
     tests/unit/serving/test_gateway.py \
     "tests/unit/inference/test_inference.py::test_paged_decode_kernel_vs_reference" \
     "tests/unit/inference/test_inference.py::test_decode_kernel_vs_reference" \
+    "tests/unit/inference/test_inference.py::test_fused_decode_block_matches_unfused" \
     -q -p no:cacheprovider
 }
 
@@ -159,6 +161,24 @@ moe_lane() {
     tests/unit/inference/test_moe_decode.py -q -p no:cacheprovider
 }
 
+capacity_lane() {
+  echo "== serving capacity/roofline lane =="
+  # serving goodput & capacity observability guards (telemetry/capacity.py
+  # + telemetry/profiler.py): sampled fenced roofline timing adds ZERO XLA
+  # programs over a fresh length/spec/adapter mix (jax.monitoring) and
+  # bounded decode overhead, host-gap buckets sum exactly to the measured
+  # gap, analytic FLOPs cross-check against jit(...).lower().cost_analysis(),
+  # the on-demand profile endpoint writes a loadable trace and 409s on
+  # overlap. test_profiling.py rides along: the training-side flops
+  # profiler + report-boundary capture share this surface (its slow nodeid
+  # lives in slow_tests.txt to keep tier-1 in budget). The matching perf
+  # leg is `python bench.py serving` ("capacity" entry: instrumented-vs-off
+  # tok/s ratio + live MFU/goodput, BENCH_SERVING_CAPACITY sample knob).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/serving/test_capacity.py \
+    tests/unit/test_profiling.py -q -p no:cacheprovider
+}
+
 bench_diff() {
   echo "== bench diff (advisory) =="
   # diff the given fresh bench JSON (or the latest committed round) against
@@ -226,6 +246,10 @@ if [ "${1:-}" = "--moe" ]; then
   moe_lane
   exit $?
 fi
+if [ "${1:-}" = "--capacity" ]; then
+  capacity_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -271,7 +295,10 @@ dg_rc=$?
 moe_lane
 me_rc=$?
 
+capacity_lane
+cp_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ]

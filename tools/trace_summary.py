@@ -15,7 +15,10 @@ Usage:
 ``--requests`` switches to the per-request view: request span trees are
 reconstructed from the gateway/scheduler trace events (``req/*`` spans
 keyed by their ``track`` id) and the top-K slowest requests print with
-their TTFT/ITL and phase breakdown (queued / prefill / decode ms).
+their TTFT/ITL and phase breakdown (queued / prefill / decode ms). When
+any shown request migrated between disaggregated replicas, the view adds
+the route (``r<prefill>>r<decode>``) and the handoff latency (the
+``req/migration`` span: demote -> parked -> restore, in ms).
 
 Event schema: see benchmarks/OBSERVABILITY.md.
 """
@@ -130,7 +133,9 @@ def summarize_requests(events):
         req = reqs.setdefault(track, {"track": track, "phases": OrderedDict(),
                                       "tenant": None, "tokens": 0,
                                       "ttft_ms": None, "itl_ms": None,
-                                      "reason": None, "start": None})
+                                      "reason": None, "start": None,
+                                      "prefill_replica": None,
+                                      "decode_replica": None})
         attrs = ev.get("attrs") or {}
         if req["tenant"] is None and attrs.get("tenant"):
             req["tenant"] = attrs["tenant"]
@@ -139,6 +144,16 @@ def summarize_requests(events):
             req["phases"][phase] = req["phases"].get(phase, 0.0) + float(ev.get("dur", 0.0))
             if req["start"] is None or ev["ts"] < req["start"]:
                 req["start"] = ev["ts"]
+        # disaggregated serving: pair the prefill replica (admitted /
+        # migrate_out) with the decode replica that adopted the handoff
+        # (migrated / the migration span) — format_requests prints the
+        # route and the handoff latency when any request migrated
+        if phase == "admitted" and attrs.get("replica") is not None:
+            req["prefill_replica"] = attrs["replica"]
+        elif phase == "migrate_out" and attrs.get("replica") is not None:
+            req["prefill_replica"] = attrs["replica"]
+        elif phase in ("migrated", "migration") and attrs.get("replica") is not None:
+            req["decode_replica"] = attrs["replica"]
         if phase in ("complete", "expired", "cancelled", "rejected"):
             req["reason"] = attrs.get("reason", phase)
             req["tokens"] = attrs.get("tokens", req["tokens"])
@@ -156,18 +171,34 @@ def format_requests(reqs, top=10, sort="ttft"):
     key = {"ttft": lambda r: r["ttft_ms"] or 0.0,
            "itl": lambda r: r["itl_ms"] or 0.0}[sort]
     ordered = sorted(reqs.values(), key=key, reverse=True)[:top]
+    # migration-aware layout: the route + handoff-latency columns only
+    # appear when at least one shown request actually migrated, so the
+    # colocated view stays byte-stable
+    migrated = any(r.get("decode_replica") is not None for r in ordered)
+    header = (f"{'request':<20s} {'tenant':<10s} {'tok':>4s} {'ttft ms':>9s} "
+              f"{'itl ms':>8s} {'queued':>8s} {'prefill':>8s} {'decode':>8s}")
+    if migrated:
+        header += f" {'route':>7s} {'migr ms':>8s}"
     lines = [f"top {len(ordered)} requests by {sort} (of {len(reqs)} traced):",
-             f"{'request':<20s} {'tenant':<10s} {'tok':>4s} {'ttft ms':>9s} "
-             f"{'itl ms':>8s} {'queued':>8s} {'prefill':>8s} {'decode':>8s}  reason"]
+             header + "  reason"]
     for r in ordered:
         ph = r["phases"]
-        lines.append(
+        line = (
             f"{r['track'][:18]:<20s} {str(r['tenant'] or '-')[:10]:<10s} "
             f"{r['tokens'] or 0:>4d} "
             f"{(r['ttft_ms'] or 0.0):>9.1f} {(r['itl_ms'] or 0.0):>8.2f} "
             f"{ph.get('queued', 0.0) * 1e3:>8.1f} "
             f"{ph.get('prefill', 0.0) * 1e3:>8.1f} "
-            f"{ph.get('decode', 0.0) * 1e3:>8.1f}  {r['reason'] or '?'}")
+            f"{ph.get('decode', 0.0) * 1e3:>8.1f}")
+        if migrated:
+            if r.get("decode_replica") is not None:
+                src = r.get("prefill_replica")
+                route = f"r{src if src is not None else '?'}>r{r['decode_replica']}"
+            else:
+                route = "-"
+            line += (f" {route:>7s} "
+                     f"{ph.get('migration', 0.0) * 1e3:>8.1f}")
+        lines.append(line + f"  {r['reason'] or '?'}")
     return "\n".join(lines)
 
 
