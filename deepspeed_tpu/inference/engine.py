@@ -59,6 +59,27 @@ def _sample_tokens(rng, logits, do_sample, temperature, top_k, top_p):
     return jax.random.categorical(rng, logits).astype(jnp.int32)
 
 
+class FusedDecodeEligibility:
+    """Structured result of the fused decode-block gate
+    (:meth:`InferenceEngine._fused_decode_eligible`): truthy iff the decode
+    loop can use ``ops/pallas/decode_block``; otherwise ``reasons`` names
+    EVERY failing condition — surfaced in the ready line, ``/v1/metrics``,
+    and ``_shard_desc()`` so an operator never has to guess why the fast
+    path didn't activate."""
+    __slots__ = ("eligible", "reasons")
+
+    def __init__(self, reasons=()):
+        self.reasons = tuple(reasons)
+        self.eligible = not self.reasons
+
+    def __bool__(self):
+        return self.eligible
+
+    def __repr__(self):
+        return (f"FusedDecodeEligibility(eligible={self.eligible}, "
+                f"reasons={list(self.reasons)})")
+
+
 class InferenceEngine:
     """Wraps a zoo model (or preset name) for TP-sharded generation."""
 
@@ -190,20 +211,18 @@ class InferenceEngine:
         self.module = type(model)(dataclasses.replace(model.cfg, **overrides))
         self.model_config = self.module.cfg
 
-        # fused decode-block gating (satellite of the MoE serving PR): the
-        # per-layer fused kernel has no expert dispatch, so an int8 MoE
-        # config that would otherwise fuse falls back to the per-projection
-        # path — say so LOUDLY (ready line + warning) instead of the old
-        # silent `num_experts == 0` check in _fused_decode_eligible
+        # fused decode-block gating: every failing condition gets a concrete
+        # reason (ready line + /v1/metrics + warning) instead of the old
+        # silent boolean chain. Only meaningful for int8 configs that asked
+        # for the fast path — an fp engine stays quiet.
         self._fused_decode_note = None
         if (self._int8_weights and cfg.fused_decode_block
-                and getattr(self.model_config, "num_experts", 0) > 0):
-            self._fused_decode_note = (
-                f"num_experts={self.model_config.num_experts}: the fused "
-                f"per-layer decode kernel has no expert dispatch; serving "
-                f"the per-projection MoE path")
-            logger.warning("init_inference(int8): fused decode-block disabled — "
-                           + self._fused_decode_note)
+                and hasattr(self.model_config, "int8_weights")):
+            elig = self._fused_decode_eligible()
+            if not elig:
+                self._fused_decode_note = "; ".join(elig.reasons)
+                logger.warning("init_inference(int8): fused decode-block disabled — "
+                               + self._fused_decode_note)
 
         # cold-expert host offload (continuous_batching.expert_offload):
         # expert kernels leave the device tree at materialization and page
@@ -303,6 +322,9 @@ class InferenceEngine:
                 desc += f" expert_offload=on ({R}/{n_experts} resident)"
         if getattr(self, "_fused_decode_note", None):
             desc += f" fused_decode=off ({self._fused_decode_note})"
+        elif (self._int8_weights and self._config.fused_decode_block
+              and hasattr(self.model_config, "int8_weights")):
+            desc += " fused_decode=on"
         return desc
 
     # ------------------------------------------------------------------ params
@@ -494,11 +516,16 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------ generate
     def _fused_decode_eligible(self):
-        """True when the decode loop can use the fused per-layer kernel
+        """Structured gate for the fused per-layer decode kernel
         (``ops/pallas/decode_block.py`` — the reference's fused
-        qkv_gemm/softmax_context/mlp_gemm pass, pt_binding.cpp:1745):
-        int8 fused-qkv serving, layernorm + sequential residual + ungated
-        MLP, no rope/alibi, MHA (nh == kv), unrolled layers, tp=1.
+        qkv_gemm/softmax_context/mlp_gemm pass, pt_binding.cpp:1745).
+        Returns a truthy :class:`FusedDecodeEligibility` for int8 fused-qkv
+        serving with unrolled layers at tp=1 and any of: layernorm OR
+        rmsnorm, rope (full rotary) / learned / no positions, gated
+        (swiglu/geglu) or ungated MLPs, grouped KV heads. Falsy results
+        carry a concrete reason per failing condition — the genuinely
+        unsupported shapes are alibi, partial rotary, local-attention
+        layers, act-quant, attn_scale, parallel residual, and MoE.
 
         VMEM gate (ADVICE r5): the fused kernels' k-block pickers
         (``pick_block_k``) never split a quantization group, so a coarse
@@ -508,37 +535,71 @@ class InferenceEngine:
         the full K axis, which can exceed VMEM at compile time. Such
         configs fall back to the per-projection path instead."""
         mc = self.model_config
+        reasons = []
 
-        def _group_ok():
-            gs = getattr(mc, "int8_group_size", 0) or 128
-            # effective group per contraction dim: quantize_params uses gs
-            # only when it divides K, else the whole dim is one group
-            dims = (mc.hidden_size,                      # qkv / up K
-                    mc.num_heads * mc.head_size,         # o-proj K
-                    getattr(mc, "ffn_size", 4 * mc.hidden_size))  # down K
-            return all((gs if k % gs == 0 else k) <= 1024 for k in dims)
-
-        return (getattr(mc, "int8_weights", False)
-                and getattr(mc, "int8_fused_qkv", False)
-                and getattr(mc, "scan_layers", True) is False
-                and getattr(mc, "num_experts", 0) == 0
-                and not getattr(mc, "parallel_residual", False)
-                and getattr(mc, "norm", "") == "layernorm"
-                # the fused kernels add projection biases unconditionally;
-                # a bias-less layernorm model (attn_bias=False) must take
-                # the per-projection path
-                and (getattr(mc, "attn_bias", None) is None or mc.attn_bias)
-                and not getattr(mc, "embed_norm", False)
-                and mc.pos_embedding in ("learned", "none")
-                and mc.activation in ("gelu", "gelu_exact", "quick_gelu", "relu")
-                and mc.kv_heads == mc.num_heads
-                and (mc.rotary_dim or 0) == 0
-                and getattr(mc, "attn_scale", None) is None
-                and not getattr(mc, "local_attention_layers", ())
-                and not getattr(mc, "act_quant_bits", 0)
-                and _group_ok()
-                and self.mesh.shape[dist.TENSOR_AXIS] == 1
-                and self._config.fused_decode_block)
+        if not getattr(mc, "int8_weights", False):
+            reasons.append("dtype is not int8 (the fused kernels stream "
+                           "int8 weights)")
+        elif not getattr(mc, "int8_fused_qkv", False):
+            reasons.append("int8_fused_qkv=off"
+                           + (f" ({self._int8_fused_note})"
+                              if getattr(self, "_int8_fused_note", None)
+                              else ""))
+        if getattr(mc, "scan_layers", True) is not False:
+            reasons.append("scan_layers=True (the fused path needs "
+                           "per-layer unrolled caches; enable kernel_inject)")
+        if getattr(mc, "num_experts", 0) > 0:
+            reasons.append(
+                f"num_experts={mc.num_experts}: the fused per-layer decode "
+                f"kernel has no expert dispatch; serving the per-projection "
+                f"MoE path")
+        if getattr(mc, "parallel_residual", False):
+            reasons.append("parallel_residual=True (the fused out/mlp kernel "
+                           "computes the sequential residual)")
+        if getattr(mc, "norm", "") not in ("layernorm", "rmsnorm"):
+            reasons.append(f"norm={getattr(mc, 'norm', '?')} (fused kernels "
+                           f"support layernorm/rmsnorm)")
+        if getattr(mc, "embed_norm", False):
+            reasons.append("embed_norm=True (no fused embedding norm)")
+        if mc.pos_embedding not in ("learned", "none", "rope"):
+            reasons.append(f"pos_embedding={mc.pos_embedding}: no in-kernel "
+                           f"alibi bias")
+        elif (mc.pos_embedding == "rope"
+              and (mc.rotary_dim or 0) not in (0, mc.head_size)):
+            reasons.append(
+                f"partial rotary (rotary_dim={mc.rotary_dim} < head_size="
+                f"{mc.head_size}): the in-kernel rotation is full-head only")
+        if mc.activation not in ("gelu", "gelu_exact", "quick_gelu", "relu",
+                                 "swiglu", "geglu"):
+            reasons.append(f"activation={mc.activation} not in the fused "
+                           f"out/mlp kernel's set")
+        if getattr(mc, "attn_scale", None) is not None:
+            reasons.append(f"attn_scale={mc.attn_scale} (fused attention "
+                           f"uses the default 1/sqrt(head_size))")
+        if getattr(mc, "local_attention_layers", ()):
+            reasons.append("local-attention layers (the fused path has no "
+                           "per-layer sliding-window starts)")
+        if getattr(mc, "act_quant_bits", 0):
+            reasons.append(f"act_quant_bits={mc.act_quant_bits} (no fused "
+                           f"fake-quant of block inputs)")
+        gs = getattr(mc, "int8_group_size", 0) or 128
+        # effective group per contraction dim: quantize_params uses gs
+        # only when it divides K, else the whole dim is one group
+        dims = (mc.hidden_size,                      # qkv / up K
+                mc.num_heads * mc.head_size,         # o-proj K
+                getattr(mc, "ffn_size", 4 * mc.hidden_size))  # down K
+        bad = [k for k in dims if (gs if k % gs == 0 else k) > 1024]
+        if bad:
+            reasons.append(
+                f"int8 group spans {max(bad)} > 1024 on a contraction dim "
+                f"(group_size={gs}): the weight block would exceed VMEM")
+        tp_eff = self.mesh.shape[dist.TENSOR_AXIS]
+        if tp_eff != 1:
+            reasons.append(f"tensor={tp_eff}: the fused kernels are opaque "
+                           f"to GSPMD; tp decodes per-projection")
+        if not self._config.fused_decode_block:
+            reasons.append("fused_decode_block=False in config")
+        return FusedDecodeEligibility(reasons)
 
     def _fast_tree(self):
         """Per-layer tuples for the fused decode kernel, derived once from
@@ -558,65 +619,47 @@ class InferenceEngine:
         cached = getattr(self, "_fast_tree_cache", None)
         if cached is not None and cached[0] is self.params:
             return cached[1]
-
-        def build(params):
-            mc = self.model_config
-            layers = []
-            for i in range(mc.num_layers):
-                lp = params[f"layer_{i}"]
-                at, mlp = lp["attn"], lp["mlp"]
-                f32 = lambda x: jnp.asarray(x, jnp.float32)
-                norms = jnp.stack([f32(lp["attn_norm"]["scale"]), f32(lp["attn_norm"]["bias"]),
-                                   f32(lp["mlp_norm"]["scale"]), f32(lp["mlp_norm"]["bias"])])
-                qkv = (at["qkv_q"], f32(at["qkv_scale"]), f32(at["qkv_bias"]))
-                o = (at["o_proj"]["kernel_q"], f32(at["o_proj"]["kernel_scale"]),
-                     f32(at["o_proj"]["bias"]))
-                up = (mlp["up_proj"]["kernel_q"], f32(mlp["up_proj"]["kernel_scale"]),
-                      f32(mlp["up_proj"]["bias"]))
-                down = (mlp["down_proj"]["kernel_q"], f32(mlp["down_proj"]["kernel_scale"]),
-                        f32(mlp["down_proj"]["bias"]))
-                layers.append((norms, qkv, o, up, down))
-            head = {
-                "final_scale": f32(params["final_norm"]["scale"]),
-                "final_bias": f32(params["final_norm"]["bias"]),
-                "embed": params["embed"]["embedding"],
-                "logits_q": params["logits_q"],
-                "logits_scale": f32(params["logits_scale"]),
-            }
-            if self.model_config.pos_embedding == "learned":
-                head["pos_embed"] = params["pos_embed"]
-            if "logits_bias" in params:
-                head["logits_bias"] = f32(params["logits_bias"])
-            return tuple(layers), head
-
         with self.mesh:
-            self._fast_tree_cache = (self.params, build(self.params))
+            self._fast_tree_cache = (
+                self.params, self.module.fused_decode_operands(self.params))
         return self._fast_tree_cache[1]
 
     def _fused_step(self, layers, head, caches, tok, pos_rows, pos, pads):
         """One fused-token decode step: embeds -> L fused layer kernels (+
         XLA cache commits) -> final norm -> int8 logits. Returns
         (logits (B, V) f32, new caches)."""
+        from ..models.transformer import rope_table
         from ..ops.pallas.decode_block import fused_decode_block
         from ..ops.pallas.quant_matmul import quant_matmul
         mc = self.model_config
         x = jnp.take(head["embed"], tok, axis=0)  # (B, H) bf16
         if mc.pos_embedding == "learned":
             x = x + jnp.take(head["pos_embed"], pos_rows, axis=0).astype(x.dtype)
+        rope = None
+        if mc.pos_embedding == "rope":
+            sin, cos = rope_table(mc.rotary_dim or mc.head_size,
+                                  mc.max_seq_len, mc.rope_theta)
+            rope = (sin[pos_rows], cos[pos_rows])
         cks, cvs = caches
         new_ck, new_cv = [], []
-        for i, (norms, qkv, o, up, down) in enumerate(layers):
+        for i, (norms, qkv, o, up, down, gate) in enumerate(layers):
             x, ck, cv = fused_decode_block(
                 x, norms, cks[i], cvs[i], qkv, o, up, down, pads, pos,
                 activation=mc.activation, eps=mc.layernorm_epsilon,
-                block_kv=mc.decode_block_kv)
+                block_kv=mc.decode_block_kv, norm=mc.norm, rope=rope,
+                gate=gate)
             new_ck.append(ck)
             new_cv.append(cv)
         x32 = x.astype(jnp.float32)
-        mu = jnp.mean(x32, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
-        xn = ((x32 - mu) * jax.lax.rsqrt(var + mc.layernorm_epsilon)
-              * head["final_scale"] + head["final_bias"]).astype(x.dtype)
+        if "final_bias" in head:  # layernorm head
+            mu = jnp.mean(x32, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+            xn = ((x32 - mu) * jax.lax.rsqrt(var + mc.layernorm_epsilon)
+                  * head["final_scale"] + head["final_bias"]).astype(x.dtype)
+        else:  # rmsnorm
+            ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+            xn = (x32 * jax.lax.rsqrt(ms + mc.layernorm_epsilon)
+                  * head["final_scale"]).astype(x.dtype)
         logits = quant_matmul(xn, head["logits_q"], head["logits_scale"],
                               block_m=8)[:, :mc.vocab_size].astype(jnp.float32)
         if "logits_bias" in head:

@@ -478,6 +478,23 @@ class DecodeScheduler:
                                          or engine.telemetry.enabled)
         self.expert_replays = 0
         self.expert_dispatch_tokens = 0
+        # fused decode blocks (ops/pallas/decode_block.py): when the
+        # engine's structured gate passes, the fused/spec step programs
+        # dispatch THREE resident kernels per layer (fused_paged_step)
+        # instead of the per-projection apply_with_cache path — same pool,
+        # same write-index/q_spans threading, same O(1) program count.
+        # LoRA program variants stay per-projection regardless (adapter
+        # deltas hook the projection intermediates the fused kernels never
+        # materialize), which is a per-DISPATCH choice: base-only batches
+        # on an adapter-serving scheduler still fuse.
+        if hasattr(engine.model_config, "int8_weights"):
+            elig = engine._fused_decode_eligible()
+            self._fused_block = bool(elig)
+            self._fused_block_reasons = list(elig.reasons)
+        else:
+            self._fused_block = False
+            self._fused_block_reasons = [
+                "model family without fused decode-block support"]
         self._prefill = None  # at most one in-flight _PrefillState
         self.queue = collections.deque()
         self.active = {}  # slot -> _Request
@@ -2045,9 +2062,15 @@ class DecodeScheduler:
         pass them length 0 and keep the paged kernel's KV-block walk
         bounded by the longest LIVE row, not the longest retained prefix.
 
-        NOTE: the fused per-layer decode kernel (decode_block.py) needs a
-        shared position scalar, so the slot-pool step always uses the
-        per-projection path (paged Pallas kernels or XLA).
+        Fused decode blocks: when the engine's structured gate passes
+        (``self._fused_block``) the forward routes through
+        ``CausalLMModel.fused_paged_step`` — three resident Pallas kernels
+        per layer (qkv+norm+rope, paged attention, out/mlp) instead of the
+        per-projection ``apply_with_cache`` path, with IDENTICAL
+        write-index/q_spans threading and pool layout. The program key is
+        retagged ``fused_block`` so capacity telemetry prices the fused
+        kind separately; the variant count is unchanged, so the O(1)
+        compiled-programs contract holds.
 
         ``lora=True`` builds the multi-adapter variant: the program takes a
         trailing ``lora`` argument (per-bucket pool tensors + per-row slot
@@ -2058,8 +2081,9 @@ class DecodeScheduler:
         both variants together stay O(1) in adapter count/mix/churn (which
         rows carry which adapter is runtime data, pool shapes are fixed by
         the bucket config)."""
-        key = ("fused", sampling, collect, chunk, ksteps) + (("lora", ) if lora
-                                                             else ())
+        fused_block = self._fused_block and not lora
+        key = ("fused_block" if fused_block else "fused",
+               sampling, collect, chunk, ksteps) + (("lora", ) if lora else ())
 
         def build():
             model = self.engine.module
@@ -2095,6 +2119,13 @@ class DecodeScheduler:
                     """One in-sync forward; returns (logits, pool, counts)
                     with counts None when stats are off (the non-stats
                     trace is unchanged from the pre-MoE program)."""
+                    if fused_block:
+                        # 3 resident kernels per layer; stats/lora/offload
+                        # are structurally absent on this path (the gate
+                        # excludes MoE, and lora variants stay unfused)
+                        lg, pl = model.fused_paged_step(
+                            params, tok_block, pool, pos_block, widx, sp)
+                        return lg, pl, None
                     if stats:
                         return model.apply_with_cache(
                             params, tok_block, pool, 0, position_ids=pos_block,
@@ -2171,8 +2202,14 @@ class DecodeScheduler:
         ``lora=True`` is the multi-adapter variant (same contract as
         :meth:`_fused_fn`): drafts verify through each row's gathered
         adapter pages, so speculative acceptance stays bit-identical to
-        that adapter's non-speculative stream."""
-        key = ("spec", sampling, collect, width) + (("lora", ) if lora else ())
+        that adapter's non-speculative stream. When the fused decode-block
+        gate passes, verification routes through ``fused_paged_step``
+        (key retagged ``spec_block``) — drafts verify through the SAME
+        fused kernels that decode, keeping acceptance bit-identical to
+        fused non-speculative decode."""
+        fused_block = self._fused_block and not lora
+        key = ("spec_block" if fused_block else "spec",
+               sampling, collect, width) + (("lora", ) if lora else ())
 
         def build():
             model = self.engine.module
@@ -2197,7 +2234,10 @@ class DecodeScheduler:
                 eops = extra[i] if offload else None
                 C = ids.shape[1]
                 pos = lengths[:, None] + jnp.arange(C)[None, :]
-                if stats:
+                if fused_block:
+                    logits, pool = model.fused_paged_step(
+                        params, ids, pool, pos, lengths, spans)
+                elif stats:
                     logits, pool, cnt = model.apply_with_cache(
                         params, ids, pool, 0, position_ids=pos,
                         write_index=lengths, q_spans=spans, lora_ops=lops,

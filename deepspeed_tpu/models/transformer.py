@@ -1425,6 +1425,173 @@ class CausalLMModel:
                                      axis=0)
         return logits, new_cache, counts
 
+    # ---- fused decode blocks (serving fast path) -------------------------
+    def fused_decode_operands(self, params):
+        """Per-layer kernel operand tuples for ``ops/pallas/decode_block``,
+        derived from the QUANTIZED param tree (``quantize_params`` output
+        with ``int8_fused_qkv``). Safe both eagerly (the engine's static
+        generate loop caches the result) and in-trace (the scheduler's step
+        programs derive per dispatch): the int8 weights and the embedding
+        pass through BY REFERENCE — only the small norm/bias/scale leaves
+        convert, and missing bias leaves (rmsnorm models carry none)
+        synthesize as zeros so the kernels stay uniform.
+
+        Returns ``(layers, head)``: ``layers[i] = (norms (4, H) f32, qkv,
+        o, up, down, gate-or-None)`` with each projection a ``(w int8,
+        scales f32, bias f32)`` tuple, and ``head`` the final-norm /
+        embedding / int8 vocab-projection leaves."""
+        cfg = self.cfg
+        H = cfg.hidden_size
+        f32 = lambda x: jnp.asarray(x, jnp.float32)
+        zeros = lambda n: jnp.zeros((n, ), jnp.float32)
+
+        def norm_rows(scope):
+            return [f32(scope["scale"]),
+                    f32(scope["bias"]) if "bias" in scope else zeros(H)]
+
+        def proj(node, n):
+            return (node["kernel_q"], f32(node["kernel_scale"]),
+                    f32(node["bias"]) if "bias" in node else zeros(n))
+
+        layers = []
+        for i in range(cfg.num_layers):
+            lp = params[f"layer_{i}"]
+            at, mlp = lp["attn"], lp["mlp"]
+            norms = jnp.stack(norm_rows(lp["attn_norm"])
+                              + norm_rows(lp["mlp_norm"]))
+            Nq = at["qkv_q"].shape[1]
+            qkv = (at["qkv_q"], f32(at["qkv_scale"]),
+                   f32(at["qkv_bias"]) if "qkv_bias" in at else zeros(Nq))
+            F = mlp["up_proj"]["kernel_q"].shape[1]
+            gate = proj(mlp["gate_proj"], F) if "gate_proj" in mlp else None
+            layers.append((norms, qkv, proj(at["o_proj"], H),
+                           proj(mlp["up_proj"], F), proj(mlp["down_proj"], H),
+                           gate))
+        head = {
+            "final_scale": f32(params["final_norm"]["scale"]),
+            "embed": params["embed"]["embedding"],
+            "logits_q": params["logits_q"],
+            "logits_scale": f32(params["logits_scale"]),
+        }
+        if "bias" in params["final_norm"]:
+            head["final_bias"] = f32(params["final_norm"]["bias"])
+        if cfg.pos_embedding == "learned":
+            head["pos_embed"] = params["pos_embed"]
+        if "logits_bias" in params:
+            head["logits_bias"] = f32(params["logits_bias"])
+        return tuple(layers), head
+
+    def fused_paged_step(self, params, input_ids, kv_cache, position_ids,
+                         write_index, q_spans):
+        """The fused-decode-block equivalent of the slot-pool
+        ``apply_with_cache(params, ids, pool, 0, position_ids=...,
+        write_index=..., q_spans=...)`` call the scheduler's step programs
+        make: embeds -> per layer (kernel A qkv+norm+rope -> span KV commit
+        -> paged attention -> kernel C out/mlp) -> final norm -> int8
+        logits. Three resident kernels per layer instead of the
+        per-projection path's ~9+ XLA-glued dispatches.
+
+        The KV commit and paged-attention dispatch mirror
+        :class:`Attention`'s span-write path LINE FOR LINE (same ``tgt``
+        row drop, same ``paged_decode_attention`` for C == 1 /
+        ``paged_span_attention`` for C > 1, same int8-KV quantize) so the
+        pool stays byte-compatible with the unfused programs — prefill,
+        copy_slot, and tier restore interoperate with fused decode on the
+        same pool. Only eligible configs reach here (engine
+        ``_fused_decode_eligible``): tp=1, so no sharded kernel variants.
+
+        Returns ``(logits (N, C, V) compute-dtype, new_pool)`` with the
+        pool structure ``apply_with_cache`` returns."""
+        from ..ops.pallas.decode_block import fused_qkv_ln, fused_out_mlp
+        from ..ops.pallas.decode_attention import (paged_decode_attention,
+                                                   paged_span_attention)
+        cfg = self.cfg
+        N, C = input_ids.shape
+        nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_size
+        layers, head = self.fused_decode_operands(params)
+        x2d = jnp.take(head["embed"], input_ids.reshape(-1), axis=0)  # (N*C, H)
+        pos_flat = position_ids.reshape(-1)
+        if cfg.pos_embedding == "learned":
+            x2d = x2d + jnp.take(head["pos_embed"], pos_flat,
+                                 axis=0).astype(x2d.dtype)
+        rope = None
+        if cfg.pos_embedding == "rope":
+            sin, cos = rope_table(cfg.rotary_dim or hd, cfg.max_seq_len,
+                                  cfg.rope_theta)
+            rope = (sin[pos_flat], cos[pos_flat], nh + nkv, hd)
+        quant_kv = len(kv_cache) == 3
+        if quant_kv:
+            from ..ops.quantizer import quantize_kv_rows
+        starts = jnp.zeros((N, ), jnp.int32)
+        col = jnp.arange(C)[None, :]
+        new_layers = []
+        for i, (norms, qkv, o, up, down, gate) in enumerate(layers):
+            layer_cache = tuple(comp[i] for comp in kv_cache)
+            csc = None
+            if quant_kv:
+                ck, cv, csc = layer_cache
+            else:
+                ck, cv = layer_cache
+            y = fused_qkv_ln(x2d, norms, qkv, eps=cfg.layernorm_epsilon,
+                             norm=cfg.norm, rope=rope)
+            qf, kf, vf = jnp.split(y, [nh * hd, (nh + nkv) * hd], axis=-1)
+            k = kf.reshape(N, C, nkv, hd).transpose(0, 2, 1, 3)
+            v = vf.reshape(N, C, nkv, hd).transpose(0, 2, 1, 3)
+            if quant_kv:
+                kq, vq, sc_new = quantize_kv_rows(k, v)
+                writes = [(ck, kq), (cv, vq), (csc, sc_new)]
+            else:
+                writes = [(ck, k), (cv, v)]
+            # span commit, identical to Attention's: column j of row i lands
+            # at write_index_i + j; columns past the live span target row S
+            # (out of range) and are DROPPED
+            tgt = write_index[:, None] + col
+            tgt = jnp.where(col < q_spans[:, None], tgt, ck.shape[2])
+            upd = lambda c, kk, t_: c.at[:, t_, :].set(kk.astype(c.dtype),
+                                                       mode="drop")
+            written = [jax.vmap(upd)(c, kk, tgt) for c, kk in writes]
+            if quant_kv:
+                ck, cv, csc = written
+            else:
+                ck, cv = written
+            if C == 1:
+                out = paged_decode_attention(
+                    qf.reshape(N, nh, hd), ck, cv, starts, write_index + 1,
+                    block_kv=cfg.decode_block_kv,
+                    k_scale=csc, v_scale=csc)
+                attn2d = out.astype(cfg.dtype).reshape(N, nh * hd)
+            else:
+                q4 = qf.reshape(N, C, nh, hd).transpose(0, 2, 1, 3)
+                out = paged_span_attention(
+                    q4, ck, cv, starts, write_index,
+                    block_kv=cfg.decode_block_kv,
+                    k_scale=csc, v_scale=csc)
+                attn2d = out.astype(cfg.dtype).transpose(0, 2, 1, 3) \
+                            .reshape(N * C, nh * hd)
+            x2d = fused_out_mlp(attn2d, x2d, norms, o, up, down,
+                                activation=cfg.activation,
+                                eps=cfg.layernorm_epsilon, norm=cfg.norm,
+                                gate=gate)
+            new_layers.append(written)
+        new_cache = tuple(tuple(lay[j] for lay in new_layers)
+                          for j in range(len(new_layers[0])))
+        x32 = x2d.astype(jnp.float32)
+        if "final_bias" in head:  # layernorm head
+            mu = jnp.mean(x32, axis=-1, keepdims=True)
+            var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+            xn = ((x32 - mu) * jax.lax.rsqrt(var + cfg.layernorm_epsilon)
+                  * head["final_scale"] + head["final_bias"])
+        else:  # rmsnorm
+            ms = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+            xn = (x32 * jax.lax.rsqrt(ms + cfg.layernorm_epsilon)
+                  * head["final_scale"])
+        logits = _qmm2d(xn.astype(x2d.dtype), head["logits_q"],
+                        head["logits_scale"])
+        logits = logits.reshape(N, C, -1)[..., :cfg.vocab_size]
+        if "logits_bias" in head:
+            logits = logits + head["logits_bias"].astype(logits.dtype)
+        return logits, new_cache
+
     def _apply_kwargs(self, rng):
         """Dropout is active iff a step rng is provided and rate > 0."""
         if rng is not None and self.cfg.dropout > 0:

@@ -5,7 +5,8 @@ softmax_context -> vector_matmul -> mlp_gemm`` (``csrc/transformer/
 inference/csrc/pt_binding.cpp:1745-1805`` + ``inference_context.h``'s
 workspace): a decode layer runs in THREE resident kernels, with int8
 weights streamed block-by-block through the MXU and the layer's
-norms/biases/activations folded in (no XLA glue between projections).
+norms/biases/activations/rotary folded in (no XLA glue between
+projections).
 
 Why: at decode the step is HBM-bound and the op count is the enemy — the
 per-projection path costs ~190 kernel launches + ~340 XLA glue fusions per
@@ -13,23 +14,33 @@ token step, whose fixed costs roughly double the ideal weight-streaming
 time. This brings a layer to 3 launches + 2 cache-commit
 dynamic-update-slices:
 
-    kernel A  ln1(x) folded into the fused [q;k;v] int8 matmul (+bias)
-    kernel B  ``decode_attention`` over the committed KV cache
-    kernel C  o-projection (+bias) -> residual -> ln2 -> up (+bias, act)
-              -> down (+bias) -> residual -> x_out
+    kernel A  norm1(x) folded into the fused [q;k;v] int8 matmul (+bias),
+              with RoPE rotation of the q/k head segments on the final step
+    kernel B  ``decode_attention`` over the committed KV cache (GQA-native:
+              kv_heads may divide num_heads)
+    kernel C  o-projection (+bias) -> residual -> norm2 -> up [and gate]
+              (+bias, act) -> down (+bias) -> residual -> x_out
 
 Everything inside the kernels stays 2-D (lane dim = feature dim): Mosaic
 cannot lane-split ``(B, nh*hd) -> (B, nh, hd)`` in-kernel, so the head
 reshape + cache commit happen in XLA where they are free (the HLO audit
-shows zero copies in the decode loop body).
+shows zero copies in the decode loop body). RoPE needs no head reshape:
+the rotation acts on static per-head column segments of the fused
+[q;k;v] row, so it folds into kernel A's flush step.
 
 Supported model shape (the engine gates on this): fused int8 qkv weights,
-layernorm norms, sequential residual, gelu/gelu_exact/quick_gelu/relu MLP
-(no gate), no rope/alibi (learned or no positional embedding), and
-``num_heads == kv_heads``. Quantization groups follow
-``CausalLMModel.quantize_params``. Weight-block scales are applied to the
-(B, n-block) fp32 partial sums after each dot — see ``quant_matmul.py``
-for the design rationale and microbenchmarks.
+layernorm or rmsnorm norms, sequential residual, gelu/gelu_exact/
+quick_gelu/relu MLP or a gated swiglu/geglu MLP (gate and up share the
+norm2(x) tiles in kernel C), rope (full rotary only, ``rotary_dim in (0,
+head_size)``) / learned / no positional embedding, and grouped KV heads
+(``kv_heads`` dividing ``num_heads``). Still gated out: alibi, partial
+rotary, local-attention layers, act-quant, MoE — see
+``InferenceEngine._fused_decode_eligible`` for the reason strings.
+Models without bias params (rmsnorm shapes) pass zero biases; the kernels
+are uniform. Quantization groups follow ``CausalLMModel.quantize_params``.
+Weight-block scales are applied to the (B, n-block) fp32 partial sums
+after each dot — see ``quant_matmul.py`` for the design rationale and
+microbenchmarks.
 """
 
 import functools
@@ -46,7 +57,14 @@ def _interpret():
     return jax.default_backend() == "cpu"
 
 
-def _ln(x32, scale, bias, eps):
+def _norm(x32, norms_ref, row, kind, eps):
+    """Row ``row`` of the (4, H) norms block is the scale, ``row + 1`` the
+    bias (a zero row for rmsnorm models, which have no bias param)."""
+    scale = norms_ref[row, :][None, :]
+    if kind == "rmsnorm":
+        ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        return x32 * jax.lax.rsqrt(ms + eps) * scale
+    bias = norms_ref[row + 1, :][None, :]
     mu = jnp.mean(x32, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
     return (x32 - mu) * jax.lax.rsqrt(var + eps) * scale + bias
@@ -59,7 +77,26 @@ def _act(h, kind):
         return jax.nn.gelu(h, approximate=False)
     if kind == "quick_gelu":
         return h * jax.nn.sigmoid(1.702 * h)
+    if kind == "silu":
+        return h * jax.nn.sigmoid(h)
     return jnp.maximum(h, 0.0)
+
+
+def _rope_rotate(y, sin, cos, rot_heads, hd):
+    """Rotate the first ``rot_heads`` head segments of the fused [q;k;v]
+    row ``y`` (f32 (B, Nqkv)); columns past ``rot_heads * hd`` (the v
+    segment) pass through. ``sin``/``cos``: (B, hd // 2) f32 gathered at
+    each row's position. Same half-split convention as ``apply_rope``."""
+    half = hd // 2
+    parts = []
+    for i in range(rot_heads):
+        off = i * hd
+        a = y[:, off:off + half]
+        b = y[:, off + half:off + hd]
+        parts.append(a * cos - b * sin)
+        parts.append(b * cos + a * sin)
+    parts.append(y[:, rot_heads * hd:])
+    return jnp.concatenate(parts, axis=-1)
 
 
 def _qdot(x_bf16, w_ref, s_ref, k_idx, bk, gsize, col_off=None):
@@ -98,15 +135,18 @@ def _prep_scales(sc):
 
 
 # --------------------------------------------------------------- kernel A
-def _qkv_ln_kernel(x_ref, norms_ref, w_ref, s_ref, b_ref, o_ref,
-                   xln_s, acc_s, *, nk1, bk1, g1, eps):
+def _qkv_ln_kernel(x_ref, norms_ref, w_ref, s_ref, b_ref, *rest,
+                   nk1, bk1, g1, eps, norm_kind, rot_heads, hd):
+    if rot_heads:
+        sin_ref, cos_ref, o_ref, xln_s, acc_s = rest
+    else:
+        o_ref, xln_s, acc_s = rest
     s = pl.program_id(0)
 
     @pl.when(s == 0)
     def _ln1():
         x32 = x_ref[...].astype(jnp.float32)
-        xln_s[...] = _ln(x32, norms_ref[0, :][None, :], norms_ref[1, :][None, :],
-                         eps).astype(x_ref.dtype)
+        xln_s[...] = _norm(x32, norms_ref, 0, norm_kind, eps).astype(x_ref.dtype)
 
     part = _qdot(xln_s[:, pl.ds(s * bk1, bk1)], w_ref, s_ref, s, bk1, g1)
 
@@ -120,13 +160,20 @@ def _qkv_ln_kernel(x_ref, norms_ref, w_ref, s_ref, b_ref, o_ref,
 
     @pl.when(s == nk1 - 1)
     def _done():
-        o_ref[...] = (acc_s[...] + b_ref[0, :][None, :]).astype(o_ref.dtype)
+        y = acc_s[...] + b_ref[0, :][None, :]
+        if rot_heads:
+            y = _rope_rotate(y, sin_ref[...], cos_ref[...], rot_heads, hd)
+        o_ref[...] = y.astype(o_ref.dtype)
 
 
-def fused_qkv_ln(x, norms, qkv, *, eps=1e-5):
-    """ln1(x) @ dequant(Wqkv) + bias in one kernel. x: (B, H) bf16;
-    norms: (4, H) f32 (rows 0/1 used); qkv: (W int8 (H, Nqkv), scales,
-    bias). Returns (B, Nqkv) bf16."""
+def fused_qkv_ln(x, norms, qkv, *, eps=1e-5, norm="layernorm", rope=None):
+    """norm1(x) @ dequant(Wqkv) + bias (+ rope) in one kernel. x: (B, H)
+    bf16; norms: (4, H) f32 (rows 0/1 used; bias row is zeros for
+    rmsnorm); qkv: (W int8 (H, Nqkv), scales, bias). ``rope``: optional
+    ``(sin2d, cos2d, rot_heads, head_dim)`` — (B, head_dim // 2) f32
+    tables gathered at each row's position; the first ``rot_heads`` head
+    segments (the q and k heads of the fused layout) are rotated on the
+    flush step, the v tail passes through. Returns (B, Nqkv) bf16."""
     B, H = x.shape
     w, sc, b = qkv
     Nq = w.shape[1]
@@ -134,31 +181,50 @@ def fused_qkv_ln(x, norms, qkv, *, eps=1e-5):
     g1 = H // G
     bk1 = _pick_bk(H, g1)
     nk1 = H // bk1
-    kernel = functools.partial(_qkv_ln_kernel, nk1=nk1, bk1=bk1, g1=g1, eps=eps)
+    if rope is not None:
+        sin2d, cos2d, rot_heads, hd = rope
+    else:
+        sin2d = cos2d = None
+        rot_heads, hd = 0, 0
+    kernel = functools.partial(_qkv_ln_kernel, nk1=nk1, bk1=bk1, g1=g1, eps=eps,
+                               norm_kind=norm, rot_heads=rot_heads, hd=hd)
+    in_specs = [
+        pl.BlockSpec((B, H), lambda s: (0, 0)),
+        pl.BlockSpec(norms.shape, lambda s: (0, 0)),
+        pl.BlockSpec((bk1, Nq), lambda s: (s, 0)),
+        pl.BlockSpec(sc.shape, lambda s: (0, 0)),
+        pl.BlockSpec((1, Nq), lambda s: (0, 0)),
+    ]
+    operands = [x, norms, w, sc, b.reshape(1, -1)]
+    if rot_heads:
+        half = hd // 2
+        in_specs += [pl.BlockSpec((B, half), lambda s: (0, 0)),
+                     pl.BlockSpec((B, half), lambda s: (0, 0))]
+        operands += [jnp.asarray(sin2d, jnp.float32),
+                     jnp.asarray(cos2d, jnp.float32)]
     return pl.pallas_call(
         kernel,
         grid=(nk1, ),
-        in_specs=[
-            pl.BlockSpec((B, H), lambda s: (0, 0)),
-            pl.BlockSpec(norms.shape, lambda s: (0, 0)),
-            pl.BlockSpec((bk1, Nq), lambda s: (s, 0)),
-            pl.BlockSpec(sc.shape, lambda s: (0, 0)),
-            pl.BlockSpec((1, Nq), lambda s: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((B, Nq), lambda s: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, Nq), x.dtype),
         scratch_shapes=[pltpu.VMEM((B, H), x.dtype), pltpu.VMEM((B, Nq), jnp.float32)],
         compiler_params=_CompilerParams(dimension_semantics=("arbitrary", )),
         interpret=_interpret(),
-    )(x, norms, w, sc, b.reshape(1, -1))
+    )(*operands)
 
 
 # --------------------------------------------------------------- kernel C
 def _out_mlp_kernel(attn_ref, x_ref, norms_ref,
-                    o_w, o_s, o_b, up_w, up_s, up_b, dn_w, dn_s, dn_b,
-                    xo_ref, res2, ln2_s, up_h, acc_s,
-                    *, nko, nju, nku, nkd, bko, bk1, bnu, bkd, go, gu, gd,
-                    eps, act):
+                    o_w, o_s, o_b, up_w, up_s, up_b, *rest,
+                    nko, nju, nku, nkd, bko, bk1, bnu, bkd, go, gu, gd,
+                    eps, act, norm_kind, gated):
+    if gated:
+        (gt_w, gt_s, gt_b, dn_w, dn_s, dn_b,
+         xo_ref, res2, ln2_s, up_h, g_h, acc_s) = rest
+    else:
+        dn_w, dn_s, dn_b, xo_ref, res2, ln2_s, up_h, acc_s = rest
+        gt_w = gt_s = gt_b = g_h = None
     s = pl.program_id(0)
     A1 = nko
     A2 = A1 + nju * nku
@@ -180,29 +246,42 @@ def _out_mlp_kernel(attn_ref, x_ref, norms_ref,
     def _o_done():
         r = acc_s[...] + o_b[0, :][None, :] + x_ref[...].astype(jnp.float32)
         res2[...] = r
-        ln2_s[...] = _ln(r, norms_ref[2, :][None, :], norms_ref[3, :][None, :],
-                         eps).astype(ln2_s.dtype)
+        ln2_s[...] = _norm(r, norms_ref, 2, norm_kind, eps).astype(ln2_s.dtype)
 
-    # ---- up projection + activation ----
+    # ---- up (and gate) projection + activation ----
     @pl.when((s >= A1) & (s < A2))
     def _up():
         p_ = s - A1
         j, k = p_ // nku, p_ % nku
-        part = _qdot(ln2_s[:, pl.ds(k * bk1, bk1)], up_w, up_s, k, bk1, gu,
-                     col_off=j * bnu)
+        xt = ln2_s[:, pl.ds(k * bk1, bk1)]
+        part = _qdot(xt, up_w, up_s, k, bk1, gu, col_off=j * bnu)
+        gpart = _qdot(xt, gt_w, gt_s, k, bk1, gu, col_off=j * bnu) if gated \
+            else None
+
+        def _combine(u, g):
+            ub = u + up_b[0, pl.ds(j * bnu, bnu)][None, :]
+            if gated:  # gated MLP: act(gate) * up (swiglu / geglu)
+                return _act(g + gt_b[0, pl.ds(j * bnu, bnu)][None, :], act) * ub
+            return _act(ub, act)
 
         @pl.when(k == 0)
         def _():
             upd = part
             if nku == 1:  # single k-block: this step completes the column
-                upd = _act(upd + up_b[0, pl.ds(j * bnu, bnu)][None, :], act)
+                upd = _combine(part, gpart)
+            elif gated:
+                g_h[:, pl.ds(j * bnu, bnu)] = gpart.astype(g_h.dtype)
             up_h[:, pl.ds(j * bnu, bnu)] = upd.astype(up_h.dtype)
 
         @pl.when(k > 0)
         def _():
             upd = up_h[:, pl.ds(j * bnu, bnu)].astype(jnp.float32) + part
             if nku > 1:  # tracing reaches here only when nku > 1
-                upd2 = _act(upd + up_b[0, pl.ds(j * bnu, bnu)][None, :], act)
+                gacc = None
+                if gated:
+                    gacc = g_h[:, pl.ds(j * bnu, bnu)].astype(jnp.float32) + gpart
+                    g_h[:, pl.ds(j * bnu, bnu)] = gacc.astype(g_h.dtype)
+                upd2 = _combine(upd, gacc)
                 upd = jnp.where(k == nku - 1, upd2, upd)
             up_h[:, pl.ds(j * bnu, bnu)] = upd.astype(up_h.dtype)
 
@@ -225,11 +304,16 @@ def _out_mlp_kernel(attn_ref, x_ref, norms_ref,
         xo_ref[...] = (res2[...] + acc_s[...] + dn_b[0, :][None, :]).astype(xo_ref.dtype)
 
 
-def fused_out_mlp(attn2d, x, norms, o, up, down, *, activation="gelu", eps=1e-5):
-    """x + o_proj(attn) -> ln2 -> up -> act -> down -> + residual, one
-    kernel. attn2d: (B, nh*hd) bf16 flattened attention output; x: (B, H)
-    residual stream; norms (4, H) f32 rows 2/3 used; o/up/down:
-    (W int8, scales, bias). Returns x_out (B, H) bf16."""
+def fused_out_mlp(attn2d, x, norms, o, up, down, *, activation="gelu",
+                  eps=1e-5, norm="layernorm", gate=None):
+    """x + o_proj(attn) -> norm2 -> up [* act(gate)] -> down -> + residual,
+    one kernel. attn2d: (B, nh*hd) bf16 flattened attention output; x:
+    (B, H) residual stream; norms (4, H) f32 rows 2/3 used; o/up/down (and
+    ``gate`` when the MLP is gated): (W int8, scales, bias). For
+    ``activation`` in ("swiglu", "geglu") pass ``gate``; the gate
+    contraction shares norm2(x)'s k-tiles with up and the activation
+    applies to the gate (silu for swiglu, tanh-gelu for geglu), matching
+    ``MLP``. Returns x_out (B, H) bf16."""
     B, H = x.shape
     o_w, o_s, o_b = o
     up_w, up_s, up_b = up
@@ -250,54 +334,82 @@ def fused_out_mlp(attn2d, x, norms, o, up, down, *, activation="gelu", eps=1e-5)
     nsteps = nko + nju * nku + nkd
     A1 = nko
 
+    gated = gate is not None
+    act = activation
+    if gated:
+        act = "silu" if activation == "swiglu" else "gelu"
+        gt_w, gt_s, gt_b = gate
+        gt_s, Gg = _prep_scales(gt_s)
+        assert gt_w.shape == up_w.shape and Gg == Gu, \
+            "gate/up projections must share shape and quant grouping"
+
     kernel = functools.partial(
         _out_mlp_kernel, nko=nko, nju=nju, nku=nku, nkd=nkd,
         bko=bko, bk1=bk1, bnu=bnu, bkd=bkd, go=go, gu=gu, gd=gd,
-        eps=eps, act=activation)
+        eps=eps, act=act, norm_kind=norm, gated=gated)
     f32 = jnp.float32
+    up_spec = pl.BlockSpec((bk1, bnu), lambda s: (
+        jnp.clip(s - A1, 0, nju * nku - 1) % nku,
+        jnp.clip(s - A1, 0, nju * nku - 1) // nku))
+    in_specs = [
+        pl.BlockSpec((B, Ko), lambda s: (0, 0)),
+        pl.BlockSpec((B, H), lambda s: (0, 0)),
+        pl.BlockSpec(norms.shape, lambda s: (0, 0)),
+        pl.BlockSpec((bko, H), lambda s: (jnp.clip(s, 0, nko - 1), 0)),
+        pl.BlockSpec(o_s.shape, lambda s: (0, 0)),
+        pl.BlockSpec((1, H), lambda s: (0, 0)),
+        up_spec,
+        pl.BlockSpec(up_s.shape, lambda s: (0, 0)),
+        pl.BlockSpec((1, F), lambda s: (0, 0)),
+    ]
+    operands = [attn2d, x, norms, o_w, o_s, o_b.reshape(1, -1),
+                up_w, up_s, up_b.reshape(1, -1)]
+    if gated:
+        in_specs += [up_spec,  # gate walks the same tiles as up
+                     pl.BlockSpec(gt_s.shape, lambda s: (0, 0)),
+                     pl.BlockSpec((1, F), lambda s: (0, 0))]
+        operands += [gt_w, gt_s, gt_b.reshape(1, -1)]
+    in_specs += [
+        pl.BlockSpec((bkd, H), lambda s: (jnp.clip(s - A1 - nju * nku, 0, nkd - 1), 0)),
+        pl.BlockSpec(dn_s.shape, lambda s: (0, 0)),
+        pl.BlockSpec((1, H), lambda s: (0, 0)),
+    ]
+    operands += [dn_w, dn_s, dn_b.reshape(1, -1)]
+    scratch = [
+        pltpu.VMEM((B, H), f32),       # res2
+        pltpu.VMEM((B, H), x.dtype),   # ln2 out
+        pltpu.VMEM((B, F), x.dtype),   # up_h
+    ]
+    if gated:
+        scratch.append(pltpu.VMEM((B, F), x.dtype))  # gate partials
+    scratch.append(pltpu.VMEM((B, H), f32))          # shared o/down accumulator
     return pl.pallas_call(
         kernel,
         grid=(nsteps, ),
-        in_specs=[
-            pl.BlockSpec((B, Ko), lambda s: (0, 0)),
-            pl.BlockSpec((B, H), lambda s: (0, 0)),
-            pl.BlockSpec(norms.shape, lambda s: (0, 0)),
-            pl.BlockSpec((bko, H), lambda s: (jnp.clip(s, 0, nko - 1), 0)),
-            pl.BlockSpec(o_s.shape, lambda s: (0, 0)),
-            pl.BlockSpec((1, H), lambda s: (0, 0)),
-            pl.BlockSpec((bk1, bnu), lambda s: (
-                jnp.clip(s - A1, 0, nju * nku - 1) % nku,
-                jnp.clip(s - A1, 0, nju * nku - 1) // nku)),
-            pl.BlockSpec(up_s.shape, lambda s: (0, 0)),
-            pl.BlockSpec((1, F), lambda s: (0, 0)),
-            pl.BlockSpec((bkd, H), lambda s: (jnp.clip(s - A1 - nju * nku, 0, nkd - 1), 0)),
-            pl.BlockSpec(dn_s.shape, lambda s: (0, 0)),
-            pl.BlockSpec((1, H), lambda s: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((B, H), lambda s: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H), x.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((B, H), f32),       # res2
-            pltpu.VMEM((B, H), x.dtype),   # ln2 out
-            pltpu.VMEM((B, F), x.dtype),   # up_h
-            pltpu.VMEM((B, H), f32),       # shared o/down accumulator
-        ],
+        scratch_shapes=scratch,
         compiler_params=_CompilerParams(dimension_semantics=("arbitrary", )),
         interpret=_interpret(),
-    )(attn2d, x, norms, o_w, o_s, o_b.reshape(1, -1),
-      up_w, up_s, up_b.reshape(1, -1), dn_w, dn_s, dn_b.reshape(1, -1))
+    )(*operands)
 
 
 def fused_decode_block(x, norms, k_cache, v_cache, qkv, o, up, down,
-                       start, pos, *, activation="gelu", eps=1e-5, block_kv=256):
+                       start, pos, *, activation="gelu", eps=1e-5, block_kv=256,
+                       norm="layernorm", rope=None, gate=None):
     """One fused transformer decode layer for a single token per row.
 
     x: (B, H) bf16 residual stream. norms: (4, H) f32 rows
-    [ln1_scale, ln1_bias, ln2_scale, ln2_bias]. k_cache/v_cache:
-    (B, nh, S, hd). qkv/o/up/down: (weight_q int8, scales f32 (G, N),
-    bias f32 (N,)) tuples in matmul layout (qkv fused [q;k;v]).
-    start: (B,) int32 first attendable slot; pos: scalar int32 cache write
-    position.
+    [norm1_scale, norm1_bias, norm2_scale, norm2_bias] (zero bias rows for
+    rmsnorm). k_cache/v_cache: (B, kv_heads, S, hd) — ``kv_heads`` may be
+    smaller than ``num_heads`` (GQA; attention groups q heads over the KV
+    heads). qkv/o/up/down (and ``gate`` for swiglu/geglu): (weight_q int8,
+    scales f32 (G, N), bias f32 (N,)) tuples in matmul layout (qkv fused
+    [q;k;v]). start: (B,) int32 first attendable slot; pos: scalar int32
+    cache write position. ``rope``: optional (sin2d, cos2d) — (B, hd // 2)
+    f32 rotary tables gathered at each row's position, rotated in-kernel
+    over the q and k head segments.
 
     Returns (x_out (B, H) bf16, new_k_cache, new_v_cache) — the caches are
     committed (dynamic_update_slice at ``pos``) before attention, exactly
@@ -305,11 +417,17 @@ def fused_decode_block(x, norms, k_cache, v_cache, qkv, o, up, down,
     """
     from .decode_attention import decode_attention
     B, H = x.shape
-    _, nh, S, hd = k_cache.shape
-    qkv2d = fused_qkv_ln(x, norms, qkv, eps=eps)  # (B, 3*nh*hd)
-    qf, kf, vf = jnp.split(qkv2d, [nh * hd, 2 * nh * hd], axis=-1)
-    k3 = kf.reshape(B, nh, 1, hd)
-    v3 = vf.reshape(B, nh, 1, hd)
+    _, nkv, S, hd = k_cache.shape
+    Nq = qkv[0].shape[1]
+    nh = Nq // hd - 2 * nkv
+    rope_op = None
+    if rope is not None:
+        sin2d, cos2d = rope
+        rope_op = (sin2d, cos2d, nh + nkv, hd)
+    qkv2d = fused_qkv_ln(x, norms, qkv, eps=eps, norm=norm, rope=rope_op)
+    qf, kf, vf = jnp.split(qkv2d, [nh * hd, (nh + nkv) * hd], axis=-1)
+    k3 = kf.reshape(B, nkv, 1, hd)
+    v3 = vf.reshape(B, nkv, 1, hd)
     k_cache = jax.lax.dynamic_update_slice_in_dim(k_cache, k3.astype(k_cache.dtype),
                                                   pos, axis=2)
     v_cache = jax.lax.dynamic_update_slice_in_dim(v_cache, v3.astype(v_cache.dtype),
@@ -317,5 +435,5 @@ def fused_decode_block(x, norms, k_cache, v_cache, qkv, o, up, down,
     attn = decode_attention(qf.reshape(B, nh, hd), k_cache, v_cache,
                             start, pos + 1, block_kv=min(block_kv, S))
     x_out = fused_out_mlp(attn.reshape(B, nh * hd), x, norms, o, up, down,
-                          activation=activation, eps=eps)
+                          activation=activation, eps=eps, norm=norm, gate=gate)
     return x_out, k_cache, v_cache

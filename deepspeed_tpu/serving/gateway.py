@@ -948,7 +948,14 @@ class Gateway:
                           "slot_occupancy": sched.cache.occupancy(),
                           "compiled_programs": sched.compiled_program_count(),
                           "tp_size": sched.tp_size,
-                          "ep_size": sched.ep_size},
+                          "ep_size": sched.ep_size,
+                          # fused decode blocks: whether the step programs
+                          # run 3 resident kernels/layer, and the per-
+                          # condition reasons when they don't
+                          "fused_decode_block": getattr(
+                              sched, "_fused_block", False),
+                          "fused_decode_reasons": list(getattr(
+                              sched, "_fused_block_reasons", ()))},
             "adapters": (sched.adapters.stats()
                          if sched.adapters is not None else None),
             "expert_store": (sched.experts.stats()

@@ -100,13 +100,21 @@ class CapacityModel:
         self.attn_flops_per_ctx_tok = 4.0 * L * nh * hd
         # active weights read once per on-device step (the K-step loop
         # re-reads them each iteration); router/embeddings are noise
-        dtype_bytes = 2  # serving compute dtype is bf16/int8-dequant — 2B
-        # is the honest upper bound either way
-        try:
-            dtype_bytes = np.dtype(
-                np.asarray(0, _cfg(model_config, "dtype")).dtype).itemsize
-        except Exception:  # noqa: BLE001 — unknown dtype: keep the bf16 bound
-            pass
+        if _cfg(model_config, "int8_weights", False):
+            # int8 serving streams 1 byte/param plus the fp32 per-group
+            # scales (4 bytes per group of `int8_group_size` params) —
+            # without this the fused decode-block kind would report half
+            # its real hbm_bw_util
+            gs = int(_cfg(model_config, "int8_group_size", 0) or 128)
+            dtype_bytes = 1.0 + 4.0 / max(1, gs)
+        else:
+            dtype_bytes = 2  # serving compute dtype is bf16 — the honest
+            # upper bound for unknown dtypes too
+            try:
+                dtype_bytes = np.dtype(
+                    np.asarray(0, _cfg(model_config, "dtype")).dtype).itemsize
+            except Exception:  # noqa: BLE001 — unknown dtype: keep the bound
+                pass
         self.weight_read_bytes = float((attn_proj + mlp_active + lm_head)
                                        * dtype_bytes)
         del mlp_total
@@ -136,12 +144,16 @@ class CapacityModel:
 
 def program_shape(key):
     """(width, ksteps) batch shape encoded in a compiled-program cache key:
-    fused keys carry (chunk, ksteps), spec keys carry the draft width (the
-    verify program scores ``width`` columns in one pass); everything else
-    (prefill/copy/tier ops) is shape-accounted as a single column."""
-    if isinstance(key, tuple) and len(key) >= 5 and key[0] == "fused":
+    fused/fused_block keys carry (chunk, ksteps), spec/spec_block keys
+    carry the draft width (the verify program scores ``width`` columns in
+    one pass); everything else (prefill/copy/tier ops) is shape-accounted
+    as a single column. The ``*_block`` kinds are the fused decode-block
+    retags — same tuple positions, priced separately in the roofline."""
+    if (isinstance(key, tuple) and len(key) >= 5
+            and key[0] in ("fused", "fused_block")):
         return int(key[3]), int(key[4])
-    if isinstance(key, tuple) and len(key) >= 4 and key[0] == "spec":
+    if (isinstance(key, tuple) and len(key) >= 4
+            and key[0] in ("spec", "spec_block")):
         return int(key[3]), 1
     return 1, 1
 

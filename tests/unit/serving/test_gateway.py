@@ -133,6 +133,11 @@ def test_health_ready_metrics_endpoints(baseline):
         assert metrics["gateway"]["tokens"] == 4
         assert metrics["scheduler"]["num_slots"] == 2
         assert metrics["scheduler"]["compiled_programs"] >= 1
+        # fused decode-block gate verdict: this fp32 engine is excluded,
+        # and the reasons list says exactly why
+        assert metrics["scheduler"]["fused_decode_block"] is False
+        assert any("int8" in r
+                   for r in metrics["scheduler"]["fused_decode_reasons"])
         assert get(gw.port, "/nope")[0] == 404
     finally:
         assert gw.close(timeout=60)

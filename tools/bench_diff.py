@@ -65,7 +65,10 @@ _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
 
 def _lower_better(path):
     leaf = path.split(".")[-1].lower()
-    if "bytes_per_token" in leaf:
+    # explicit compounds: bytes_per_token (kv/weight traffic) and step_ms
+    # (the fused_block leg's per-decode-step wall time) read lower-is-better
+    # even though their leading token alone wouldn't resolve them
+    if "bytes_per_token" in leaf or "step_ms" in leaf:
         return True
     return any(tok in _LOWER_TOKENS for tok in leaf.split("_"))
 

@@ -2,7 +2,7 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane + fusedblock lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
@@ -14,6 +14,7 @@
 #   tools/ci_check.sh --disagg   # disaggregated prefill/decode lane only
 #   tools/ci_check.sh --moe      # MoE serving (expert-parallel decode) lane only
 #   tools/ci_check.sh --capacity # serving capacity/roofline + profiling lane only
+#   tools/ci_check.sh --fusedblock # fused llama-family decode-block lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -161,6 +162,26 @@ moe_lane() {
     tests/unit/inference/test_moe_decode.py -q -p no:cacheprovider
 }
 
+fusedblock_lane() {
+  echo "== fused decode-block lane =="
+  # fused llama-family decode-block guards, run UNFILTERED under the forced
+  # multi-CPU-device backend (the parity-matrix and scheduler-stream nodeids
+  # live in slow_tests.txt to keep tier-1 in budget): fused_paged_step ==
+  # per-projection apply_with_cache across RoPE x RMSNorm x SwiGLU x GQA x
+  # int8-KV x column width, greedy AND sampled scheduler streams identical
+  # through the fused_block/spec_block retagged programs (radix hit/cold,
+  # spec on/off), ZERO new XLA programs on a fresh request mix after warmup
+  # (jax.monitoring), one concrete gate reason per excluded model condition,
+  # and the capacity-meter registration of the new program kinds. The
+  # matching perf leg is `python bench.py serving` ("fused_block" entry:
+  # fused vs per-projection step_ms + tok/s, BENCH_SERVING_FUSED knob).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" python -m pytest \
+    tests/unit/inference/test_fused_block.py \
+    "tests/unit/inference/test_inference.py::test_fused_decode_block_matches_unfused" \
+    -q -p no:cacheprovider
+}
+
 capacity_lane() {
   echo "== serving capacity/roofline lane =="
   # serving goodput & capacity observability guards (telemetry/capacity.py
@@ -250,6 +271,10 @@ if [ "${1:-}" = "--capacity" ]; then
   capacity_lane
   exit $?
 fi
+if [ "${1:-}" = "--fusedblock" ]; then
+  fusedblock_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -298,7 +323,10 @@ me_rc=$?
 capacity_lane
 cp_rc=$?
 
+fusedblock_lane
+fb_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ] && [ "$fb_rc" -eq 0 ]
