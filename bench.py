@@ -375,7 +375,91 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
                lambda: _capacity_bench(make, max_new, seed,
                                        sample_every=int(os.environ.get(
                                            "BENCH_SERVING_CAPACITY", "8"))))
+    _guard_leg(results, "long_context",
+               lambda: _long_context_bench(seed,
+                                           max_ctx=int(os.environ.get(
+                                               "BENCH_SERVING_LONGCTX", "4096"))))
     return results
+
+
+def _long_context_bench(seed, max_ctx=4096, max_new=32):
+    """Long-context leg (BENCH_SERVING_LONGCTX = max context, 0 disables):
+    TTFT and mean ITL vs context length 256 -> max_ctx served over chained
+    KV extents deliberately sized far below the horizon (the multi-extent
+    paged path is on for every length), plus the compile guard the tentpole
+    promises: after the FIRST context length warms the stream, every longer
+    context reuses the same programs — extent count is an operand, so
+    ``new_programs_after_first_ctx`` must stay 0. A seq-parallel arm
+    re-measures the largest context's TTFT with prefill sharded over the
+    ``seq`` mesh axis when the host exposes enough devices (the
+    single-process CPU default skips it with a note)."""
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.comm import comm as _comm
+    from deepspeed_tpu.models.transformer import TransformerConfig, CausalLMModel
+
+    if max_ctx < 256:
+        return {"skipped": f"BENCH_SERVING_LONGCTX={max_ctx} < 256"}
+    extent = 512  # tiny extents: a 4k context spans an 8-extent chain
+    mcfg = TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                             num_heads=4, num_kv_heads=2, max_seq_len=max_ctx,
+                             intermediate_size=128, attention_impl="flash",
+                             scan_layers=False, decode_block_kv=64)
+    rng = np.random.default_rng(seed + 57)
+    ctxs = [c for c in (256, 512, 1024, 2048, 4096, 8192) if c <= max_ctx]
+
+    def build(mesh_kw=None, **sched_kw):
+        _comm._state["mesh"] = None
+        if mesh_kw:
+            _comm.initialize_mesh(**mesh_kw)
+        eng = deepspeed_tpu.init_inference(
+            CausalLMModel(mcfg),
+            config={"dtype": "float32", "decode_block_kv": 64,
+                    "continuous_batching": {"enabled": True, "num_slots": 4}})
+        sched = eng.scheduler(max_len=min(extent, max_ctx), prefill_chunk=64,
+                              max_extents=max(1, max_ctx // extent), **sched_kw)
+        return eng, sched
+
+    def run_one(sched, ctx):
+        prompt = rng.integers(0, 256, ctx - max_new).astype(np.int32)
+        t0 = time.perf_counter()
+        h = sched.submit(prompt, max_new_tokens=max_new)
+        toks = h.result()
+        dt = time.perf_counter() - t0
+        req = h._req
+        ttft = ((req.first_token_ts - req.submit_ts) * 1e3
+                if req.first_token_ts is not None else None)
+        itl = ((dt * 1e3 - (ttft or 0.0)) / max(1, len(toks) - 1))
+        return {"ttft_ms": round(ttft, 1) if ttft is not None else None,
+                "itl_ms": round(itl, 2),
+                "extents_spanned": -(-ctx // sched.max_len)}
+
+    compiles = []
+    jax.monitoring.register_event_duration_secs_listener(
+        lambda name, *a, **kw: compiles.append(name)
+        if name == "/jax/core/compile/backend_compile_duration" else None)
+    _, sched = build()
+    out = {"extent_tokens": sched.max_len, "max_extents": sched.cache.max_extents,
+           "max_new": max_new, "per_context": {}}
+    run_one(sched, ctxs[0])  # warm pass: every program the stream needs
+    n0 = len(compiles)
+    for ctx in ctxs:
+        out["per_context"][str(ctx)] = run_one(sched, ctx)
+    out["new_programs_after_first_ctx"] = len(compiles) - n0
+
+    # seq-parallel arm: shard the largest context's prefill over the seq axis
+    n_dev = len(jax.devices())
+    seq = max(d for d in (1, 2, 4, 8) if d <= n_dev and n_dev % d == 0)
+    if seq < 2:
+        out["seq_parallel"] = {"skipped": f"{n_dev} device(s): no seq axis"}
+    else:
+        _, sp = build(mesh_kw={"seq": seq}, seq_parallel_min_tokens=128)
+        run_one(sp, ctxs[0])  # warm (incl. the seqp program set)
+        out["seq_parallel"] = dict(run_one(sp, ctxs[-1]), seq_shards=seq,
+                                   single_shard_ttft_ms=out["per_context"]
+                                   [str(ctxs[-1])]["ttft_ms"])
+    _comm._state["mesh"] = None
+    return out
 
 
 def _observability_bench(make, max_new, seed):

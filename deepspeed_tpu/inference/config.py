@@ -142,6 +142,39 @@ class MultiLoRAConfig(DeepSpeedConfigModel):
                                "keep the list short")
 
 
+class LongContextConfig(DeepSpeedConfigModel):
+    """Long-context serving (``inference/scheduler.py`` +
+    ``inference/kv_cache.py``): requests whose context exceeds one slot
+    extent span chained pool slots through the extent-walking paged
+    kernels, their prefill optionally sharded over the ``seq`` mesh axis,
+    and cold extent ranges optionally paged to the host tier mid-decode.
+    See benchmarks/SERVING.md ("Long-context serving")."""
+
+    max_extents = ConfigField(default=1, help="pool slots ONE request may chain "
+                              "(spannable capacity = max_len x max_extents); the "
+                              "extent count is a runtime operand, so any value "
+                              "keeps the compiled-program count O(1). 1 disables "
+                              "chaining (byte-identical pre-extent programs); "
+                              "> 1 requires chunked prefill + flash attention")
+    seq_parallel_min_tokens = ConfigField(default=0, help="prompts at or above "
+                                          "this length prefill at the sequence-"
+                                          "parallel chunk width (sharded over "
+                                          "the seq mesh axis when it has "
+                                          "devices) — bit-identical to the "
+                                          "single-shard chunked path; 0 "
+                                          "disables seq-parallel prefill")
+    seq_parallel_degree = ConfigField(default=0, help="seq-parallel chunk width "
+                                      "multiplier: the wide chunk is "
+                                      "degree x prefill_chunk (clamped to the "
+                                      "slot extent); 0 = the seq mesh axis size")
+    allow_lossy_kv = ConfigField(default=False, help="permit per-request "
+                                 "kv_window=(sink, recent) lossy sliding-window "
+                                 "attention (StreamingLLM): out-of-window "
+                                 "extents drop from HBM without a host copy. "
+                                 "CHANGES LOGITS — off by default, and requests "
+                                 "must still opt in per-call")
+
+
 class ContinuousBatchingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving path (``inference/scheduler.py``):
     iteration-level admission into a fixed slot-pool KV cache. When enabled,
@@ -211,6 +244,11 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
         help="cold-expert host offload: page MoE expert kernels through "
         "LRU device pools so experts bigger than HBM still decode "
         "(deepspeed_tpu/moe/expert_store.py; see benchmarks/SERVING.md)")
+    long_context = ConfigField(
+        default=LongContextConfig,
+        help="long-context serving: multi-extent paged KV chains, "
+        "sequence-parallel chunked prefill, and mid-decode cold-range "
+        "demotion (see benchmarks/SERVING.md)")
     disaggregation = ConfigField(
         default=DisaggregationConfig,
         help="disaggregated prefill/decode: phase-specialized replicas with "

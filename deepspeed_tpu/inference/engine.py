@@ -744,6 +744,14 @@ class InferenceEngine:
                   "spec_ngram_max": cb.spec_ngram_max,
                   "spec_ngram_min": cb.spec_ngram_min,
                   "kv_cache_dtype": cb.kv_cache_dtype}
+            # long-context serving: extent chaining, seq-parallel prefill,
+            # and the lossy-window gate ride the config section straight
+            # through (scheduler validation owns the compose rules)
+            lc = cb.long_context
+            kw.update(max_extents=lc.max_extents,
+                      seq_parallel_min_tokens=lc.seq_parallel_min_tokens,
+                      seq_parallel_degree=lc.seq_parallel_degree,
+                      allow_lossy_kv=lc.allow_lossy_kv)
             hk = cb.hierarchical_kv
             dg = cb.disaggregation
             if hk.enabled or dg.enabled:

@@ -59,17 +59,26 @@ class SlotKVCache:
     - ``cached`` — released by its request but holding a retained prefix the
       radix cache still references (``refs[slot] > 0``); not allocatable
       until :meth:`reclaim` (radix eviction) returns it to the free list.
+    - ``extent`` — a secondary row of a long-context extent chain
+      (:meth:`alloc_chain`): its KV belongs to the chain's primary slot,
+      which alone carries the request's logical length and owner.
 
     ``pool`` is the device-side cache tree (``model.init_cache(num_slots,
     max_len)``); it is REPLACED by the scheduler after every compiled step
     (functional update with donation, so the buffers alias in place).
     """
 
-    def __init__(self, pool, num_slots, max_len, page_size=256):
+    def __init__(self, pool, num_slots, max_len, page_size=256, max_extents=1):
         self.pool = pool
         self.num_slots = int(num_slots)
         self.max_len = int(max_len)
         self.page_size = int(page_size)
+        # long-context extent chains: one request may span up to
+        # ``max_extents`` pool slots; ``lengths[primary]`` then counts the
+        # request's LOGICAL tokens (up to chain_len * max_len) while the
+        # extra slots sit in the ``extent`` state, invisible to alloc/radix
+        self.max_extents = int(max_extents)
+        self.chain = {}  # primary slot -> [primary, ext1, ...]; -1 = demoted
         self.lengths = np.zeros(self.num_slots, np.int32)  # live tokens per slot
         self.state = ["free"] * self.num_slots
         self.refs = np.zeros(self.num_slots, np.int32)  # trie references
@@ -100,12 +109,116 @@ class SlotKVCache:
         self.total_allocs += 1
         return slot
 
+    def alloc_chain(self, n_ext, owner=None):
+        """Claim ``n_ext`` pool slots as ONE logical extent chain for a
+        long-context request: the first (primary) slot carries the request's
+        bookkeeping — logical ``lengths`` row, owner, state ``active`` —
+        and every extra slot enters the ``extent`` state, off the free list
+        and invisible to radix reuse. Logical token position ``p`` lives in
+        extent ``p // max_len`` at offset ``p % max_len``; the scheduler's
+        per-request extent table hands the chain to the extent-walking
+        Pallas kernels. Returns the primary slot, or None when the request
+        exceeds ``max_extents`` or fewer than ``n_ext`` slots are free
+        (all-or-nothing: a partial chain is never claimed)."""
+        n_ext = int(n_ext)
+        if n_ext <= 1:
+            return self.alloc(owner)
+        if n_ext > self.max_extents or len(self._free) < n_ext:
+            return None
+        primary = self.alloc(owner)
+        members = [primary]
+        for _ in range(n_ext - 1):
+            s = self._free.pop()
+            self.lengths[s] = 0
+            self.state[s] = "extent"
+            self._owner[s] = owner
+            self.slot_version[s] = self.weights_version
+            members.append(s)
+        self.chain[primary] = members
+        return primary
+
+    def extents(self, slot):
+        """Pool rows backing ``slot``'s logical KV, extent order (entry i
+        holds logical tokens ``[i*max_len, (i+1)*max_len)``); -1 marks a
+        host-demoted extent. Single-extent slots are their own chain."""
+        return self.chain.get(slot, [slot])
+
+    def extent_capacity(self, slot):
+        """Logical token capacity of ``slot``'s chain (demoted extents
+        still count — their logical range exists, just not on-device)."""
+        return len(self.extents(slot)) * self.max_len
+
+    def missing_extents(self, slot):
+        """Indices of host-demoted extents in ``slot``'s chain — non-empty
+        means the request cannot decode (losslessly) until
+        :meth:`restore_extent` brings every index back."""
+        return [i for i, s in enumerate(self.extents(slot)) if s < 0]
+
+    def demote_extent(self, primary, idx):
+        """Release the pool row behind chain extent ``idx`` of ``primary``
+        (cold-range demotion: the KV bytes have been handed to the host
+        tier, or — lossy sliding-window mode — masked out forever). The
+        row returns to the free list for other admissions and the chain
+        marks the extent -1. Extent 0 is pinned: it anchors the request's
+        bookkeeping row AND holds the attention-sink tokens (StreamingLLM),
+        so only ``idx >= 1`` demotes. Returns the freed pool row."""
+        members = self.chain.get(primary)
+        if members is None:
+            raise ValueError(f"demote_extent on slot {primary} with no extent chain")
+        if not 1 <= int(idx) < len(members):
+            raise ValueError(f"extent index {idx} outside chain of {len(members)} "
+                             f"(extent 0 is pinned)")
+        s = members[int(idx)]
+        if s < 0:
+            raise ValueError(f"extent {idx} of slot {primary} already demoted")
+        self.state[s] = "free"
+        self._owner[s] = None
+        self._free.append(s)
+        members[int(idx)] = -1
+        return s
+
+    def restore_extent(self, primary, idx):
+        """Re-claim a pool row for a demoted extent (detect-miss-and-restore
+        paging: the scheduler noticed the next decode step needs the range
+        and is about to land the host copy back). Returns the new pool row,
+        or None when the free list is dry — the request stays PARKED and
+        the scheduler retries after the next free."""
+        members = self.chain.get(primary)
+        if members is None:
+            raise ValueError(f"restore_extent on slot {primary} with no extent chain")
+        if not 1 <= int(idx) < len(members):
+            raise ValueError(f"extent index {idx} outside chain of {len(members)}")
+        if members[int(idx)] >= 0:
+            raise ValueError(f"extent {idx} of slot {primary} is not demoted")
+        if not self._free:
+            return None
+        s = self._free.pop()
+        self.lengths[s] = 0
+        self.state[s] = "extent"
+        self._owner[s] = self._owner[primary]
+        self.slot_version[s] = self.weights_version
+        members[int(idx)] = s
+        return s
+
     def free(self, slot):
         """Return an active ``slot`` to the pool (eviction at
         token-iteration granularity: the scheduler calls this the moment a
-        sequence finishes, mid-decode-loop)."""
+        sequence finishes, mid-decode-loop). Frees the slot's whole extent
+        chain — demoted (-1) entries hold no pool row and are skipped."""
         if self.state[slot] != "active":
             raise ValueError(f"double free of slot {slot} (state {self.state[slot]})")
+        members = self.chain.pop(slot, None)
+        if members is not None:
+            for s in members[1:]:
+                if s < 0:
+                    continue
+                if self.state[s] != "extent":
+                    raise ValueError(f"chain member {s} of slot {slot} in state "
+                                     f"{self.state[s]} (extent bookkeeping drift)")
+                self.lengths[s] = 0
+                self.state[s] = "free"
+                self._owner[s] = None
+                self._free.append(s)
         self.lengths[slot] = 0
         self.state[slot] = "free"
         self._owner[slot] = None
@@ -119,6 +232,10 @@ class SlotKVCache:
         stays off the free list until :meth:`reclaim`."""
         if self.state[slot] != "active":
             raise ValueError(f"retain of non-active slot {slot} (state {self.state[slot]})")
+        if slot in self.chain:
+            raise ValueError(
+                f"retain of multi-extent slot {slot}: spanned prefixes don't "
+                f"register for radix reuse (free the chain instead)")
         if self.refs[slot] <= 0:
             raise ValueError(f"retain of slot {slot} with no trie reference")
         if self.slot_version[slot] != self.weights_version:
@@ -143,8 +260,20 @@ class SlotKVCache:
         self._free.append(slot)
 
     def fits(self, prompt_len, max_new_tokens):
-        """Would a request of this shape ever fit a slot?"""
-        return prompt_len + max_new_tokens <= self.max_len
+        """Would a request of this shape ever fit — spanning up to
+        ``max_extents`` chained slots when one extent isn't enough?"""
+        return prompt_len + max_new_tokens <= self.spannable_len
+
+    @property
+    def spannable_len(self):
+        """Maximum logical tokens one request can hold across its longest
+        permitted extent chain."""
+        return self.max_len * self.max_extents
+
+    def extents_needed(self, total_tokens):
+        """Chain length a request of ``total_tokens`` logical tokens needs
+        (ceil over the per-extent capacity; at least 1)."""
+        return max(1, -(-int(total_tokens) // self.max_len))
 
     def adopt_rows(self, slot, length, version):
         """Account ``length`` externally-computed KV rows landing on an
@@ -162,8 +291,9 @@ class SlotKVCache:
                 f"a pool at version {self.weights_version}: a migrated request "
                 f"whose weights were swapped mid-handoff must fail, not decode "
                 f"on stale rows")
-        if not 0 <= int(length) <= self.max_len:
-            raise ValueError(f"adopt_rows length {length} outside [0, {self.max_len}]")
+        cap = self.extent_capacity(slot)
+        if not 0 <= int(length) <= cap:
+            raise ValueError(f"adopt_rows length {length} outside [0, {cap}]")
         self.lengths[slot] = int(length)
         self.slot_version[slot] = self.weights_version
 
@@ -191,6 +321,11 @@ class SlotKVCache:
     @property
     def cached_slots(self):
         return sum(1 for s in self.state if s == "cached")
+
+    @property
+    def extent_slots(self):
+        """Pool rows serving as secondary extents of long-context chains."""
+        return sum(1 for s in self.state if s == "extent")
 
     @property
     def free_slots(self):
@@ -279,7 +414,36 @@ class SlotKVCache:
                     f"{self.weights_version} (stale-weights KV retained)")
             if self.refs[i] < 0:
                 raise AssertionError(f"negative refcount on slot {i}")
-        if self.active_slots + self.cached_slots + self.free_slots != self.num_slots:
+        chained = [s for m in self.chain.values() for s in m[1:] if s >= 0]
+        if len(set(chained)) != len(chained):
+            raise AssertionError("pool row appears in two extent chains")
+        for primary, members in self.chain.items():
+            if len(members) < 2 or len(members) > self.max_extents:
+                raise AssertionError(f"chain of slot {primary} has bad length "
+                                     f"{len(members)} (max_extents {self.max_extents})")
+            if members[0] != primary:
+                raise AssertionError(f"chain of slot {primary} doesn't lead with it")
+            if self.state[primary] != "active":
+                raise AssertionError(f"chain primary {primary} is "
+                                     f"{self.state[primary]}, not active")
+            if self.lengths[primary] > len(members) * self.max_len:
+                raise AssertionError(f"slot {primary} logical length "
+                                     f"{int(self.lengths[primary])} exceeds its "
+                                     f"chain capacity")
+            for s in members[1:]:
+                if s < 0:
+                    continue  # demoted: range lives on the host tier
+                if self.state[s] != "extent":
+                    raise AssertionError(f"chain member {s} of slot {primary} is "
+                                         f"{self.state[s]}, not extent")
+                if self.lengths[s] != 0 or self.refs[s] != 0:
+                    raise AssertionError(f"extent row {s} holds its own "
+                                         f"lengths/refs (belong to the primary)")
+        for i, s in enumerate(self.state):
+            if s == "extent" and i not in set(chained):
+                raise AssertionError(f"extent-state row {i} belongs to no chain")
+        if (self.active_slots + self.cached_slots + self.free_slots
+                + self.extent_slots != self.num_slots):
             raise AssertionError("slot states don't partition the pool")
 
 

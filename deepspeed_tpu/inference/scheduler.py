@@ -160,6 +160,13 @@ from .speculative import PromptLookupDrafter
 # unlocked: each scheduler stays single-threaded within its own pump.
 _PROGRAM_LOCK = threading.RLock()
 
+# Host-store namespace for mid-decode extent demotion: parked extent entries
+# key as ``(_EXT_NS, rid, extent_idx)`` — a negative sentinel no prompt
+# token-tuple or adapter namespace can collide with (same convention as the
+# adapter store's negative-uid namespaces). Entries are pinned and held by
+# the owning scheduler; probes can never surface them.
+_EXT_NS = -0x10C7E57
+
 
 def _bucket_len(n, base, cap):
     """Prefill bucket: next power of two >= n (floor ``base``), capped at
@@ -226,11 +233,11 @@ class _Request:
                  "temperature", "top_k", "top_p", "seed", "slot", "out", "logits",
                  "done", "cancelled", "submit_ts", "first_token_ts", "collect_logits",
                  "on_token", "trace", "adapter_id", "adapter_ref", "handle",
-                 "migrating", "error")
+                 "migrating", "error", "kv_window", "row_budget")
 
     def __init__(self, rid, prompt, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, seed, collect_logits, submit_ts,
-                 on_token=None, trace=None, adapter_id=None):
+                 on_token=None, trace=None, adapter_id=None, kv_window=None):
         self.rid = rid
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -267,6 +274,15 @@ class _Request:
         # 500 and SchedulerHandle.result() raises instead of returning a
         # silently truncated stream
         self.error = None
+        # lossy long-context mode: (sink, recent) sliding-window knob — the
+        # request attends only its first ``sink`` and last ``recent`` tokens
+        # (StreamingLLM), which BREAKS bit-identity and is gated behind the
+        # scheduler's allow_lossy_kv. None = lossless (the default)
+        self.kv_window = kv_window
+        # KV rows reserved past the prompt (multi-step/spec overshoot
+        # rounding, stamped at submit): admission sizes extent chains from
+        # prompt + row_budget so a chain can never stall mid-decode
+        self.row_budget = 0
 
 
 class SchedulerHandle:
@@ -316,11 +332,15 @@ class _PrefillState:
     prompt position to feed — rows ``[0, pos)`` of the slot already hold KV
     (prefix-cache copy and/or earlier chunks)."""
 
-    __slots__ = ("req", "pos")
+    __slots__ = ("req", "pos", "seq_parallel")
 
     def __init__(self, req, pos):
         self.req = req
         self.pos = pos
+        # sequence-parallel chunked prefill: this prefill's wide forwards
+        # run at the seq-parallel chunk width (sharded over the seq mesh
+        # axis when it has more than one device)
+        self.seq_parallel = False
 
 
 class DecodeScheduler:
@@ -342,7 +362,8 @@ class DecodeScheduler:
                  prefix_cache=True, spec_tokens=0, spec_ngram_max=3,
                  spec_ngram_min=1, kv_cache_dtype="auto", compiled_cache=None,
                  prefix_store=None, restore_min_tokens=0, adapter_store=None,
-                 expert_store=None):
+                 expert_store=None, max_extents=1, seq_parallel_min_tokens=0,
+                 seq_parallel_degree=0, allow_lossy_kv=False):
         self.engine = engine
         # raw constructor args, so a replica set can clone this scheduler's
         # exact configuration for its sibling replicas (normalization —
@@ -359,7 +380,11 @@ class DecodeScheduler:
             spec_tokens=spec_tokens, spec_ngram_max=spec_ngram_max,
             spec_ngram_min=spec_ngram_min, kv_cache_dtype=kv_cache_dtype,
             prefix_store=prefix_store, restore_min_tokens=restore_min_tokens,
-            adapter_store=adapter_store, expert_store=expert_store)
+            adapter_store=adapter_store, expert_store=expert_store,
+            max_extents=max_extents,
+            seq_parallel_min_tokens=seq_parallel_min_tokens,
+            seq_parallel_degree=seq_parallel_degree,
+            allow_lossy_kv=allow_lossy_kv)
         model = engine.module
         cfg = engine._config
         if max_len is None:
@@ -392,6 +417,52 @@ class DecodeScheduler:
         # chunked prefill: clamp the chunk to the slot capacity (a chunk
         # wider than a slot could never land a full write)
         self.prefill_chunk = min(max(0, int(prefill_chunk)), S)
+        # ---- long-context serving: multi-extent paged KV, seq-parallel
+        # chunked prefill, mid-decode cold-range demotion ------------------
+        me = max(1, int(max_extents))
+        if me > 1 and self.prefill_chunk <= 0:
+            raise ValueError(
+                "long_context.max_extents > 1 requires chunked prefill "
+                "(prefill_chunk > 0): the monolithic prefill path writes one "
+                "contiguous slot and has no extent plumbing")
+        # a chain's logical positions are bounded by the model's rope/mask
+        # horizon — extents past max_seq_len could never hold a valid row
+        me = max(1, min(me, model.cfg.max_seq_len // S))
+        self.allow_lossy_kv = bool(allow_lossy_kv)
+        self.seq_parallel_min_tokens = max(0, int(seq_parallel_min_tokens))
+        seq_on = self.seq_parallel_min_tokens > 0
+        if seq_on and self.prefill_chunk <= 0:
+            raise ValueError(
+                "seq_parallel_min_tokens > 0 requires chunked prefill "
+                "(prefill_chunk > 0): sequence parallelism shards the "
+                "chunked path's wide prefill forwards")
+        seq_ax = int(engine.mesh.shape[dist.SEQ_AXIS])
+        tp_ax = int(engine.mesh.shape[dist.TENSOR_AXIS])
+        self._seq_shards = seq_ax if (seq_on and seq_ax > 1) else 1
+        if self._seq_shards > 1 and tp_ax > 1:
+            raise ValueError(
+                "sequence-parallel prefill composes with tp=1 only: the "
+                "seq-sharded span kernel gathers over the seq axis while "
+                "tensor parallelism already shards the attention heads")
+        if seq_on:
+            # seq-parallel chunk width: the configured degree (default: the
+            # seq mesh axis) times the base chunk, clamped to the extent and
+            # rounded to a shard multiple (the sharded kernel splits the
+            # query block evenly across the seq axis)
+            deg = max(1, int(seq_parallel_degree) or seq_ax)
+            Cs = min(deg * self.prefill_chunk, S)
+            Cs = max((Cs // self._seq_shards) * self._seq_shards,
+                     self.prefill_chunk)
+            self._seq_chunk = Cs
+        else:
+            self._seq_chunk = 0
+        if ((me > 1 or self._seq_chunk or self.allow_lossy_kv)
+                and getattr(model.cfg, "attention_impl", "xla") != "flash"):
+            raise ValueError(
+                "long-context serving (max_extents > 1 / seq-parallel "
+                "prefill / lossy KV windows) requires "
+                "attention_impl='flash': the extent block walk and the "
+                "seq-sharded span kernel live in the paged Pallas path")
         # KV storage tier: "auto" rides the model compute dtype; "int8" is
         # the group-quantized paged tier (3-leaf pool with joint per-token-
         # row scales); explicit float names force that precision
@@ -408,7 +479,8 @@ class DecodeScheduler:
             kv_arg = _DTYPE_MAP[kvd]
         self.kv_quantized = kv_arg == "int8"
         self.cache = SlotKVCache(engine._init_cache(int(num_slots), S, kv_dtype=kv_arg),
-                                 int(num_slots), S, page_size=min(block, S))
+                                 int(num_slots), S, page_size=min(block, S),
+                                 max_extents=me)
         # self-speculative decoding: spec_tokens drafted columns verified
         # per pure-decode sync (clamped so a full verify block always fits
         # one slot alongside at least one row of decode headroom)
@@ -496,6 +568,14 @@ class DecodeScheduler:
             self._fused_block_reasons = [
                 "model family without fused decode-block support"]
         self._prefill = None  # at most one in-flight _PrefillState
+        # long-context paging: slots whose chained extents are (partly)
+        # host-demoted sit in ``_parked`` — excluded from every dispatch
+        # until step()'s paging pump restores them; the pinned host-store
+        # entries park in ``_ext_parked`` keyed (rid, extent_idx)
+        self._parked = set()
+        self._ext_parked = {}
+        self.longctx_demotes = 0
+        self.longctx_restores = 0
         self.queue = collections.deque()
         self.active = {}  # slot -> _Request
         # disaggregated prefill/decode (serving/replica.py): when set by the
@@ -583,16 +663,21 @@ class DecodeScheduler:
             self.telemetry.gauges([
                 ("serving/kv_bytes_per_token", self.cache.bytes_per_token(), None),
                 ("serving/kv_cache_capacity_bytes", self.cache.capacity_bytes(), None)])
-        if self.experts is not None:
+        if (self.experts is not None or me > 1 or self._seq_chunk
+                or self.allow_lossy_kv):
             # cold-expert serving warms EVERY variant the replay/backoff
             # ladder can reach, at build — before any gateway recompile
-            # watch arms — so residency churn never compiles mid-stream
+            # watch arms — so residency churn never compiles mid-stream.
+            # Long-context serving warms for the same reason: the extent /
+            # seq-parallel program variants must exist before the first
+            # spilling request arrives, so a fresh length/extent mix adds
+            # ZERO XLA programs mid-stream
             self.warm_programs()
 
     # ------------------------------------------------------------------ API
     def submit(self, prompt, max_new_tokens=64, eos_token_id=None, do_sample=False,
                temperature=1.0, top_k=0, top_p=1.0, seed=0, collect_logits=None,
-               on_token=None, trace=None, adapter_id=None):
+               on_token=None, trace=None, adapter_id=None, kv_window=None):
         """Enqueue one request; returns a :class:`SchedulerHandle`. The
         request joins the decode batch as soon as a slot frees up.
 
@@ -619,8 +704,28 @@ class DecodeScheduler:
         gathered inside the shared fused programs. Requires an attached
         :class:`~deepspeed_tpu.adapters.PagedAdapterStore` with the id
         registered; None is base-model traffic (bit-identical to the
-        pre-adapter programs)."""
+        pre-adapter programs).
+
+        ``kv_window``: OPTIONAL ``(sink, recent)`` lossy long-context knob
+        (attention sinks + sliding window, StreamingLLM-style): the request
+        attends only its first ``sink`` and most recent ``recent`` tokens,
+        and extents that slide entirely outside that window are dropped
+        from HBM without a host copy. This CHANGES the logits — it is
+        gated behind ``long_context.allow_lossy_kv`` and off by default."""
         tel = self.telemetry
+        if kv_window is not None:
+            if not self.allow_lossy_kv:
+                raise ValueError(
+                    "request sets kv_window but lossy long-context KV is not "
+                    "enabled (continuous_batching.long_context.allow_lossy_kv):"
+                    " sliding-window attention changes logits and must be "
+                    "opted into explicitly")
+            sink, recent = int(kv_window[0]), int(kv_window[1])
+            if sink < 0 or recent < 1:
+                raise ValueError(
+                    f"kv_window must be (sink >= 0, recent >= 1), got "
+                    f"{kv_window!r}")
+            kv_window = (sink, recent)
         if adapter_id is not None:
             if self.adapters is None:
                 raise ValueError(
@@ -632,19 +737,23 @@ class DecodeScheduler:
                        temperature, top_k, top_p, seed,
                        self.collect_logits if collect_logits is None else collect_logits,
                        tel.now(), on_token=on_token, trace=trace,
-                       adapter_id=adapter_id)
+                       adapter_id=adapter_id, kv_window=kv_window)
         self._rid += 1
         if trace is not None:
             trace.attrs.setdefault("sched_rid", req.rid)
         # validate the PROMPT alone up front (before any early return): a
         # prompt that can never fit a slot must fail here with a clear
         # message, not deep inside a compiled prefill
-        if req.prompt.size >= self.max_len:
+        cap = (self.cache.spannable_len if self.prefill_chunk > 0
+               else self.max_len)
+        if req.prompt.size >= cap:
             raise ValueError(
                 f"prompt of {req.prompt.size} tokens exceeds the per-slot KV capacity "
-                f"{self.max_len} (a prompt needs at least one row of decode headroom); "
-                f"raise the scheduler's max_len / the engine's max_out_tokens, or "
-                f"shorten the prompt")
+                f"{self.max_len} x {self.cache.max_extents} extent(s) = {cap} "
+                f"spannable rows (a prompt needs at least one row of decode "
+                f"headroom); raise the scheduler's max_len / the engine's "
+                f"max_out_tokens / long_context.max_extents, or shorten the "
+                f"prompt")
         if req.max_new_tokens <= 0:  # static-path parity: zero-budget -> no tokens
             req.done = True
             return SchedulerHandle(self, req)
@@ -657,8 +766,14 @@ class DecodeScheduler:
         if not self.cache.fits(req.prompt.size, budget):
             raise ValueError(
                 f"request needs {req.prompt.size + budget} cache rows > "
-                f"slot capacity {self.max_len}; raise max_out_tokens/num_slots' max_len "
-                f"or shorten the request")
+                f"slot capacity {self.max_len} x {self.cache.max_extents} "
+                f"extent(s) = {self.cache.spannable_len}; raise "
+                f"max_out_tokens/num_slots' max_len / "
+                f"long_context.max_extents, or shorten the request")
+        # admission sizes multi-extent chains against this reservation —
+        # all rows the K-step/spec overshoot can ever write are covered, so
+        # a chain never stalls on extent exhaustion mid-decode
+        req.row_budget = int(budget)
         handle = SchedulerHandle(self, req)
         req.handle = handle
         self.queue.append(req)
@@ -938,6 +1053,12 @@ class DecodeScheduler:
         while self._adapter_invalidations:
             self._invalidate_adapter_uid(self._adapter_invalidations.popleft())
         self._reap_cancelled()
+        if self._parked or self.cache.chain:
+            # long-context paging pump: restore parked extents BEFORE
+            # admission so a freed slot un-parks a live request rather than
+            # admitting new work in front of it; lossy rows drop extents
+            # that slid outside their attention window
+            self._service_long_context()
         admitted = 0
         if self._paused:
             pass  # swap protocol: no admission; in-flight work still advances
@@ -986,6 +1107,16 @@ class DecodeScheduler:
             kind = "fused"
             delivered, ksteps = self._fused_chunk_step()
         elif self.active:
+            if self._parked and all(s in self._parked for s in self.active):
+                # nothing can dispatch and nothing can ever free a row:
+                # every live request waits on a restore, and restores wait
+                # on a free row only a live request could release
+                self._iter_links = None
+                raise RuntimeError(
+                    "long-context paging deadlock: every live request is "
+                    "parked on demoted extents and no free pool row exists "
+                    "to restore into — demote fewer extents or leave slot "
+                    "headroom")
             if self.drafter is not None:
                 kind = "spec"
                 delivered, ksteps = self._spec_decode_step()
@@ -1079,6 +1210,19 @@ class DecodeScheduler:
         else:
             self.cache.free(slot)
 
+    def _drop_parked(self, slot, req):
+        """Forget a departing request's extent-paging state: the slot
+        leaves the parked set and any host-parked extent entries are
+        discarded (a finished/cancelled request's demoted KV dies with
+        it). No-op for the single-extent common case."""
+        if not self._parked and not self._ext_parked:
+            return
+        self._parked.discard(slot)
+        for key in [k for k in self._ext_parked if k[0] == req.rid]:
+            del self._ext_parked[key]
+            if self.kv_tier is not None:
+                self.kv_tier.store.discard((_EXT_NS, req.rid, key[1]))
+
     def _reap_cancelled(self):
         """Evict slots whose requests were cancelled (handle dropped). Runs
         only from step() — the single-threaded loop — so eviction never
@@ -1089,6 +1233,7 @@ class DecodeScheduler:
                 req.done = True
                 del self.active[slot]
                 self._release_slot(slot)
+                self._drop_parked(slot, req)
                 self._release_adapter(req)
                 if tel.enabled:
                     tel.counter("serving/cancelled")
@@ -1106,6 +1251,170 @@ class DecodeScheduler:
                 tel.counter("serving/cancelled")
             if req.trace is not None:
                 req.trace.instant("cancelled", where="prefill")
+
+    # ------------------------------------------------------------------ long context
+    def _ext_operands(self, rows, force=False):
+        """The extent-walk operand block for ONE dispatch — ``(ext_table
+        (N, E), wslot (N,), ext_base (N,), sinks (N,), wins (N,))`` over
+        the FULL slot axis — or None when no live row needs it (chains and
+        lossy windows absent, ``force`` off; the plain programs then run
+        byte-identical to the pre-extent scheduler). ``force`` is for the
+        seq-parallel program, whose signature always carries the block.
+
+        Rows without a chain get the identity single-extent table; demoted
+        extents carry -1 (the kernel clamps the DMA index and masks the
+        range — only lossy rows ever dispatch with one). ``wslot`` /
+        ``ext_base`` redirect each row's KV writes into its WRITE extent's
+        pool row; all-zero sinks/wins are the lossless sentinel."""
+        if not force and not self.cache.chain and not any(
+                r.kv_window is not None for _, r in rows):
+            return None
+        N = self.cache.num_slots
+        S = self.max_len
+        E = max(1, self.cache.max_extents)
+        ext = np.full((N, E), -1, np.int32)
+        ext[:, 0] = np.arange(N, dtype=np.int32)
+        wslot = np.arange(N, dtype=np.int32)
+        base = np.zeros(N, np.int32)
+        sinks = np.zeros(N, np.int32)
+        wins = np.zeros(N, np.int32)
+        for slot, req in rows:
+            members = self.cache.extents(slot)
+            for i, m in enumerate(members):
+                ext[slot, i] = m
+            w = min(int(self.cache.lengths[slot]) // S, len(members) - 1)
+            wslot[slot] = max(int(members[w]), 0)
+            base[slot] = w * S
+            if req.kv_window is not None:
+                sinks[slot] = req.kv_window[0]
+                wins[slot] = req.kv_window[1]
+        return ext, wslot, base, sinks, wins
+
+    def demote_cold_extents(self, slot, keep_recent=1):
+        """Page a live multi-extent request's COLD extents out of HBM.
+
+        Extent 0 (the attention-sink prefix, pinned) and the write extent
+        (plus ``keep_recent - 1`` extents before it) stay resident; extents
+        past the write head hold nothing and are skipped. Lossless mode
+        (the default — no ``kv_window`` on the request) copies each demoted
+        extent to the hierarchical host tier and PARKS the row: it skips
+        every dispatch until :meth:`step`'s paging pump restores all of
+        them (detect-miss-and-restore), so the emitted stream stays
+        bit-identical. A lossy request (``kv_window``) drops the rows
+        outright — its sliding-window mask already hides every position
+        they held. Returns the number of extents demoted."""
+        req = self.active.get(slot)
+        if req is None:
+            raise ValueError(f"slot {slot} is not a live decode row")
+        members = self.cache.extents(slot)
+        if len(members) <= 1:
+            return 0
+        lossy = req.kv_window is not None
+        if not lossy and self.kv_tier is None:
+            raise ValueError(
+                "lossless extent demotion requires the hierarchical KV tier "
+                "(continuous_batching.hierarchical_kv) for the host-side "
+                "copy; enable it, or submit the request with kv_window for "
+                "the lossy sliding-window mode")
+        S = self.max_len
+        tel = self.telemetry
+        w = min(int(self.cache.lengths[slot]) // S, len(members) - 1)
+        keep = {max(0, w - i) for i in range(max(1, int(keep_recent)))}
+        demoted = 0
+        for idx in range(1, len(members)):
+            if idx in keep or idx > w or members[idx] < 0:
+                continue
+            if not lossy:
+                # copy to host FIRST (the cache-level demote frees the row)
+                entry = self.kv_tier.demote_extent(
+                    members[idx], (_EXT_NS, req.rid, idx))
+                self._ext_parked[(req.rid, idx)] = entry
+            self.cache.demote_extent(slot, idx)
+            demoted += 1
+            self.longctx_demotes += 1
+            if tel.enabled:
+                tel.counter("serving/longctx_demote_tokens", S)
+            if self.capacity is not None and not lossy:
+                # paging traffic, not tokens: the demoted bytes buy HBM
+                # headroom, never a request token
+                self.capacity.account(
+                    0, wasted_bytes=S * self.cache.bytes_per_token())
+        if demoted and not lossy:
+            self._parked.add(slot)
+        return demoted
+
+    def _service_long_context(self):
+        """Host-side extent paging pump, once per scheduler iteration:
+
+        - lossy rows (``kv_window``) auto-drop extents that have slid
+          entirely outside their attention sink + recent window — the
+          window mask already hides every position they hold (and the
+          window's trailing edge only ever advances, so a dropped extent
+          can never be needed again);
+        - parked rows (lossless :meth:`demote_cold_extents`) restore every
+          missing extent into free pool rows — reclaiming LRU radix
+          prefixes under pressure — and rejoin the batch the moment the
+          last one lands.
+        """
+        tel = self.telemetry
+        S = self.max_len
+        for slot, req in list(self.active.items()):
+            if req.kv_window is None or slot not in self.cache.chain:
+                continue
+            sink, recent = req.kv_window
+            length = int(self.cache.lengths[slot])
+            members = self.cache.extents(slot)
+            for idx in range(1, len(members)):
+                if members[idx] < 0:
+                    continue
+                if idx * S >= sink and (idx + 1) * S <= length - recent:
+                    self.cache.demote_extent(slot, idx)
+                    self.longctx_demotes += 1
+                    if tel.enabled:
+                        tel.counter("serving/longctx_demote_tokens", S)
+        if not self._parked:
+            return
+        for slot in sorted(self._parked):
+            req = self.active.get(slot)
+            if req is None or req.cancelled:
+                continue  # _reap_cancelled owns the teardown
+            restored_all = True
+            for idx in self.cache.missing_extents(slot):
+                row = self.cache.restore_extent(slot, idx)
+                while row is None and self.radix is not None:
+                    victim = self.radix.evict_lru()
+                    if victim is None:
+                        break
+                    self.cache.reclaim(victim)
+                    if tel.enabled:
+                        tel.counter("serving/prefix_cache_evict")
+                    row = self.cache.restore_extent(slot, idx)
+                if row is None:
+                    restored_all = False  # free list dry: retry next iter
+                    break
+                entry = self._ext_parked.pop((req.rid, idx), None)
+                if entry is None or self.kv_tier is None:
+                    raise RuntimeError(
+                        "long-context paging invariant violated: a demoted "
+                        "extent has no parked host entry to restore from")
+                t0 = time.perf_counter() if self._gap is not None else 0.0
+                with self.engine.mesh:
+                    ok = self.kv_tier.restore_extent(entry, row)
+                if self._gap is not None:
+                    self._gap.add("tier_transfer", time.perf_counter() - t0)
+                if not ok:
+                    raise RuntimeError(
+                        "long-context paging invariant violated: a parked "
+                        "extent entry vanished from the host store while "
+                        "its request was live")
+                self.longctx_restores += 1
+                if tel.enabled:
+                    tel.counter("serving/longctx_restore_tokens", S)
+                if self.capacity is not None:
+                    self.capacity.account(
+                        0, wasted_bytes=S * self.cache.bytes_per_token())
+            if restored_all:
+                self._parked.discard(slot)
 
     # ------------------------------------------------------------------ admit
     def _acquire_slot(self, req):
@@ -1129,6 +1438,28 @@ class DecodeScheduler:
             if aref is None:
                 return None, (0, None)  # every page pinned: retry next iter
         akey = aref.uid if aref is not None else None
+        # multi-extent request: reserve the WHOLE chain (prompt + decode
+        # budget) up front, all-or-nothing — extents claimed lazily could
+        # deadlock mid-decode with nothing evictable. Chains skip radix
+        # reuse both ways: prefix donors are single-extent slots, and a
+        # chained slot is never retained (free() tears the chain down)
+        n_ext = self.cache.extents_needed(req.prompt.size + req.row_budget)
+        if n_ext > 1:
+            slot = self.cache.alloc_chain(n_ext, owner=req.rid)
+            while slot is None and self.radix is not None:
+                victim = self.radix.evict_lru()
+                if victim is None:
+                    break
+                self.cache.reclaim(victim)
+                if self.telemetry.enabled:
+                    self.telemetry.counter("serving/prefix_cache_evict")
+                slot = self.cache.alloc_chain(n_ext, owner=req.rid)
+            if slot is None:
+                if aref is not None:
+                    self.adapters.release(aref)
+                return None, (0, None)
+            req.adapter_ref = aref
+            return slot, (0, None)
         if self.radix is not None:
             t0 = time.perf_counter() if self._gap is not None else 0.0
             match = self.radix.match(req.prompt, adapter=akey)
@@ -1172,7 +1503,8 @@ class DecodeScheduler:
         if tr is not None and tr.enabled:
             tr.mark("prefill")  # phase closes at _finish_prefill
             probe_t0 = tel.now()
-        if self.radix is not None:
+        # multi-extent chains skip prefix reuse entirely (see _acquire_slot)
+        if self.radix is not None and slot not in self.cache.chain:
             m, donor = match
             m = min(m, req.prompt.size - 1)
             m = (m // self.prefill_chunk) * self.prefill_chunk
@@ -1245,7 +1577,15 @@ class DecodeScheduler:
         if req.adapter_id is not None and tel.enabled:
             tel.counter(f"serving/adapter/{self.adapters.label(req.adapter_id)}"
                         f"/requests")
-        self._prefill = _PrefillState(req, pos)
+        pf = _PrefillState(req, pos)
+        pf.seq_parallel = bool(self._seq_chunk
+                               and req.prompt.size >= self.seq_parallel_min_tokens)
+        if tel.enabled:
+            tel.histogram("serving/kv_extents_per_request",
+                          len(self.cache.extents(slot)))
+            if pf.seq_parallel:
+                tel.counter("serving/seq_parallel_prefills")
+        self._prefill = pf
 
     def _finish_prefill(self, req, tok, last_logits):
         """The final chunk landed: deliver token 0, register the prompt in
@@ -1254,7 +1594,7 @@ class DecodeScheduler:
         tel = self.telemetry
         self._prefill = None
         self.active[req.slot] = req
-        if self.radix is not None:
+        if self.radix is not None and req.slot not in self.cache.chain:
             akey = req.adapter_ref.uid if req.adapter_ref is not None else None
             if self.kv_tier is not None:
                 # a cold/device-hit prefill supersedes this scheduler's own
@@ -1342,6 +1682,7 @@ class DecodeScheduler:
             if req.slot in self.active:
                 del self.active[req.slot]
             self._release_slot(req.slot)
+            self._drop_parked(req.slot, req)
             self._release_adapter(req)
             if self.telemetry.enabled:
                 self.telemetry.counter("serving/evicted")
@@ -1490,7 +1831,12 @@ class DecodeScheduler:
             lens = np.asarray(step_args[3])
             live_ctx = lens[spans > 0] if spans.shape == lens.shape else lens
             width, ksteps = program_shape(key)
-            cap.observe_dispatch(key, dur, live_ctx, width, ksteps)
+            # the extent-walk kernels DMA every extent's pool column per KV
+            # block, so their KV traffic prices at max_extents x contiguous
+            kv_mult = (self.cache.max_extents
+                       if key[0] in ("fused_ext", "fused_seqp") else 1)
+            cap.observe_dispatch(key, dur, live_ctx, width, ksteps,
+                                 kv_mult=kv_mult)
         return out
 
     def _call_step(self, fn, args, lora):
@@ -1598,11 +1944,15 @@ class DecodeScheduler:
                 (seeds, steps, flags, temps, topks, topps, sampling,
                  collect) = self._gather_sampling(group)
                 lora = self._adapter_arg(group)
-                fn = self._fused_fn(sampling, collect, 1, 1, lora=lora is not None)
+                eo = self._ext_operands(group)
+                fn = self._fused_fn(sampling, collect, 1, 1, lora=lora is not None,
+                                    ext=eo is not None)
                 args = (eng.params, self.cache.pool, jnp.asarray(ids),
                         jnp.asarray(lens), jnp.asarray(spans),
                         jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
                         jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+                if eo is not None:
+                    args = args + tuple(jnp.asarray(x) for x in eo)
                 try:
                     out = self._call_step(fn, args, lora)
                     break
@@ -1633,12 +1983,15 @@ class DecodeScheduler:
         eng = self.engine
         preq = pf.req
         N, C = self.cache.num_slots, self.prefill_chunk
+        S = self.max_len
         ps = preq.slot
         L = preq.prompt.size
         delivered = 0
         chunk_end = min(pf.pos + C, L)
         while pf.pos < chunk_end:
-            take = chunk_end - pf.pos
+            # never cross an extent boundary mid-piece: the write targets
+            # one extent per forward (same rule as the normal chunk step)
+            take = min(chunk_end - pf.pos, S - pf.pos % S)
             while True:
                 ids = np.zeros((N, C), np.int32)
                 spans = np.zeros(N, np.int32)
@@ -1658,12 +2011,15 @@ class DecodeScheduler:
                 topks[ps] = preq.top_k
                 topps[ps] = preq.top_p
                 lora = self._adapter_arg([(ps, preq)])
+                eo = self._ext_operands([(ps, preq)])
                 fn = self._fused_fn(preq.do_sample, preq.collect_logits, 1, C,
-                                    lora=lora is not None)
+                                    lora=lora is not None, ext=eo is not None)
                 args = (eng.params, self.cache.pool, jnp.asarray(ids),
                         jnp.asarray(lens), jnp.asarray(spans),
                         jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
                         jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+                if eo is not None:
+                    args = args + tuple(jnp.asarray(x) for x in eo)
                 try:
                     out = self._call_step(fn, args, lora)
                     break
@@ -1686,6 +2042,8 @@ class DecodeScheduler:
                     else None)
                 delivered += 1
                 if (not preq.done and self.migrate_hook is not None
+                        and ps not in self.cache.chain
+                        and preq.kv_window is None
                         and self.migrate_hook(self, preq)):
                     pass  # migrated out (see _fused_chunk_step)
             else:
@@ -1718,20 +2076,25 @@ class DecodeScheduler:
             lora_args += (tuple((jnp.asarray(np.zeros(N, np.int32)), pools[b])
                                 for b in self.adapters.bucket_keys()), )
 
-        def dispatch(fn, width, lora):
+        def dispatch(fn, width, lora, ext_args=()):
             args = (self.engine.params, self.cache.pool,
                     jnp.asarray(np.zeros((N, width), np.int32)),
                     jnp.asarray(zeros), jnp.asarray(zeros),
                     jnp.asarray(np.zeros(N, np.uint32)), jnp.asarray(zeros),
                     jnp.asarray(np.zeros(N, bool)),
                     jnp.asarray(np.ones(N, np.float32)), jnp.asarray(zeros),
-                    jnp.asarray(np.ones(N, np.float32)))
+                    jnp.asarray(np.ones(N, np.float32))) + tuple(ext_args)
             out = self._call_step(fn, args, lora)
             self.cache.pool = out[0]
 
+        shapes = sorted({(K, C), (1, C), (K, 1), (1, 1)})
+        # seq-parallel prefill reaches the PLAIN program at the wide chunk
+        # width when the seq axis has one device (same math, unsharded)
+        wide = ({(K, self._seq_chunk), (1, self._seq_chunk)}
+                if (self._seq_chunk and self._seq_shards == 1) else set())
         for sampling in (False, True):
             for lora in lora_args:
-                for ksteps, width in sorted({(K, C), (1, C), (K, 1), (1, 1)}):
+                for ksteps, width in sorted(set(shapes) | wide):
                     dispatch(self._fused_fn(sampling, self.collect_logits, ksteps,
                                             width, lora=lora is not None),
                              width, lora)
@@ -1740,6 +2103,33 @@ class DecodeScheduler:
                                            self._spec_width,
                                            lora=lora is not None),
                              self._spec_width, lora)
+        if (self.cache.max_extents > 1 or self.allow_lossy_kv
+                or self._seq_chunk):
+            # long-context variants: the extent program at every shape the
+            # decode/backoff/chunk ladder reaches (plus the seq-parallel
+            # chunk width), and the seq-sharded program at its one width —
+            # warmed with the identity extent table and all spans zero
+            eo = tuple(jnp.asarray(x)
+                       for x in self._ext_operands([], force=True))
+            ext_shapes = set(shapes)
+            if self._seq_chunk:
+                ext_shapes |= {(K, self._seq_chunk), (1, self._seq_chunk)}
+            for sampling in (False, True):
+                for lora in lora_args:
+                    for ksteps, width in sorted(ext_shapes):
+                        dispatch(self._fused_fn(sampling, self.collect_logits,
+                                                ksteps, width,
+                                                lora=lora is not None,
+                                                ext=True),
+                                 width, lora, eo)
+                    if self._seq_shards > 1:
+                        for ksteps in (K, 1):
+                            dispatch(self._fused_fn(sampling,
+                                                    self.collect_logits,
+                                                    ksteps, self._seq_chunk,
+                                                    lora=lora is not None,
+                                                    ext=True, seqp=True),
+                                     self._seq_chunk, lora, eo)
         if self.radix is not None:
             # the radix slot-copy program (src == dst is the identity copy,
             # safe against any pool state)
@@ -1757,7 +2147,8 @@ class DecodeScheduler:
         row, not the longest retained prefix."""
         eng = self.engine
         N = self.cache.num_slots
-        live = sorted(self.active.items())
+        live = [(s, r) for s, r in sorted(self.active.items())
+                if s not in self._parked]
         ids = np.zeros((N, 1), np.int32)
         spans = np.zeros(N, np.int32)
         lens = np.zeros(N, np.int32)
@@ -1768,12 +2159,23 @@ class DecodeScheduler:
         (seeds, steps, flags, temps, topks, topps, sampling,
          collect) = self._gather_sampling(live)
         K = self.steps_per_sync
+        eo = self._ext_operands(live)
+        if eo is not None and K > 1:
+            # a K-step sync writes rows [len, len+K) contiguously in the
+            # write extent — a row about to cross an extent boundary steps
+            # through it one token at a time (the (1, 1) program is warm)
+            S = self.max_len
+            if any(S - int(self.cache.lengths[s]) % S < K for s, _ in live):
+                K = 1
         lora = self._adapter_arg(live)
-        fn = self._fused_fn(sampling, collect, K, 1, lora=lora is not None)
+        fn = self._fused_fn(sampling, collect, K, 1, lora=lora is not None,
+                            ext=eo is not None)
         args = (eng.params, self.cache.pool, jnp.asarray(ids),
                 jnp.asarray(lens), jnp.asarray(spans),
                 jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+        if eo is not None:
+            args = args + tuple(jnp.asarray(x) for x in eo)
         try:
             out = self._call_step(fn, args, lora)
         except _ExpertOverflow as e:
@@ -1801,7 +2203,15 @@ class DecodeScheduler:
         dispatch amortization when the drafter is dry."""
         eng = self.engine
         N, W = self.cache.num_slots, self._spec_width
-        live = sorted(self.active.items())
+        live = [(s, r) for s, r in sorted(self.active.items())
+                if s not in self._parked]
+        if any(s in self.cache.chain or r.kv_window is not None
+               for s, r in live):
+            # speculation is opportunistic: the verify program carries no
+            # extent walk, and a chained/lossy row's drafts would verify
+            # against truncated KV — advance exactly instead (bit-identical
+            # either way; the extent mix is rare relative to decode syncs)
+            return self._decode_step()
         drafts = {}
         total_draft = 0
         for slot, req in live:
@@ -1908,11 +2318,20 @@ class DecodeScheduler:
         dropped, so retained prefix slots stay byte-stable). Returns
         (tokens delivered, K)."""
         eng = self.engine
-        N, C = self.cache.num_slots, self.prefill_chunk
+        N = self.cache.num_slots
         pf = self._prefill
         preq = pf.req
+        # sequence-parallel prefill: wide chunks (the seq-parallel width),
+        # sharded over the seq mesh axis when it has devices — on a 1-device
+        # axis the plain program at the wide width is the same math (chunk
+        # boundaries don't change per-column attention), just unsharded
+        seqp = pf.seq_parallel and self._seq_shards > 1
+        C = self._seq_chunk if pf.seq_parallel else self.prefill_chunk
+        S = self.max_len
         L = preq.prompt.size
-        take = min(C, L - pf.pos)
+        # a chunk never crosses an extent boundary: each wide forward's KV
+        # write lands in exactly one extent's pool row
+        take = min(C, L - pf.pos, S - pf.pos % S)
         final = pf.pos + take >= L
         ids = np.zeros((N, C), np.int32)
         spans = np.zeros(N, np.int32)
@@ -1920,7 +2339,8 @@ class DecodeScheduler:
         # are dropped (span 0), and the paged kernel's KV-block walk stays
         # bounded by the longest live row, not the longest retained prefix
         lens = np.zeros(N, np.int32)
-        live = sorted(self.active.items())
+        live = [(s, r) for s, r in sorted(self.active.items())
+                if s not in self._parked]
         (seeds, steps, flags, temps, topks, topps, sampling,
          collect) = self._gather_sampling(live)
         sampling = sampling or preq.do_sample
@@ -1941,8 +2361,19 @@ class DecodeScheduler:
         # rows, or the prefill row itself once its final chunk lands — a
         # non-final chunk on an otherwise idle pool runs the 1-step variant
         K = self.steps_per_sync if (live or final) else 1
+        eo = self._ext_operands(live + [(ps, preq)], force=seqp)
+        if eo is not None and K > 1:
+            # substep writes stay inside each row's write extent: decode
+            # rows need K rows of extent headroom; a FINAL chunk's row
+            # needs its chunk plus the K-1 substep rows to fit its extent
+            room = [S - int(self.cache.lengths[s]) % S for s, _ in live]
+            if final:
+                room.append(S - pf.pos % S - take + 1)
+            if any(r < K for r in room):
+                K = 1
         lora = self._adapter_arg(live + [(ps, preq)])
-        fn = self._fused_fn(sampling, collect, K, C, lora=lora is not None)
+        fn = self._fused_fn(sampling, collect, K, C, lora=lora is not None,
+                            ext=eo is not None, seqp=seqp)
         tel = self.telemetry
         t0 = tel.now()
         lens[ps] = self.cache.lengths[ps]  # prefix copy and/or earlier chunks
@@ -1950,6 +2381,8 @@ class DecodeScheduler:
                 jnp.asarray(lens), jnp.asarray(spans),
                 jnp.asarray(seeds), jnp.asarray(steps), jnp.asarray(flags),
                 jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps))
+        if eo is not None:
+            args = args + tuple(jnp.asarray(x) for x in eo)
         try:
             out = self._call_step(fn, args, lora)
         except _ExpertOverflow as e:
@@ -2001,8 +2434,12 @@ class DecodeScheduler:
             # exact per-row state this sync left behind, so the stream is
             # bit-identical to staying put.
             if (not preq.done and self.migrate_hook is not None
+                    and ps not in self.cache.chain
+                    and preq.kv_window is None
                     and self.migrate_hook(self, preq)):
                 pass  # migrated out: slot released, request owned elsewhere
+                # (multi-extent chains and lossy-window rows stay put: the
+                # handoff protocol demotes/restores one contiguous slot)
         else:
             self.cache.lengths[ps] = pf.pos
         return delivered, K
@@ -2040,7 +2477,8 @@ class DecodeScheduler:
                 else (self._pool_sharding, ) + (self._host_sharding, ) * aux_outs)
         return jax.jit(fn, donate_argnums=donate, out_shardings=outs)
 
-    def _fused_fn(self, sampling, collect, ksteps, chunk, lora=False):
+    def _fused_fn(self, sampling, collect, ksteps, chunk, lora=False,
+                  ext=False, seqp=False):
         """THE step program: per-row query spans over a fixed ``(num_slots,
         chunk)`` ids block, then the sync's remaining ``ksteps - 1`` decode
         steps in the same on-device loop — one dispatch per scheduler
@@ -2080,10 +2518,25 @@ class DecodeScheduler:
         trace, so base-only dispatches run the byte-identical old program;
         both variants together stay O(1) in adapter count/mix/churn (which
         rows carry which adapter is runtime data, pool shapes are fixed by
-        the bucket config)."""
-        fused_block = self._fused_block and not lora
-        key = ("fused_block" if fused_block else "fused",
-               sampling, collect, chunk, ksteps) + (("lora", ) if lora else ())
+        the bucket config).
+
+        ``ext=True`` builds the multi-extent variant: the program takes the
+        5-array extent operand block (:meth:`_ext_operands`) after the
+        canonical step arguments and threads it into every forward — the
+        paged kernels walk KV blocks across each row's extent chain, and
+        writes redirect through ``wslot``/``ext_base`` into the write
+        extent's pool row. Which rows chain, how many extents each holds,
+        and any lossy windows are RUNTIME data: one extent program per
+        (sampling, collect, chunk, ksteps) point, O(1) in the length/extent
+        mix. ``seqp=True`` additionally shards the first wide forward's
+        span attention over the ``seq`` mesh axis (sequence-parallel
+        chunked prefill; substeps stay unsharded — their single-column
+        width can't split). Both variants force the per-projection path
+        (the fused decode blocks carry no extent walk)."""
+        fused_block = self._fused_block and not lora and not ext and not seqp
+        tag = ("fused_seqp" if seqp else "fused_ext" if ext
+               else "fused_block" if fused_block else "fused")
+        key = (tag, sampling, collect, chunk, ksteps) + (("lora", ) if lora else ())
 
         def build():
             model = self.engine.module
@@ -2101,10 +2554,15 @@ class DecodeScheduler:
 
             def fused(params, pool, ids, lengths, spans, seeds, steps, flags,
                       temps, topks, topps, *extra):
-                # trailing args in fixed order: adapter operands (when the
-                # `lora` key flag is set), then cold-expert operands (when
+                # trailing args in fixed order: the extent operand block
+                # (when the `ext`/`seqp` key flag is set), then adapter
+                # operands (`lora` flag), then cold-expert operands (when
                 # the scheduler carries an expert store — fixed per build)
                 i = 0
+                ext_ops = None
+                if ext or seqp:
+                    ext_ops = tuple(extra[:5])
+                    i = 5
                 lops = None
                 if lora:
                     from ..adapters.batched_lora import gather_rows
@@ -2115,7 +2573,7 @@ class DecodeScheduler:
                 N = ids.shape[0]
                 pos = lengths[:, None] + jnp.arange(C)[None, :]
 
-                def forward(pool, tok_block, pos_block, widx, sp):
+                def forward(pool, tok_block, pos_block, widx, sp, seq_sh=False):
                     """One in-sync forward; returns (logits, pool, counts)
                     with counts None when stats are off (the non-stats
                     trace is unchanged from the pre-MoE program)."""
@@ -2130,13 +2588,18 @@ class DecodeScheduler:
                         return model.apply_with_cache(
                             params, tok_block, pool, 0, position_ids=pos_block,
                             write_index=widx, q_spans=sp, lora_ops=lops,
-                            expert_ops=eops, expert_stats=True)
+                            expert_ops=eops, expert_stats=True,
+                            ext_ops=ext_ops, seq_shard=seq_sh)
                     lg, pl = model.apply_with_cache(
                         params, tok_block, pool, 0, position_ids=pos_block,
-                        write_index=widx, q_spans=sp, lora_ops=lops)
+                        write_index=widx, q_spans=sp, lora_ops=lops,
+                        ext_ops=ext_ops, seq_shard=seq_sh)
                     return lg, pl, None
 
-                logits, pool, total_cnt = forward(pool, ids, pos, lengths, spans)
+                # only the first (wide) forward seq-shards: the substeps'
+                # single-column blocks can't split over the seq axis
+                logits, pool, total_cnt = forward(pool, ids, pos, lengths,
+                                                  spans, seq_sh=seqp)
                 # each row's LAST live column: decode rows column 0, the
                 # prefill row its chunk fill - 1 (dead rows clamp to 0 —
                 # their token is garbage the host never reads)

@@ -235,6 +235,41 @@ class KVTier:
         self._install(leaves, slot, rows)
         return True
 
+    # ------------------------------------------------------------------ extent paging
+    # Long-context cold-range demotion (``DecodeScheduler.demote_cold_extents``):
+    # a live multi-extent request pages whole EXTENTS — pool rows, not
+    # prefixes — to the host store mid-decode and restores them on the
+    # detect-miss path. Rides the SAME two compiled programs and the same
+    # pinned-entry protocol as the migration handoff, but synchronous both
+    # ways: the scheduler parks the row until every extent is resident
+    # again, so there is no async window worth hiding the copy in.
+    def demote_extent(self, pool_slot, key):
+        """Copy pool row ``pool_slot``'s full extent to the store under the
+        scheduler's synthetic ``key`` (a negative-sentinel tuple no prompt
+        or adapter namespace can collide with) and return the PINNED entry
+        — the scheduler holds it for the restore; probes can never find
+        it."""
+        version = int(self.kv.weights_version)
+        with self.sched.engine.mesh:
+            dev = self._slice_fn()(self.kv.pool, np.int32(pool_slot))
+        host = [np.asarray(jax.device_get(leaf))
+                for leaf in jax.tree_util.tree_leaves(dev)]
+        self.demotes += 1
+        return self.store.put(key, host, version, origin=id(self),
+                              pinned=True, length=self.kv.max_len)
+
+    def restore_extent(self, entry, pool_slot):
+        """Install a demoted extent's rows back at ``pool_slot`` and consume
+        the entry. False when the entry vanished — structurally impossible
+        while the owning request is live (weight swaps require an empty
+        pool), so the scheduler treats False as an invariant failure."""
+        leaves = self.store.pop(entry, consume=True)
+        if leaves is None:
+            return False
+        self._install(leaves, pool_slot, self.kv.max_len)
+        self.restores += 1
+        return True
+
     def warmup(self):
         """Compile ``tier_slice``/``tier_restore`` ahead of the first real
         demote/restore by round-tripping slot 0's rows onto themselves (a

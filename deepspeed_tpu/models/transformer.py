@@ -632,7 +632,8 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, kv_cache=None, cache_index=None,
-                 position_ids=None, write_index=None, q_spans=None, lora_ops=None):
+                 position_ids=None, write_index=None, q_spans=None, lora_ops=None,
+                 ext_ops=None, seq_shard=False):
         """``attn_mask`` semantics: without a cache it is (B, T) over the
         current tokens; with a cache it is (B, S) over cache slots (True =
         attendable, used for left-pad masking during generation).
@@ -659,6 +660,24 @@ class Attention(nn.Module):
         sits at absolute position ``write_index_i + j``; columns at or past
         the span are padding — their KV write is dropped and their outputs
         are garbage the caller never reads.
+
+        ``ext_ops``: optional long-context extent operands ``(ext_table,
+        wslot, ext_base, sinks, windows)`` — ``ext_table`` (B, E) int32 maps
+        each row's logical extent i (tokens ``[i*S, (i+1)*S)``) to its pool
+        row (-1 = demoted), ``wslot``/``ext_base`` locate the CURRENT write:
+        the pool row holding the write head's extent and that extent's
+        logical base, so the in-slot write target is ``write_index -
+        ext_base``. ``write_index``/``q_spans``/``position_ids`` stay
+        LOGICAL (may exceed S). ``sinks``/``windows`` (B,) int32 or None
+        drive the lossy attention-sink/sliding-window mask (0 = lossless).
+        Requires the flash span/decode paths — alibi, per-layer local
+        windows, and the XLA fallback raise at trace time.
+
+        ``seq_shard``: run the span attention sequence-parallel over the
+        ``seq`` mesh axis (chunked prefill of long prompts); the KV write
+        stays replicated so every shard's pool is byte-identical. Explicitly
+        opt-in per program — ambient mesh detection would silently shard
+        the reference chunked path.
         """
         cfg = self.cfg
         B, T, H = x.shape
@@ -749,7 +768,22 @@ class Attention(nn.Module):
             else:
                 ck, cv = kv_cache
                 writes = [(ck, k), (cv, v)]
-            if write_index is not None and q_spans is not None:
+            if ext_ops is not None and write_index is not None and q_spans is not None:
+                # long-context extent write: the chunk lands in the pool row
+                # holding the write head's extent (wslot), at in-slot offset
+                # write_index - ext_base. The scheduler clamps chunk takes to
+                # the extent boundary, so one chunk never straddles extents.
+                # Advanced-index axes move to the front: value is (B, T, ...)
+                ext_table, wslot, ext_base, _snk, _wnd = ext_ops
+                tgt = (write_index - ext_base)[:, None] + jnp.arange(T)[None, :]
+                tgt = jnp.where(jnp.arange(T)[None, :] < q_spans[:, None], tgt,
+                                ck.shape[2])
+                written = [
+                    c.at[wslot[:, None], :, tgt].set(
+                        kk.transpose(0, 2, 1, 3).astype(c.dtype), mode="drop")
+                    for c, kk in writes]
+                cache_index = write_index
+            elif write_index is not None and q_spans is not None:
                 # fused chunk/decode span write: column j of row i lands at
                 # row position write_index_i + j; columns past the row's live
                 # span target row S (out of range) and are DROPPED — padding
@@ -782,10 +816,28 @@ class Attention(nn.Module):
             tp_kernel_shard = (cfg.bitwise_tp and _tp_mesh_size() > 1
                                and nkv % _tp_mesh_size() == 0
                                and nh % _tp_mesh_size() == 0)
+            if ext_ops is not None or seq_shard:
+                # long-context operands only compose with the fused flash
+                # span/decode paths; a silent fall-through to the XLA
+                # fallback (which knows nothing of extents) would read the
+                # wrong rows, so unsupported combinations fail at trace time
+                if (cfg.attention_impl != "flash" or alibi is not None or window
+                        or write_index is None or q_spans is None):
+                    raise ValueError(
+                        "ext_ops/seq_shard require the fused flash span path "
+                        "(attention_impl='flash', rope/none positions, no "
+                        "per-layer local window, write_index + q_spans)")
+                if seq_shard and tp_kernel_shard:
+                    raise ValueError("seq-parallel prefill requires tensor "
+                                     "parallelism of 1 (seq and tensor kernel "
+                                     "sharding don't compose)")
             if (cfg.attention_impl == "flash" and T == 1 and alibi is None
+                    and not seq_shard
                     and (write_index is not None or not quant_kv)):
                 from ..ops.pallas.decode_attention import decode_attention, \
-                    paged_decode_attention, sharded_paged_decode_attention
+                    extent_paged_decode_attention, paged_decode_attention, \
+                    sharded_extent_paged_decode_attention, \
+                    sharded_paged_decode_attention
                 if attn_mask is not None:
                     starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
                 else:
@@ -793,7 +845,24 @@ class Attention(nn.Module):
                 if window:
                     # a sliding window is just a raised start for one query
                     starts = jnp.maximum(starts, cache_index + 1 - window)
-                if write_index is not None and tp_kernel_shard:
+                if ext_ops is not None and tp_kernel_shard:
+                    ext_table, _, _, ext_sink, ext_win = ext_ops
+                    out = sharded_extent_paged_decode_attention(
+                        q[:, :, 0], ck, cv, starts, write_index + 1, ext_table,
+                        mesh=dist.get_mesh(), axis=dist.TENSOR_AXIS,
+                        block_kv=cfg.decode_block_kv,
+                        k_scale=csc if quant_kv else None,
+                        v_scale=csc if quant_kv else None,
+                        sink=ext_sink, window=ext_win)[:, :, None]
+                elif ext_ops is not None:
+                    ext_table, _, _, ext_sink, ext_win = ext_ops
+                    out = extent_paged_decode_attention(
+                        q[:, :, 0], ck, cv, starts, write_index + 1, ext_table,
+                        block_kv=cfg.decode_block_kv,
+                        k_scale=csc if quant_kv else None,
+                        v_scale=csc if quant_kv else None,
+                        sink=ext_sink, window=ext_win)[:, :, None]
+                elif write_index is not None and tp_kernel_shard:
                     out = sharded_paged_decode_attention(
                         q[:, :, 0], ck, cv, starts, write_index + 1,
                         mesh=dist.get_mesh(), axis=dist.TENSOR_AXIS,
@@ -816,12 +885,46 @@ class Attention(nn.Module):
                 # decode kernel (each row's causal window advances with its
                 # query column)
                 from ..ops.pallas.decode_attention import \
-                    paged_span_attention, sharded_paged_span_attention
+                    extent_paged_span_attention, paged_span_attention, \
+                    seq_sharded_span_attention, \
+                    sharded_extent_paged_span_attention, \
+                    sharded_paged_span_attention
                 if attn_mask is not None:
                     starts = jnp.argmax(attn_mask.astype(jnp.int32), axis=1)
                 else:
                     starts = jnp.zeros((B, ), jnp.int32)
-                if tp_kernel_shard:
+                if seq_shard:
+                    # sequence-parallel chunked prefill: shards split the
+                    # chunk's query columns over the seq axis; KV (already
+                    # written, replicated) streams whole on every shard
+                    ext_table = ext_sink = ext_win = None
+                    if ext_ops is not None:
+                        ext_table, _, _, ext_sink, ext_win = ext_ops
+                    out = seq_sharded_span_attention(
+                        q, ck, cv, starts, write_index,
+                        mesh=dist.get_mesh(), axis=dist.SEQ_AXIS,
+                        block_kv=cfg.decode_block_kv,
+                        k_scale=csc if quant_kv else None,
+                        v_scale=csc if quant_kv else None,
+                        ext=ext_table, sink=ext_sink, window=ext_win)
+                elif ext_ops is not None and tp_kernel_shard:
+                    ext_table, _, _, ext_sink, ext_win = ext_ops
+                    out = sharded_extent_paged_span_attention(
+                        q, ck, cv, starts, write_index, ext_table,
+                        mesh=dist.get_mesh(), axis=dist.TENSOR_AXIS,
+                        block_kv=cfg.decode_block_kv,
+                        k_scale=csc if quant_kv else None,
+                        v_scale=csc if quant_kv else None,
+                        sink=ext_sink, window=ext_win)
+                elif ext_ops is not None:
+                    ext_table, _, _, ext_sink, ext_win = ext_ops
+                    out = extent_paged_span_attention(
+                        q, ck, cv, starts, write_index, ext_table,
+                        block_kv=cfg.decode_block_kv,
+                        k_scale=csc if quant_kv else None,
+                        v_scale=csc if quant_kv else None,
+                        sink=ext_sink, window=ext_win)
+                elif tp_kernel_shard:
                     out = sharded_paged_span_attention(
                         q, ck, cv, starts, write_index,
                         mesh=dist.get_mesh(), axis=dist.TENSOR_AXIS,
@@ -999,7 +1102,7 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, sin, cos, attn_mask=None, deterministic=True, kv_cache=None,
                  cache_index=None, position_ids=None, write_index=None, q_spans=None,
-                 lora_ops=None, expert_ops=None):
+                 lora_ops=None, expert_ops=None, ext_ops=None, seq_shard=False):
         cfg = self.cfg
         drop = nn.Dropout(rate=cfg.dropout) if cfg.dropout > 0 else None
         if cfg.act_quant_bits:  # QAT activation fake-quant (compression)
@@ -1009,7 +1112,7 @@ class Block(nn.Module):
         h = make_norm(cfg, name="attn_norm")(x)
         h, new_cache = Attention(cfg, layer_idx=self.layer_idx, name="attn")(
             h, sin, cos, attn_mask, kv_cache, cache_index, position_ids, write_index,
-            q_spans, lora_ops)
+            q_spans, lora_ops, ext_ops, seq_shard)
         if drop is not None:
             h = drop(h, deterministic=deterministic)
         if cfg.parallel_residual:
@@ -1048,7 +1151,8 @@ class CausalLM(nn.Module):
     def __call__(self, input_ids, attn_mask=None, deterministic=True, kv_cache=None,
                  cache_index=None, position_ids=None, return_hidden=False,
                  pld_theta=None, pld_rng=None, ltd_keep=None, ltd_layers=(), ltd_rng=None,
-                 write_index=None, q_spans=None, lora_ops=None, expert_ops=None):
+                 write_index=None, q_spans=None, lora_ops=None, expert_ops=None,
+                 ext_ops=None, seq_shard=False):
         """``kv_cache``: optional per-layer (k, v) with leading layer dim —
         shapes (L, B, kv_heads, S, head_dim) — scanned alongside the layer
         stack. Returns logits, or (logits, new_kv_cache) when caching, or the
@@ -1120,9 +1224,12 @@ class CausalLM(nn.Module):
                                                   layer_cache, cache_index, ps_),
                         carry, layer_idx)
                 else:
+                    # ext_ops/seq_shard are layer-invariant (like
+                    # write_index/q_spans): closed over, not scanned
                     y, c = mdl(carry, sin, cos, attn_mask, deterministic,
                                layer_cache, cache_index, position_ids, write_index,
-                               q_spans, layer_lora, layer_experts)
+                               q_spans, layer_lora, layer_experts, ext_ops,
+                               seq_shard)
                 return apply_pld(y, carry, layer_idx), c
 
             x, new_cache = nn.scan(
@@ -1154,7 +1261,8 @@ class CausalLM(nn.Module):
                 else:
                     y, c = blk(x, sin, cos, attn_mask, deterministic,
                                layer_cache, cache_index, position_ids, write_index,
-                               q_spans, layer_lora, layer_experts)
+                               q_spans, layer_lora, layer_experts, ext_ops,
+                               seq_shard)
                 x = apply_pld(y, x, jnp.asarray(i))
                 caches.append(c)
             if kv_cache is not None:
@@ -1376,7 +1484,8 @@ class CausalLMModel:
 
     def apply_with_cache(self, params, input_ids, kv_cache, cache_index, cache_mask=None,
                          position_ids=None, write_index=None, q_spans=None,
-                         lora_ops=None, expert_ops=None, expert_stats=False):
+                         lora_ops=None, expert_ops=None, expert_stats=False,
+                         ext_ops=None, seq_shard=False):
         """Forward writing into (and attending over) the KV cache. Returns
         (logits, new_cache). ``cache_mask``: (B, S) attendable cache slots.
         ``write_index``: optional (B,) per-row cache positions (slot-pool
@@ -1397,12 +1506,17 @@ class CausalLMModel:
         layer axis ``(expert->page map (L, E), pools {leaf: (L, R, ...)})``.
         ``expert_stats=True`` additionally returns per-layer routed-token
         counts ``(L, E) int32`` (the scheduler's residency/telemetry
-        signal) as a third output."""
+        signal) as a third output.
+
+        ``ext_ops``/``seq_shard``: long-context extent operands and the
+        sequence-parallel prefill flag, layer-invariant pass-throughs to
+        :class:`Attention` (see there for semantics)."""
         mutable = ["expert_stats"] if expert_stats else False
         out = self.module.apply({"params": params}, input_ids, cache_mask, True, kv_cache,
                                 cache_index, position_ids, write_index=write_index,
                                 q_spans=q_spans, lora_ops=lora_ops,
-                                expert_ops=expert_ops, mutable=mutable)
+                                expert_ops=expert_ops, ext_ops=ext_ops,
+                                seq_shard=seq_shard, mutable=mutable)
         if not expert_stats:
             logits, new_cache = out
             return logits, new_cache

@@ -34,6 +34,27 @@ generation; ``end`` is the write head. Two entry points share one kernel:
   attends ``[start_i, base_i + j]``; the span fold reuses the same kernel
   with the query columns folded into the head-group axis and a per-column
   offset added to the causal end.
+
+Long-context extensions (multi-extent paged KV + seq-parallel prefill):
+
+- :func:`extent_paged_decode_attention` / :func:`extent_paged_span_attention`
+  — one request's KV spans SEVERAL pool slots ("extents") through a per-row
+  extent table: logical position ``p`` of row ``i`` lives at physical pool
+  row ``ext[i, p // S]``, offset ``p % S``. The kernel walks LOGICAL blocks
+  (grid ``E * S/block_kv``) and gathers each row's physical slot for the
+  current extent in-register, so the extent count stays an OPERAND (table
+  values), never a shape — the O(1)-compiled-programs guard holds across
+  any extent mix. With an identity table (``ext[i, 0] == i``) the math is
+  bit-identical to the plain paged kernels row for row. Optional per-row
+  ``sink``/``window`` operands add attention-sink + sliding-window masking
+  (the LOSSY long-context mode — rows with ``window == 0`` keep the exact
+  mask, so lossy and exact rows co-reside in one dispatch).
+- :func:`seq_sharded_span_attention` — the span kernel shard_mapped over
+  the SEQUENCE mesh axis: a wide seq-parallel prefill chunk splits its
+  query columns across seq shards (shard ``s`` computes columns
+  ``[s*Tl, (s+1)*Tl)`` with its causal base advanced by ``s*Tl``); per-row
+  softmax is per COLUMN, so the gathered output is bit-identical to the
+  unsharded span call.
 """
 
 import functools
@@ -194,6 +215,175 @@ def _decode_call(qg, k_cache, v_cache, start, ends, max_end, *, block_kv, scale,
     return out
 
 
+def _extent_kernel(ext_ref, start_ref, end_ref, max_end_ref, sink_ref, win_ref,
+                   q_ref, k_ref, v_ref, *rest, scale, block_kv, B, E, nkv, g, D,
+                   bpe, span=1, quantized=False):
+    """Multi-extent variant of :func:`_decode_kernel`: the KV walk is over
+    LOGICAL blocks — grid step ``j`` covers logical positions
+    ``[j*block_kv, (j+1)*block_kv)``, which live in extent ``j // bpe`` at
+    within-slot offset ``j % bpe``. The KV block spec streams the FULL pool
+    column at that offset and each row gathers its own extent's slot
+    (``ext_ref[i*E + e]``) in-register; windows, masks, and the span offset
+    all stay in logical coordinates, so with an identity extent table every
+    arithmetic op matches :func:`_decode_kernel` value for value.
+
+    ``sink_ref``/``win_ref``: per-row lossy knobs — a row with ``win > 0``
+    additionally masks logical positions in ``[sink, end - win)`` (keeps
+    the attention-sink head and the sliding recent window; StreamingLLM
+    shape). ``win == 0`` leaves the exact mask bit-untouched, so lossy and
+    exact rows share one compiled program."""
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        o_ref, m_s, l_s, acc_s = rest
+    j = pl.program_id(0)
+    nj = pl.num_programs(0)
+    max_end = max_end_ref[0]
+    BH = B * nkv
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, -jnp.inf)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    kv_start = j * block_kv  # LOGICAL position of this block's first key
+
+    @pl.when(kv_start < max_end)
+    def _block():
+        e = j // bpe  # extent index of this logical block
+        q = q_ref[...].astype(jnp.float32).reshape(BH, g, D) * scale
+        # per-row physical slot for extent e; demoted/unreserved extents
+        # carry -1 — clamp for a safe (masked-out) gather
+        slots = jnp.stack([jnp.maximum(ext_ref[i * E + e], 0) for i in range(B)])
+        k = jnp.take(k_ref[...], slots, axis=0).astype(jnp.float32)  # (B, nkv, bkv, D)
+        v = jnp.take(v_ref[...], slots, axis=0).astype(jnp.float32)
+        if quantized:
+            ks = jnp.take(ks_ref[...], slots, axis=0).astype(jnp.float32)
+            vs = jnp.take(vs_ref[...], slots, axis=0).astype(jnp.float32)
+            k = k * ks[:, None, :, None]
+            v = v * vs[:, None, :, None]
+        k = k.reshape(BH, block_kv, D)
+        v = v.reshape(BH, block_kv, D)
+        s = jax.lax.dot_general(q, k, (((2, ), (2, )), ((0, ), (0, ))),
+                                preferred_element_type=jnp.float32)  # (BH, g, bkv)
+        s2 = s.reshape(BH * g, block_kv)
+        kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (BH * g, block_kv), 1)
+        start2d = jnp.concatenate(
+            [jnp.full((nkv * g, block_kv), start_ref[i], jnp.int32) for i in range(B)])
+        end2d = jnp.concatenate(
+            [jnp.full((nkv * g, block_kv), end_ref[i], jnp.int32) for i in range(B)])
+        if span > 1:
+            col = jax.lax.broadcasted_iota(jnp.int32, (BH * g, block_kv), 0) % span
+            end2d = end2d + col
+        mask = (kv_pos >= start2d) & (kv_pos < end2d)
+        sink2d = jnp.concatenate(
+            [jnp.full((nkv * g, block_kv), sink_ref[i], jnp.int32) for i in range(B)])
+        win2d = jnp.concatenate(
+            [jnp.full((nkv * g, block_kv), win_ref[i], jnp.int32) for i in range(B)])
+        keep = (win2d == 0) | (kv_pos < sink2d) | (kv_pos >= end2d - win2d)
+        mask = mask & keep
+        s2 = jnp.where(mask, s2, DEFAULT_MASK_VALUE)
+
+        m_prev = m_s[...].reshape(BH * g, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s2, axis=1, keepdims=True))
+        p = jnp.exp(s2 - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_s[...] = (l_s[...].reshape(BH * g, 1) * alpha
+                    + jnp.sum(p, axis=1, keepdims=True)).reshape(BH, g)
+        pv = jax.lax.dot_general(p.reshape(BH, g, block_kv), v,
+                                 (((2, ), (1, )), ((0, ), (0, ))),
+                                 preferred_element_type=jnp.float32)  # (BH, g, D)
+        acc3 = acc_s[...].reshape(BH, g, D)
+        acc_s[...] = (acc3 * alpha.reshape(BH, g)[:, :, None] + pv).reshape(BH, g * D)
+        m_s[...] = m_new.reshape(BH, g)
+
+    @pl.when(j == nj - 1)
+    def _flush():
+        l = l_s[...].reshape(BH, g)
+        l = jnp.where(l == 0, 1.0, l)
+        out = acc_s[...].reshape(BH, g, D) / l[:, :, None]
+        o_ref[...] = out.reshape(B, nkv, g, D).astype(o_ref.dtype)
+
+
+def _extent_call(qg, k_cache, v_cache, start, ends, max_end, ext, sink, win, *,
+                 block_kv, scale, span=1, k_scale=None, v_scale=None):
+    """pallas_call builder for the multi-extent kernel. ``ext``: (B, E)
+    int32 per-row extent chains — physical pool slot of each S-row extent,
+    -1 for unreserved/demoted entries. ``start``/``ends``/``max_end`` are
+    LOGICAL positions (max ``E * S``). ``sink``/``win``: optional (B,)
+    int32 lossy-mode knobs (None → zeros → exact masking). ``k_scale``/
+    ``v_scale``: optional (Npool, S) per-token-row dequant scales covering
+    the FULL pool (the kernel gathers scale rows with the KV rows).
+
+    The walked-bytes tradeoff vs :func:`_decode_call`: each logical block
+    streams the whole pool column (Npool rows) so rows can gather any slot
+    — in serving the dispatch batch IS the pool (B == Npool), so per-block
+    DMA matches the plain kernel and the extra cost is the E-fold longer
+    logical walk, priced by ``CapacityModel.dispatch_cost``."""
+    B, nkv, g, D = qg.shape
+    Np, nkv_c, S, Dc = k_cache.shape
+    E = ext.shape[1]
+    scale = scale if scale is not None else 1.0 / (D**0.5)
+    block_kv = min(block_kv, S)
+    if S % block_kv:
+        raise ValueError(f"cache length {S} must be a multiple of block_kv={block_kv}")
+    quantized = k_scale is not None
+    bpe = S // block_kv
+    nj = E * bpe
+
+    ext_flat = ext.reshape(B * E).astype(jnp.int32)
+    start = start.astype(jnp.int32)
+    ends = ends.astype(jnp.int32)
+    max_end_arr = jnp.full((1, ), max_end, jnp.int32)
+    sink = (jnp.zeros((B, ), jnp.int32) if sink is None
+            else sink.astype(jnp.int32))
+    win = (jnp.zeros((B, ), jnp.int32) if win is None
+           else win.astype(jnp.int32))
+
+    def kv_index(j, ext_r, start_r, end_r, max_end_r, sink_r, win_r):
+        # clamp to the last LIVE logical block; skipped steps keep the
+        # previous index so no extra DMA is issued
+        last = jnp.maximum(max_end_r[0] - 1, 0) // block_kv
+        return (0, 0, jnp.minimum(j, last) % bpe, 0)
+
+    def sc_index(j, ext_r, start_r, end_r, max_end_r, sink_r, win_r):
+        last = jnp.maximum(max_end_r[0] - 1, 0) // block_kv
+        return (0, jnp.minimum(j, last) % bpe)
+
+    in_specs = [
+        pl.BlockSpec((B, nkv, g, D), lambda j, *_: (0, 0, 0, 0)),
+        pl.BlockSpec((Np, nkv, block_kv, D), kv_index),
+        pl.BlockSpec((Np, nkv, block_kv, D), kv_index),
+    ]
+    operands = [qg, k_cache, v_cache]
+    if quantized:
+        in_specs += [pl.BlockSpec((Np, block_kv), sc_index)] * 2
+        operands += [k_scale, v_scale]
+
+    kernel = functools.partial(_extent_kernel, scale=scale, block_kv=block_kv,
+                               B=B, E=E, nkv=nkv, g=g, D=D, bpe=bpe, span=span,
+                               quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(nj, ),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((B, nkv, g, D), lambda j, *_: (0, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((B * nkv, g), jnp.float32),      # running max
+                pltpu.VMEM((B * nkv, g), jnp.float32),      # running denom
+                pltpu.VMEM((B * nkv, g * D), jnp.float32),  # running numerator
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, nkv, g, D), qg.dtype),
+        compiler_params=_CompilerParams(dimension_semantics=("arbitrary", )),
+        interpret=_interpret(),
+    )(ext_flat, start, ends, max_end_arr, sink, win, *operands)
+    return out
+
+
 def _group(q, nkv):
     B, H, D = q.shape
     return q.reshape(B, nkv, H // nkv, D)
@@ -219,20 +409,22 @@ def _row_scales(k_scale, v_scale, B, S):
     return k_scale.reshape(B, S), v_scale.reshape(B, S)
 
 
-def _tp_shard_map(fn, mesh, axis, q_ndim, quantized):
+def _tp_shard_map(fn, mesh, axis, q_ndim, quantized, n_rep=3):
     """shard_map wrapper for the paged kernels over the ``axis`` (tensor)
     mesh dim: q and the KV cache split on their HEAD axes, window scalars
     and the per-token-row scale leaves stay replicated. Each shard's kernel
     then walks ONLY its local KV-head blocks (shard-local block walk — DMA
     and compute scale down tp-fold), and because every (batch, kv-head)
     pair is computed independently by the same kernel, the gathered output
-    is BIT-identical to the unsharded call."""
+    is BIT-identical to the unsharded call. ``n_rep``: replicated operands
+    following (q, k, v) — 3 for the plain window scalars, 6 for the extent
+    variants (ext table + sink/window knobs ride along replicated)."""
     from jax.sharding import PartitionSpec as SP
     from . import shard_map_compat
     head_q = SP(*(None, axis) + (None, ) * (q_ndim - 2))
     head_c = SP(None, axis, None, None)
     rep = SP()
-    in_specs = [head_q, head_c, head_c, rep, rep, rep]
+    in_specs = [head_q, head_c, head_c] + [rep] * n_rep
     if quantized:
         in_specs += [rep, rep]
     return shard_map_compat(fn, mesh, tuple(in_specs), head_q)
@@ -335,4 +527,163 @@ def paged_span_attention(q, k_cache, v_cache, start, base, *, block_kv=256,
     out = _decode_call(qf, k_cache, v_cache, start, base + 1, jnp.max(base) + T,
                        block_kv=block_kv, scale=scale, span=T, k_scale=ks,
                        v_scale=vs)
+    return out.reshape(B, H, T, D)
+
+
+# --------------------------------------------------------------------- extents
+def extent_paged_decode_attention(q, k_cache, v_cache, start, ends, ext, *,
+                                  block_kv=256, scale=None, k_scale=None,
+                                  v_scale=None, sink=None, window=None):
+    """:func:`paged_decode_attention` over multi-extent KV: row ``i``'s
+    logical position ``p`` lives at pool row ``ext[i, p // S]`` offset
+    ``p % S``. ``start``/``ends`` are LOGICAL (up to ``E * S``); ``ext`` is
+    (B, E) int32 with -1 marking unreserved/demoted extents (which must lie
+    entirely outside every attended window — the scheduler's detect-miss-
+    and-restore guarantees it in exact mode, the sink/window mask in lossy
+    mode). With an identity table this is bit-identical to the plain paged
+    kernel row for row. Returns (B, H, D)."""
+    B, H, D = q.shape
+    Np, nkv, S, _ = k_cache.shape
+    ends = ends.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, Np, S)
+    out = _extent_call(_group(q, nkv), k_cache, v_cache, start.astype(jnp.int32),
+                       ends, jnp.max(ends), ext, sink, window,
+                       block_kv=block_kv, scale=scale, k_scale=ks, v_scale=vs)
+    return out.reshape(B, H, D)
+
+
+def extent_paged_span_attention(q, k_cache, v_cache, start, base, ext, *,
+                                block_kv=256, scale=None, k_scale=None,
+                                v_scale=None, sink=None, window=None):
+    """:func:`paged_span_attention` over multi-extent KV (the fused chunked-
+    prefill/decode step when any live row's context spans pool extents).
+    ``base``: (B,) int32 LOGICAL write heads. Returns (B, H, T, D)."""
+    B, H, T, D = q.shape
+    Np, nkv, S, _ = k_cache.shape
+    qf = q.reshape(B, nkv, (H // nkv) * T, D)
+    base = base.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, Np, S)
+    out = _extent_call(qf, k_cache, v_cache, start.astype(jnp.int32), base + 1,
+                       jnp.max(base) + T, ext, sink, window, block_kv=block_kv,
+                       scale=scale, span=T, k_scale=ks, v_scale=vs)
+    return out.reshape(B, H, T, D)
+
+
+def _lossy_args(B, sink, window):
+    return (jnp.zeros((B, ), jnp.int32) if sink is None else sink.astype(jnp.int32),
+            jnp.zeros((B, ), jnp.int32) if window is None else window.astype(jnp.int32))
+
+
+def sharded_extent_paged_decode_attention(q, k_cache, v_cache, start, ends, ext,
+                                          *, mesh, axis, block_kv=256,
+                                          scale=None, k_scale=None,
+                                          v_scale=None, sink=None, window=None):
+    """:func:`extent_paged_decode_attention` shard_mapped over the tensor
+    mesh axis — head-sharded pool, shard-local LOGICAL block walk, extent
+    table replicated. Bit-identical to the unsharded extent call."""
+    B, H, D = q.shape
+    Np, nkv, S, _ = k_cache.shape
+    ends = ends.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, Np, S)
+    max_end = jnp.max(ends)
+    sk, wn = _lossy_args(B, sink, window)
+
+    def body(qg, kc, vc, st, en, me, ex, skr, wnr, *scales):
+        kss, vss = scales if scales else (None, None)
+        return _extent_call(qg, kc, vc, st, en, me[0], ex, skr, wnr,
+                            block_kv=block_kv, scale=scale, k_scale=kss,
+                            v_scale=vss)
+
+    out = _tp_shard_map(body, mesh, axis, 4, ks is not None, n_rep=6)(
+        *((_group(q, nkv), k_cache, v_cache, start.astype(jnp.int32), ends,
+           max_end[None], ext.astype(jnp.int32), sk, wn)
+          + ((ks, vs) if ks is not None else ())))
+    return out.reshape(B, H, D)
+
+
+def sharded_extent_paged_span_attention(q, k_cache, v_cache, start, base, ext,
+                                        *, mesh, axis, block_kv=256, scale=None,
+                                        k_scale=None, v_scale=None, sink=None,
+                                        window=None):
+    """:func:`extent_paged_span_attention` shard_mapped over the tensor mesh
+    axis (fused chunk step with multi-extent rows under bitwise-tp)."""
+    B, H, T, D = q.shape
+    Np, nkv, S, _ = k_cache.shape
+    base = base.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, Np, S)
+    max_end = jnp.max(base) + T
+    g = H // nkv
+    sk, wn = _lossy_args(B, sink, window)
+
+    def body(qs, kc, vc, st, bs, me, ex, skr, wnr, *scales):
+        nkv_l = kc.shape[1]
+        qf = qs.reshape(B, nkv_l, g * T, D)
+        kss, vss = scales if scales else (None, None)
+        out = _extent_call(qf, kc, vc, st, bs + 1, me[0], ex, skr, wnr,
+                           block_kv=block_kv, scale=scale, span=T,
+                           k_scale=kss, v_scale=vss)
+        return out.reshape(B, nkv_l * g, T, D)
+
+    out = _tp_shard_map(body, mesh, axis, 4, ks is not None, n_rep=6)(
+        *((q, k_cache, v_cache, start.astype(jnp.int32), base, max_end[None],
+           ext.astype(jnp.int32), sk, wn)
+          + ((ks, vs) if ks is not None else ())))
+    return out.reshape(B, H, T, D)
+
+
+# ----------------------------------------------------------- seq-parallel span
+def seq_sharded_span_attention(q, k_cache, v_cache, start, base, *, mesh, axis,
+                               block_kv=256, scale=None, k_scale=None,
+                               v_scale=None, ext=None, sink=None, window=None):
+    """Span attention shard_mapped over the SEQUENCE mesh axis: the wide
+    seq-parallel prefill chunk splits its ``T`` query columns across the
+    ``axis`` shards — shard ``s`` computes columns ``[s*Tl, (s+1)*Tl)``
+    against the REPLICATED pool with its causal base advanced by ``s*Tl``
+    (``Tl = T / shards``). Every (row, head-group, column) softmax is
+    independent and each shard's kernel runs the exact span math of the
+    single-shard call at span ``Tl``, so the gathered (B, H, T, D) output
+    is bit-identical to :func:`paged_span_attention` column for column.
+    ``ext`` switches to the multi-extent walk (long prompts whose earlier
+    chunks landed in other extents); tensor sharding does NOT compose here
+    — the scheduler gates seq-parallel prefill to tp == 1."""
+    from jax.sharding import PartitionSpec as SP
+    from . import shard_map_compat
+    B, H, T, D = q.shape
+    Np, nkv, S, _ = k_cache.shape
+    n = mesh.shape[axis]
+    if T % n:
+        raise ValueError(f"span width {T} must divide by the seq axis size {n}")
+    Tl = T // n
+    g = H // nkv
+    base = base.astype(jnp.int32)
+    ks, vs = _row_scales(k_scale, v_scale, Np, S)
+    max_end = jnp.max(base) + T
+    has_ext = ext is not None
+    ext_arr = (ext.astype(jnp.int32) if has_ext
+               else jnp.zeros((B, 1), jnp.int32))
+    sk, wn = _lossy_args(B, sink, window)
+
+    def body(qs, kc, vc, st, bs, me, ex, skr, wnr, *scales):
+        sh = jax.lax.axis_index(axis)
+        bl = bs + sh * Tl  # this shard's columns start Tl*sh later
+        qf = qs.reshape(B, nkv, g * Tl, D)
+        kss, vss = scales if scales else (None, None)
+        if has_ext:
+            out = _extent_call(qf, kc, vc, st, bl + 1, me[0], ex, skr, wnr,
+                               block_kv=block_kv, scale=scale, span=Tl,
+                               k_scale=kss, v_scale=vss)
+        else:
+            out = _decode_call(qf, kc, vc, st, bl + 1, me[0],
+                               block_kv=block_kv, scale=scale, span=Tl,
+                               k_scale=kss, v_scale=vss)
+        return out.reshape(B, H, Tl, D)
+
+    seq_q = SP(None, None, axis, None)
+    rep = SP()
+    in_specs = [seq_q, rep, rep, rep, rep, rep, rep, rep, rep]
+    if ks is not None:
+        in_specs += [rep, rep]
+    out = shard_map_compat(body, mesh, tuple(in_specs), seq_q)(
+        *((q, k_cache, v_cache, start.astype(jnp.int32), base, max_end[None],
+           ext_arr, sk, wn) + ((ks, vs) if ks is not None else ())))
     return out.reshape(B, H, T, D)

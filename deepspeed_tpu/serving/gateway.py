@@ -1049,10 +1049,16 @@ class Gateway:
         # capacity pre-check mirrors DecodeScheduler.submit's validation so
         # impossible requests 400 immediately instead of queueing first
         budget = _round_up(max(1, max_tokens), sched.steps_per_sync)
-        if len(prompt) >= sched.max_len or len(prompt) + budget > sched.max_len:
+        # spannable capacity: one request may chain up to
+        # long_context.max_extents slot extents (chunked mode; the
+        # monolithic path stays bounded by one slot)
+        cap = (sched.cache.spannable_len if sched.prefill_chunk > 0
+               else sched.max_len)
+        if len(prompt) >= cap or len(prompt) + budget > cap:
             raise ValueError(
                 f"prompt ({len(prompt)} tokens) + max_tokens ({max_tokens}) exceeds "
-                f"the per-slot KV capacity {sched.max_len}")
+                f"the per-slot KV capacity {sched.max_len} x "
+                f"{sched.cache.max_extents} extent(s) = {cap} spannable rows")
         return dict(
             prompt=np.asarray(prompt, np.int32),
             max_new_tokens=max_tokens,

@@ -121,11 +121,14 @@ class CapacityModel:
         self.kv_bytes_per_token = float(kv_bytes_per_token)
         self.num_slots = int(num_slots)
 
-    def dispatch_cost(self, live_ctx, width, ksteps):
+    def dispatch_cost(self, live_ctx, width, ksteps, kv_mult=1.0):
         """(flops, hbm_bytes) for ONE step dispatch: ``width`` query columns
         over the full slot block plus ``ksteps - 1`` single-column substeps,
         with ``live_ctx`` the live rows' context lengths (attention + KV
-        traffic scale with these)."""
+        traffic scale with these). ``kv_mult`` scales the KV-read term for
+        the multi-extent block walk — the extent kernel DMAs every extent's
+        pool column per KV block, so its KV traffic is ``max_extents``× the
+        contiguous walk even when most extents sit behind the mask."""
         ksteps = max(1, int(ksteps))
         cols_full = self.num_slots * (max(1, int(width)) + (ksteps - 1))
         ctx_sum = float(np.sum(live_ctx)) if len(live_ctx) else 0.0
@@ -133,7 +136,8 @@ class CapacityModel:
         flops = (cols_full * self.matmul_flops_per_col
                  + cols_per_row * ctx_sum * self.attn_flops_per_ctx_tok)
         bytes_ = ksteps * (self.weight_read_bytes
-                           + ctx_sum * self.kv_bytes_per_token)
+                           + ctx_sum * self.kv_bytes_per_token
+                           * max(1.0, float(kv_mult)))
         return flops, bytes_
 
     def flops_per_token(self, ctx):
@@ -150,7 +154,8 @@ def program_shape(key):
     as a single column. The ``*_block`` kinds are the fused decode-block
     retags — same tuple positions, priced separately in the roofline."""
     if (isinstance(key, tuple) and len(key) >= 5
-            and key[0] in ("fused", "fused_block")):
+            and key[0] in ("fused", "fused_block", "fused_ext",
+                           "fused_seqp")):
         return int(key[3]), int(key[4])
     if (isinstance(key, tuple) and len(key) >= 4
             and key[0] in ("spec", "spec_block")):
@@ -210,12 +215,14 @@ class CapacityMeter:
         return sync_seq % self.sample_every == 0
 
     # ---------------------------------------------------------------- sampling
-    def observe_dispatch(self, key, dur_s, live_ctx, width, ksteps):
+    def observe_dispatch(self, key, dur_s, live_ctx, width, ksteps,
+                         kv_mult=1.0):
         """Fold one fenced dispatch sample into the live gauges. ``dur_s``
         is the fence-to-fence wall time of the dispatch alone."""
         if dur_s <= 0.0:
             return
-        flops, bytes_ = self.model.dispatch_cost(live_ctx, width, ksteps)
+        flops, bytes_ = self.model.dispatch_cost(live_ctx, width, ksteps,
+                                                 kv_mult)
         mfu = flops / dur_s / self.peak_flops
         bw = bytes_ / dur_s / self.peak_hbm_bw
         intensity = flops / max(1.0, bytes_)

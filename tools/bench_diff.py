@@ -56,7 +56,12 @@ _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
                  "shed", "evict", "evictions", "evicts", "miss", "misses",
                  "s", "seconds", "loss", "ppl", "perplexity", "spill",
                  "spills", "dropped", "swaps", "degradation", "pending",
-                 "failed", "loads", "replays", "programs", "gap"}
+                 "failed", "loads", "replays", "programs", "gap",
+                 "ttft", "itl"}
+# long_context leg notes: "ttft"/"itl" read lower-is-better on their own so
+# ms-less variants (ttft_p50, itl_p95) resolve too; new_programs_after_first_ctx
+# rides "programs" (a length mix that compiles mid-stream is the regression);
+# extents_spanned / seq_shards are descriptive, not directional.
 # capacity-leg directionality: "gap" (host_gap_total_s — device idle time)
 # reads lower-is-better; mfu / hbm_bw_util / goodput_fraction /
 # instrumented_ratio stay on the higher-is-better default, so a sampled-

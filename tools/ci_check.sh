@@ -2,7 +2,7 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane + fusedblock lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane + fusedblock lane + longctx lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
@@ -15,6 +15,7 @@
 #   tools/ci_check.sh --moe      # MoE serving (expert-parallel decode) lane only
 #   tools/ci_check.sh --capacity # serving capacity/roofline + profiling lane only
 #   tools/ci_check.sh --fusedblock # fused llama-family decode-block lane only
+#   tools/ci_check.sh --longctx  # long-context serving (multi-extent KV + seq-parallel prefill) lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -182,6 +183,25 @@ fusedblock_lane() {
     -q -p no:cacheprovider
 }
 
+longctx_lane() {
+  echo "== long-context serving lane =="
+  # multi-extent paged KV + seq-parallel prefill guards, run UNFILTERED
+  # under the forced multi-CPU-device backend (every nodeid lives in
+  # slow_tests.txt to keep tier-1 in budget): a chained request BIT-
+  # identical (tokens AND logits, greedy + sampled) to the single-slot
+  # path, seq-parallel chunked prefill identical to single-shard, mid-
+  # decode extent demote -> detect-miss-and-restore bit-identity, the
+  # lossy sliding-window mode gated off by default and asserted NON-
+  # identical when on, a fresh chained/unchained length mix compiling
+  # ZERO new XLA programs (jax.monitoring), spannable-capacity 400s at
+  # submit AND at the gateway, and the paging/extent telemetry. The
+  # matching perf leg is `python bench.py serving` ("long_context" entry:
+  # TTFT/ITL vs context over tiny extents, BENCH_SERVING_LONGCTX knob).
+  timeout -k 10 900 env JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" python -m pytest \
+    tests/unit/inference/test_long_context.py -q -p no:cacheprovider
+}
+
 capacity_lane() {
   echo "== serving capacity/roofline lane =="
   # serving goodput & capacity observability guards (telemetry/capacity.py
@@ -271,6 +291,10 @@ if [ "${1:-}" = "--capacity" ]; then
   capacity_lane
   exit $?
 fi
+if [ "${1:-}" = "--longctx" ]; then
+  longctx_lane
+  exit $?
+fi
 if [ "${1:-}" = "--fusedblock" ]; then
   fusedblock_lane
   exit $?
@@ -326,7 +350,10 @@ cp_rc=$?
 fusedblock_lane
 fb_rc=$?
 
+longctx_lane
+lc_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ] && [ "$fb_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ] && [ "$fb_rc" -eq 0 ] && [ "$lc_rc" -eq 0 ]
