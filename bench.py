@@ -379,6 +379,10 @@ def _serving_bench(model_name="gpt2-large", dtype="int8", num_slots=8, n_request
                lambda: _long_context_bench(seed,
                                            max_ctx=int(os.environ.get(
                                                "BENCH_SERVING_LONGCTX", "4096"))))
+    _guard_leg(results, "autoscale",
+               lambda: _autoscale_bench(make, num_slots, max_new, seed,
+                                        n_spike=int(os.environ.get(
+                                            "BENCH_SERVING_AUTOSCALE", "6"))))
     return results
 
 
@@ -717,6 +721,146 @@ def _replicas_bench(make, num_slots, max_new, seed, n_replicas=2):
         out["scaling_efficiency"] = round(out["speedup"] / n_replicas, 3)
         if lo.get("ttft_ms_p95") and hi.get("ttft_ms_p95"):
             out["ttft_p95_speedup"] = round(lo["ttft_ms_p95"] / hi["ttft_ms_p95"], 3)
+    return out
+
+
+def _autoscale_bench(make, num_slots, max_new, seed, n_spike=6):
+    """Elastic-fleet leg (BENCH_SERVING_AUTOSCALE = spike request count, 0
+    disables): one ramp -> spike -> decay open-loop arrival trace served
+    twice — a static single replica vs the FleetController closing the
+    loop (queue-wait scale-up at the spike, brownout shedding of
+    batch-tier work once the fleet is at max_replicas, calm-window
+    two-phase scale-down after the decay). Reports per-leg completions,
+    sheds, arrival-to-first-token p95, the replica-count trace, the
+    controller's decision tally, and the zero-new-XLA-programs guard
+    across the whole grow/shed/shrink cycle (the elastic-fleet contract:
+    a resize costs HBM, never a compile)."""
+    from deepspeed_tpu.inference.config import AutoscalerConfig
+    from deepspeed_tpu.serving import FleetController, FleetSignals, ReplicaSet
+
+    if n_spike <= 0:
+        return {"skipped": "BENCH_SERVING_AUTOSCALE=0"}
+    rng = np.random.default_rng(seed + 23)
+    ramp = max(2, n_spike // 3)
+    plan = []  # (arrival_s, tier) — the spike floods at one instant
+    t = 0.0
+    for _ in range(ramp):
+        plan.append((t, "standard"))
+        t += 0.4
+    for i in range(n_spike):
+        plan.append((t, "batch" if i % 2 else "standard"))
+    for _ in range(ramp):
+        t += 0.4
+        plan.append((t, "standard"))
+    prompts = [rng.integers(0, 50257, int(rng.integers(8, 24))).astype(np.int32)
+               for _ in plan]
+    mnt = min(max_new, 24)
+    out = {"requests": len(plan), "spike_requests": n_spike}
+
+    for leg in ("static", "autoscaled"):
+        eng = make(True)
+        rs = ReplicaSet.build(eng, 1, num_slots=num_slots)
+        budget = 2 * rs.primary.steps_per_sync
+        # warm the shared program set: every stream prompt shares the warm
+        # prompt's prefill bucket, and budget+2 forces the decode multi-step
+        rs.primary.submit(np.ones(24, np.int32), max_new_tokens=budget + 2).result()
+        warm_programs = rs.compiled_program_count()
+        ctl = None
+        if leg == "autoscaled":
+            ctl = FleetController(AutoscalerConfig({
+                "enabled": True, "interval_s": 0.05, "min_replicas": 1,
+                "max_replicas": 2, "queue_wait_up_s": 0.4,
+                "cooldown_up_s": 1.0, "cooldown_down_s": 2.0,
+                "scale_down_occupancy": 0.5, "brownout_tiers": ["standard"],
+                "brownout_step_s": 0.3, "brownout_cooldown_s": 0.6}))
+            ctl.scale_up_fn = lambda: rs.add_replica() is not None
+
+            def _scale_down():
+                for rep in reversed(list(rs)):
+                    if rep.idx and not rep.pending_drain and not rep.retired:
+                        rs.begin_scale_down(rep.idx)
+                        return True
+                return False
+            ctl.scale_down_fn = _scale_down
+            # the level lives on the controller; the pump below reads it
+            ctl.brownout_fn = lambda level: True
+        pending = sorted(zip(plan, prompts), key=lambda it: it[0][0])
+        handles = []   # (arrival_s, handle)
+        shed = 0
+        trace = []
+        t0 = time.perf_counter()
+
+        def _pump_tick():
+            nonlocal shed, pending
+            now = time.perf_counter() - t0
+            # brownout door: an engaged ladder sheds the sub-bar tier from
+            # the queue (what the gateway's evict/503 path does)
+            if ctl is not None and ctl.brownout_level >= 1:
+                keep = []
+                for item in pending:
+                    if item[0][0] <= now and item[0][1] == "batch":
+                        shed += 1
+                    else:
+                        keep.append(item)
+                pending = keep
+            while pending and pending[0][0][0] <= now:
+                rep, h = rs.dispatch(pending[0][1], max_new_tokens=mnt)
+                if h is None:
+                    break
+                # queue wait is arrival -> dispatch in this loop's clock;
+                # submit -> first token rides the scheduler's own stamps
+                # (the telemetry clock has a different epoch)
+                handles.append((now - pending[0][0][0], h))
+                pending.pop(0)
+            if ctl is not None:
+                ready = [it for it in pending if it[0][0] <= now]
+                ctl.tick(FleetSignals(
+                    now=now, queue_depth=len(ready),
+                    oldest_wait_s=(now - min(it[0][0] for it in ready))
+                    if ready else 0.0,
+                    occupancy=float(np.mean(
+                        [r.scheduler.cache.occupancy()
+                         for r in rs if not r.retired])),
+                    replicas=rs.active_count(),
+                    replicas_active=sum(1 for r in rs if r.available()),
+                    inflight=sum(1 for _, h in handles if not h.done)))
+            trace.append(rs.active_count())
+            if not rs.pump_once() and not ready_sleepless(now):
+                time.sleep(0.01)
+
+        def ready_sleepless(now):
+            return (pending and pending[0][0][0] <= now) or any(
+                not h.done for _, h in handles)
+
+        while pending or any(not h.done for _, h in handles):
+            _pump_tick()
+        dt = time.perf_counter() - t0
+        # calm window: let the controller de-escalate and retire the spare
+        # pool (two-phase pending-drain -> retire rides pump_once)
+        if ctl is not None:
+            calm_deadline = time.perf_counter() + 8.0
+            while ((rs.active_count() > 1 or ctl.brownout_level > 0)
+                   and time.perf_counter() < calm_deadline):
+                _pump_tick()
+                time.sleep(0.02)
+        toks = sum(len(h.result()) for _, h in handles)
+        ttfts = sorted((wait + h._req.first_token_ts - h._req.submit_ts) * 1e3
+                       for wait, h in handles
+                       if h._req.first_token_ts is not None)
+        out[leg] = {
+            "completed": len(handles), "shed": shed,
+            "tokens_per_sec": round(toks / dt, 1),
+            "ttft_from_arrival_ms_p95":
+                round(float(np.percentile(ttfts, 95)), 1) if ttfts else None,
+            "max_replicas": max(trace), "final_replicas": rs.active_count(),
+            "new_programs": rs.compiled_program_count() - warm_programs,
+        }
+        if ctl is not None:
+            out[leg]["decisions"] = {k: int(v) for k, v in ctl.counters.items()}
+    lo, hi = out.get("static", {}), out.get("autoscaled", {})
+    if lo.get("ttft_from_arrival_ms_p95") and hi.get("ttft_from_arrival_ms_p95"):
+        out["ttft_p95_static_over_autoscaled"] = round(
+            lo["ttft_from_arrival_ms_p95"] / hi["ttft_from_arrival_ms_p95"], 3)
     return out
 
 

@@ -1,2 +1,3 @@
 from .elasticity import (compute_elastic_config, elasticity_enabled,  # noqa: F401
                          ElasticityError, ElasticityConfigError, ElasticityIncompatibleWorldSize)
+from .manager import ElasticityManager, ResizePlan  # noqa: F401

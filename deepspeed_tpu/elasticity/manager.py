@@ -1,0 +1,129 @@
+"""Elastic training resize plans: checkpoint-restore across world sizes.
+
+The runtime half the elasticity package was missing: ``elasticity.py``
+pre-computes the fixed effective batch and its compatible chip counts
+(reference ``deepspeed/elasticity``), and the runtime config validates the
+LAUNCH world against it — but nothing connected a checkpoint saved at one
+world size to a resume at another. On TPU the elastic event is a slice
+resize (preemption reshapes the pod; the job relaunches on whatever slice
+the scheduler grants), and the invariant that makes the loss curve
+continuous across the resize is: **the effective train batch never moves**
+— only the ``micro_batch × grad_accum × data_parallel`` tiling under it
+re-solves for the new world.
+
+:class:`ElasticityManager` owns that re-solve:
+
+- :meth:`plan` — one world size -> a :class:`ResizePlan` (train batch,
+  micro batch, grad-accum, dp degree, the compatible-world set), raising
+  :class:`~deepspeed_tpu.elasticity.elasticity.ElasticityIncompatibleWorldSize`
+  for a world the fixed batch cannot tile.
+- :meth:`on_restore` — called by ``engine.load_checkpoint`` with the saved
+  ``client_sd``: detects a world-size change since the save, validates
+  BOTH worlds sit in the compatible set, asserts the effective batch is
+  unchanged (a drifted elasticity section between save and resume would
+  silently bend the loss curve — that is a hard config error), and
+  returns the new plan (logged + counted) or None when nothing resized.
+
+The checkpoint itself is already resize-proof: arrays are saved as global
+logical tensors (universal-checkpoint property), so only the batch tiling
+— not the tensor layout — needs re-solving here.
+"""
+
+from ..utils.logging import logger
+from .elasticity import (ElasticityConfigError,
+                         ElasticityIncompatibleWorldSize,
+                         compute_elastic_config, elasticity_enabled)
+
+
+class ResizePlan:
+    """One world size's tiling of the fixed effective batch."""
+
+    __slots__ = ("world_size", "data_parallel", "train_batch", "micro_batch",
+                 "grad_accum", "compatible_worlds")
+
+    def __init__(self, world_size, data_parallel, train_batch, micro_batch,
+                 grad_accum, compatible_worlds):
+        self.world_size = int(world_size)
+        self.data_parallel = int(data_parallel)
+        self.train_batch = int(train_batch)
+        self.micro_batch = int(micro_batch)
+        self.grad_accum = int(grad_accum)
+        self.compatible_worlds = list(compatible_worlds)
+
+    def as_dict(self):
+        return {name: getattr(self, name) for name in self.__slots__}
+
+    def __repr__(self):
+        return (f"ResizePlan(world={self.world_size}, dp={self.data_parallel}, "
+                f"batch={self.train_batch} = {self.micro_batch} micro x "
+                f"{self.grad_accum} accum x {self.data_parallel} dp)")
+
+
+class ElasticityManager:
+    """Resize-plan solver over one ds_config's ``elasticity`` section."""
+
+    def __init__(self, ds_config):
+        ds_config = dict(ds_config or {})
+        if not elasticity_enabled(ds_config):
+            raise ElasticityConfigError(
+                "ElasticityManager requires an enabled 'elasticity' section")
+        self.ds_config = ds_config
+        sec = dict(ds_config.get("elasticity", {}))
+        self.model_parallel_size = int(sec.get("model_parallel_size", 1))
+        self.version = float(sec.get("version", 0.1))
+
+    def plan(self, world_size):
+        """Tile the fixed effective batch over ``world_size`` chips."""
+        world_size = int(world_size)
+        final_batch, worlds, micro = compute_elastic_config(
+            self.ds_config, world_size=world_size, return_microbatch=True)
+        mp = self.model_parallel_size
+        dp = (world_size // mp if (self.version >= 0.2 and mp > 1)
+              else world_size)
+        return ResizePlan(world_size, dp, final_batch, micro,
+                          final_batch // (micro * dp), worlds)
+
+    def on_restore(self, world_size, client_sd, telemetry=None):
+        """Validate (and describe) an elastic resume.
+
+        ``client_sd`` is the loaded checkpoint's client state; the save
+        side stamps ``world_size`` and ``ds_config`` into it. Returns the
+        current world's :class:`ResizePlan` when the world CHANGED since
+        the save, None when it didn't (or the checkpoint predates the
+        stamp). Raises when either world is incompatible with the fixed
+        batch, or when the saved config's elastic batch differs from the
+        current one — a resume must never silently change the effective
+        batch mid-run."""
+        saved_world = (client_sd or {}).get("world_size")
+        current = self.plan(world_size)
+        if not saved_world or int(saved_world) == current.world_size:
+            return None
+        # the save-time tiling must have been legal under the CURRENT
+        # elastic envelope too: a saved world outside today's compatible
+        # set means the section changed shape between save and resume
+        if int(saved_world) not in current.compatible_worlds:
+            raise ElasticityIncompatibleWorldSize(
+                f"checkpoint was saved at world size {saved_world}, which is "
+                f"not in the current compatible set "
+                f"{current.compatible_worlds} — the elasticity section "
+                f"changed since the save")
+        saved_cfg = (client_sd or {}).get("ds_config")
+        if isinstance(saved_cfg, dict) and elasticity_enabled(saved_cfg):
+            saved_batch, _ = compute_elastic_config(saved_cfg)
+            if int(saved_batch) != current.train_batch:
+                raise ElasticityConfigError(
+                    f"elastic effective batch moved across the resume: "
+                    f"checkpoint solved {saved_batch}, current config solves "
+                    f"{current.train_batch} — the loss curve would bend; "
+                    f"restore the original elasticity section")
+        logger.info(
+            f"elasticity: resuming across a resize {saved_world} -> "
+            f"{current.world_size} chips; effective batch held at "
+            f"{current.train_batch} ({current.micro_batch} micro x "
+            f"{current.grad_accum} accum x {current.data_parallel} dp)")
+        if telemetry is not None and getattr(telemetry, "enabled", False):
+            telemetry.counter("elasticity/resizes")
+            telemetry.event("elasticity/resize",
+                            {"from_world": int(saved_world),
+                             **current.as_dict()})
+        return current

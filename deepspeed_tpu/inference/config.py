@@ -175,6 +175,95 @@ class LongContextConfig(DeepSpeedConfigModel):
                                  "must still opt in per-call")
 
 
+class AutoscalerConfig(DeepSpeedConfigModel):
+    """Elastic fleet control plane (``serving/controller.py``): an
+    SLO-driven :class:`FleetController` ticked from the replica-0 pump
+    that scales the replica fleet, re-balances prefill/decode roles, and
+    runs a brownout load-shedding ladder. Policy-as-config: every
+    threshold below is a decision input; the decision function itself is
+    pure (no wall clock) and every decision is an ``autoscale/decision``
+    telemetry event. See benchmarks/SERVING.md ("Elastic fleet")."""
+
+    enabled = ConfigField(default=False)
+    dry_run = ConfigField(default=False, help="evaluate and RECORD decisions "
+                          "(events, /v1/autoscaler) without actuating — the "
+                          "rollout mode: watch what the controller WOULD do "
+                          "against live traffic before handing it the keys")
+    min_replicas = ConfigField(default=1, help="scale-down floor (>= 1; "
+                               "replica 0 never retires — it owns the shared "
+                               "compiled-program cache)")
+    max_replicas = ConfigField(default=4, help="scale-up ceiling: each replica "
+                               "adds a KV slot pool's HBM but ZERO XLA "
+                               "programs (shared compiled-program dict)")
+    interval_s = ConfigField(default=2.0, help="decision cadence; signals are "
+                             "snapshotted once per tick (FleetSignals)")
+    scale_up_burn = ConfigField(default=2.0, help="fast-window SLO burn rate "
+                                "at/above which the fleet is overloaded "
+                                "(paired with slow_burn_floor: both windows "
+                                "must burn, so a blip doesn't scale)")
+    slow_burn_floor = ConfigField(default=1.0, help="slow-window burn rate "
+                                  "that must ALSO hold for overload (multi-"
+                                  "window burn: fast catches the spike, slow "
+                                  "confirms it is sustained)")
+    queue_wait_up_s = ConfigField(default=5.0, help="head-of-line queue wait "
+                                  "that declares overload even without an SLO "
+                                  "burn (covers disabled-telemetry fleets)")
+    scale_down_burn = ConfigField(default=0.5, help="both burn windows at/"
+                                  "below this + empty queue + occupancy below "
+                                  "scale_down_occupancy = calm enough to shrink")
+    scale_down_occupancy = ConfigField(default=0.3, help="fleet slot occupancy "
+                                       "ceiling for scale-down (shrinking a "
+                                       "busy fleet would immediately re-queue)")
+    cooldown_up_s = ConfigField(default=10.0, help="minimum seconds between "
+                                "scale-ups (a new replica needs a tick or two "
+                                "to absorb load before judging it)")
+    cooldown_down_s = ConfigField(default=30.0, help="minimum seconds after "
+                                  "ANY scale action before shrinking "
+                                  "(hysteresis against grow/shrink flapping)")
+    host_gap_veto = ConfigField(default=0.5, help="host-gap fraction (device-"
+                                "idle seconds per wall second, from serving/"
+                                "host_gap/*) at/above which scale-up is "
+                                "VETOED: the host, not the device, is the "
+                                "bottleneck, and another replica would only "
+                                "add host work")
+    brownout_tiers = ConfigField(default=lambda: ["standard"],
+                                 help="escalation ladder: each tier name "
+                                 "yields two brownout levels — first EVICT "
+                                 "queued flows whose priority weighs below "
+                                 "it, then PREEMPT in-flight work below it "
+                                 "(cancel, or park-for-resume with "
+                                 "brownout_park)")
+    brownout_step_s = ConfigField(default=5.0, help="minimum seconds between "
+                                  "brownout level changes (either direction)")
+    brownout_cooldown_s = ConfigField(default=15.0, help="seconds without "
+                                      "overload before the ladder de-"
+                                      "escalates one level")
+    brownout_retry_after_s = ConfigField(default=20, help="Retry-After "
+                                         "advertised on brownout 503s (shed "
+                                         "tiers should back off harder than "
+                                         "the live-state estimate suggests)")
+    brownout_park = ConfigField(default=False, help="preempt in-flight work "
+                                "by PARKING its decode state through the "
+                                "migrate-out transport (resumes bit-identical "
+                                "when the brownout lifts; requires the "
+                                "hierarchical-KV/disaggregation prefix "
+                                "store) instead of cancelling it")
+    goodput_free_threshold = ConfigField(default=0.5, help="when serving/"
+                                         "goodput_fraction falls below this, "
+                                         "preemption is priced as FREE (the "
+                                         "fleet is mostly wasted work — spec-"
+                                         "rejected or replayed tokens) and "
+                                         "the ladder may skip the step "
+                                         "cooldown to escalate")
+    rebalance_ratio = ConfigField(default=2.0, help="phase-saturation skew "
+                                  "(busier side / calmer side) at/above which "
+                                  "a disaggregated fleet flips one replica's "
+                                  "role toward the busy phase")
+    cooldown_flip_s = ConfigField(default=20.0, help="minimum seconds between "
+                                  "role flips (a flip costs sticky purges and "
+                                  "possibly a one-off tier-program warmup)")
+
+
 class ContinuousBatchingConfig(DeepSpeedConfigModel):
     """Continuous-batching serving path (``inference/scheduler.py``):
     iteration-level admission into a fixed slot-pool KV cache. When enabled,
@@ -254,6 +343,11 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
         help="disaggregated prefill/decode: phase-specialized replicas with "
         "KV migration over the hierarchical-KV transport "
         "(serving/replica.py; see benchmarks/SERVING.md)")
+    autoscaler = ConfigField(
+        default=AutoscalerConfig,
+        help="elastic fleet control plane: SLO-driven replica autoscaling, "
+        "prefill/decode re-balancing, and brownout preemption "
+        "(serving/controller.py; see benchmarks/SERVING.md)")
     replicas = ConfigField(default=1, help="data-parallel scheduler replicas behind "
                            "the gateway (serving/replica.py): N independent slot "
                            "pools (each tp-sharded per the mesh) sharing ONE "

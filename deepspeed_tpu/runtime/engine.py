@@ -1681,6 +1681,9 @@ class DeepSpeedEngine:
             "data_sampler": (self._data_sampler.state_dict()
                              if self._data_sampler is not None else None),
             "ds_config": self._config.raw_config,
+            # elastic resume: the restore side compares this against its own
+            # world to detect (and validate) a resize across the checkpoint
+            "world_size": self._config.world_size,
         })
         if self.param_stream is not None:
             # param offload: every block (master + moments) is host-resident
@@ -1754,6 +1757,7 @@ class DeepSpeedEngine:
             self.micro_steps = client_sd.get("micro_steps", 0)
             if load_lr_scheduler_states and self.lr_scheduler is not None and client_sd.get("lr_scheduler"):
                 self.lr_scheduler.load_state_dict(client_sd["lr_scheduler"])
+            self._elastic_on_restore(client_sd)
             self.loaded_checkpoint_tag = tag_used
             return load_dir, client_sd
         state, client_sd = _load(load_dir, tag, self.state_shardings._replace(grad_acc={}), self.mesh,
@@ -1790,8 +1794,22 @@ class DeepSpeedEngine:
                 # loader not built yet (load-then-deepspeed_io order): stash
                 # and apply when the sampler is created
                 self._pending_sampler_state = client_sd["data_sampler"]
+        self._elastic_on_restore(client_sd)
         self.loaded_checkpoint_tag = tag
         return load_dir, client_sd
+
+    def _elastic_on_restore(self, client_sd):
+        """Elastic resume validation: with the ``elasticity`` section
+        enabled and a checkpoint stamped at a DIFFERENT world size, the
+        :class:`~deepspeed_tpu.elasticity.ElasticityManager` re-solves the
+        batch tiling for this world and asserts the effective train batch
+        did not move across the resize (incompatibility raises — resuming
+        with a bent loss curve is worse than failing loudly)."""
+        from ..elasticity import ElasticityManager, elasticity_enabled
+        if not elasticity_enabled(self._config.raw_config):
+            return
+        ElasticityManager(self._config.raw_config).on_restore(
+            self._config.world_size, client_sd, telemetry=self.telemetry)
 
     def save_16bit_model(self, save_dir, save_filename="pytree_model.msgpack", exclude_frozen_parameters=False):
         """Consolidated compute-dtype export (reference engine.py:3223

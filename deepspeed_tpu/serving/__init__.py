@@ -10,6 +10,7 @@ Quickstart (see ``benchmarks/SERVING.md`` "Gateway" for the full protocol)::
 """
 
 from ..inference.config import GatewayConfig  # noqa: F401
+from .controller import FleetController, FleetSignals  # noqa: F401
 from .fair_queue import FairQueue, QueueFull  # noqa: F401
 from .replica import Replica, ReplicaSet  # noqa: F401
 from .gateway import Gateway  # noqa: F401

@@ -40,6 +40,12 @@ def build_parser():
                         "finished prefills migrate their KV to decode "
                         "replicas (runtime override: POST "
                         "/v1/replicas/<i>/role)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="enable the elastic fleet controller "
+                        "(continuous_batching.autoscaler.enabled): SLO-driven "
+                        "replica scaling, phase re-balancing, and brownout "
+                        "shedding, ticked from the serving pump (runtime "
+                        "toggle + dry-run: POST /v1/autoscaler)")
     p.add_argument("--max-queue-depth", type=int, default=None)
     p.add_argument("--default-max-tokens", type=int, default=None)
     p.add_argument("--request-timeout-s", type=float, default=None)
@@ -60,6 +66,9 @@ def main(argv=None):
         cfg["continuous_batching"]["num_slots"] = args.num_slots
     if args.replicas is not None:
         cfg["continuous_batching"]["replicas"] = args.replicas
+    if args.autoscale:
+        # merge: keep any tuned autoscaler thresholds from the config file
+        cfg["continuous_batching"].setdefault("autoscaler", {})["enabled"] = True
     if args.disagg_roles is not None:
         # merge, don't replace: a config file's migrate_min_tokens (etc.)
         # must survive the CLI setting the roles

@@ -185,6 +185,60 @@ class FairQueue:
         with self._lock:
             return {flow.key: len(flow.queue) for flow in self._flows.values()}
 
+    def flow_stats(self):
+        """Per-flow queue state for the fleet controller / metrics surface:
+        ``{flow key: {tenant, priority, depth, oldest_wait_s, weight}}``.
+        ``oldest_wait_s`` is the age of the flow's HEAD request — the
+        per-flow head-of-line-wait the brownout ladder prices eviction by."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                flow.key: {
+                    "tenant": flow.tp[0],
+                    "priority": flow.tp[1],
+                    "depth": len(flow.queue),
+                    "oldest_wait_s": (round(now - flow.queue[0][2], 6)
+                                      if flow.queue else 0.0),
+                    "weight": flow.weight,
+                }
+                for flow in self._flows.values()}
+
+    def tier_weight(self, priority):
+        """The configured weight multiplier of a priority class (unknown
+        classes resolve to the floor, same rule as admission)."""
+        return float(self.priority_weights.get(str(priority), self._floor))
+
+    def evict_flows(self, below_tier):
+        """Brownout load shedding: remove every queued request whose flow's
+        PRIORITY class weighs strictly less than ``below_tier``'s weight —
+        tenant weights don't shield a low class (the ladder sheds by tier,
+        not by tenant generosity). Returns the evicted ``(item, tenant,
+        priority)`` rows, oldest-first within each flow; the caller owes
+        each a 503 with a brownout ``Retry-After``. An unknown tier name
+        resolves to the floor weight, so (strict comparison) it evicts
+        nothing rather than everything."""
+        bar = self.tier_weight(below_tier)
+        evicted = []
+        with self._lock:
+            for flow in list(self._flows.values()):
+                if self.tier_weight(flow.tp[1]) >= bar:
+                    continue
+                while flow.queue:
+                    _cost, item, _enq = flow.queue.popleft()
+                    evicted.append((item, flow.tp[0], flow.tp[1]))
+                    self._depth -= 1
+                # evicted flows leave the rotation like emptied ones (and
+                # forfeit deficit); removing the rotation HEAD hands the
+                # turn to the next flow with a fresh credit
+                if self._rotation and self._rotation[0] is flow:
+                    self._fresh_turn = True
+                try:
+                    self._rotation.remove(flow)
+                except ValueError:
+                    pass
+                self._drop_flow(flow)
+        return evicted
+
     def oldest_wait_s(self):
         """Age (seconds) of the longest-queued request across every flow —
         the head-of-line-wait signal the SLO/metrics surface reads; 0.0

@@ -64,6 +64,17 @@ no aiohttp/fastapi in the image, and none needed):
   one sick replica from sinking the fleet. ``GET /v1/replicas`` lists
   states.
 
+- **Elastic fleet control plane** (``continuous_batching.autoscaler``):
+  a :class:`~deepspeed_tpu.serving.controller.FleetController` ticked from
+  the replica-0 pump reads one consolidated signal snapshot per interval
+  (SLO burn rates, queue wait, phase saturation, MFU/HBM/host-gap/goodput)
+  and drives three cooldown-guarded actuators — grow/shrink the replica
+  fleet over the SHARED compiled-program set, flip prefill/decode roles as
+  the traffic mix drifts, and a brownout ladder that evicts then preempts
+  low-tier work (503 + brownout Retry-After; optionally parking decode
+  state for resume through the migration transport). ``GET/POST
+  /v1/autoscaler`` exposes decisions and runtime enable/dry-run.
+
 Threading model: the asyncio event loop owns sockets and parsing; one
 **pump thread per replica** owns ALL of that replica's scheduler
 interaction (submit/step/cancel — each scheduler stays single-threaded).
@@ -94,6 +105,7 @@ from ..telemetry import (DEFAULT_SERVING_OBJECTIVES, RequestTrace, SLOEngine,
                          extract_trace_context)
 from ..telemetry import prometheus as prom
 from ..utils.logging import logger
+from .controller import FleetController, FleetSignals
 from .fair_queue import FairQueue, QueueFull
 from .replica import ReplicaSet
 
@@ -192,7 +204,9 @@ class Gateway:
                                priority_weights=config.priority_weights)
         self.stats = {"requests": 0, "completed": 0, "tokens": 0, "shed_429": 0,
                       "shed_503": 0, "deadline_expired": 0, "disconnects": 0,
-                      "rejected": 0}
+                      "rejected": 0, "brownout_shed": 0, "brownout_evicted": 0,
+                      "brownout_preempted": 0, "brownout_parked": 0,
+                      "replicas_added": 0, "replicas_retired": 0}
         self.host = config.host
         self.port = None  # bound port (after start)
         self.ready = False
@@ -247,6 +261,27 @@ class Gateway:
         if self.telemetry.enabled:
             from ..telemetry.profiler import XlaProfiler
             self.profiler = XlaProfiler(self.telemetry.output_path)
+        # elastic fleet control plane (serving/controller.py): the replica-0
+        # pump ticks it with one consolidated FleetSignals snapshot per
+        # interval; the four actuators below close the loop onto the
+        # ReplicaSet / FairQueue / cancel machinery the stack already has.
+        # Constructed even when disabled so POST /v1/autoscaler can turn it
+        # on at runtime (rollout: start dry_run, watch decisions, enable).
+        cb_cfg = getattr(engine._config, "continuous_batching", None)
+        as_cfg = getattr(cb_cfg, "autoscaler", None)
+        self.autoscaler = None
+        if as_cfg is not None:
+            self.autoscaler = FleetController(as_cfg, telemetry=self.telemetry)
+            self.autoscaler.scale_up_fn = self._scale_up
+            self.autoscaler.scale_down_fn = self._scale_down
+            self.autoscaler.rebalance_fn = self._rebalance
+            self.autoscaler.brownout_fn = self._set_brownout
+        # a replica added at runtime needs its own pump thread: the set
+        # fires this from whichever thread ran add_replica
+        self.replicas.on_replica_added = self._spawn_pump
+        self._brownout_bar = None   # weight bar arrivals shed under (None=off)
+        self._park_pending = set()  # greqs awaiting park-out on their owning pump
+        self._gap_mark = None       # (now, fleet host-gap total) delta basis
 
     # ------------------------------------------------------------------ lifecycle
     def start_background(self, timeout=120.0):
@@ -294,6 +329,11 @@ class Gateway:
         self.draining = True
         self.ready = False
         logger.info("gateway: drain initiated (no new admissions)")
+        # lift any brownout: parked decode state must resume (and finish)
+        # for the drain to complete, and the door is closed anyway
+        self._brownout_bar = None
+        self._park_pending.clear()
+        self.replicas.release_parked()
         # drain grace bound: past it, in-flight requests fail fast instead
         # of holding the process open forever
         timer = threading.Timer(float(self.config.drain_timeout_s), self._force)
@@ -340,12 +380,9 @@ class Gateway:
         # admission and terminal accounting serialize on the dispatch/finish
         # locks. On a pod each pump drives its own device group; on one host
         # the threads interleave through the shared backend.
-        self._pump_threads = [
-            threading.Thread(target=self._pump, args=(rep, ), daemon=True,
-                             name=f"gateway-pump-{rep.idx}")
-            for rep in self.replicas]
-        for t in self._pump_threads:
-            t.start()
+        self._pump_threads = []
+        for rep in self.replicas:
+            self._spawn_pump(rep)
         self._pump_thread = self._pump_threads[0]  # single-replica back-compat
         self.ready = True
         ready_cb()
@@ -370,6 +407,17 @@ class Gateway:
         """Transfer-thread-safe pump wakeup (migration-ready callback)."""
         self._wake.set()
 
+    def _spawn_pump(self, rep):
+        """Start (or restart) the pump thread that owns ``rep``'s scheduler.
+        Called at startup for the initial fleet and from ``add_replica`` —
+        on the on_replica_added hook — for elastic growth; a retired index
+        being re-used gets a FRESH thread (the old one exited at retire)."""
+        t = threading.Thread(target=self._pump, args=(rep, ), daemon=True,
+                             name=f"gateway-pump-{rep.idx}")
+        self._pump_threads.append(t)
+        t.start()
+        return t
+
     def _pump(self, rep):
         """One replica's pump: admit from the fair queue in DRR order
         (dispatch-locked — placement is a fleet-wide decision), step THIS
@@ -393,6 +441,10 @@ class Gateway:
                 # failing on device must degrade to sick-replica shedding,
                 # not kill this daemon thread and strand its requests
                 self.replicas.admit_migrations(rep)
+                if self._park_pending:
+                    # brownout park-for-resume: only the owning pump may
+                    # call migrate_out on its scheduler
+                    self._park_owned(rep)
                 if not rep.idle() and not rep.sick:
                     rep.step()
             except Exception:  # noqa: BLE001 — fail requests, not the server
@@ -425,6 +477,10 @@ class Gateway:
                 self._watch_recompiles()
                 if self.slo is not None:
                     self.slo.maybe_evaluate()
+                if self.autoscaler is not None and not self.draining:
+                    # elastic fleet control: one consolidated snapshot, at
+                    # most one actuation per interval (controller.py)
+                    self.autoscaler.tick(self.fleet_signals())
                 if self._flight_request is not None:
                     reason, self._flight_request = self._flight_request, None
                     self.telemetry.dump_flight(reason)
@@ -432,6 +488,13 @@ class Gateway:
                     # belt-and-braces deadline: stops an overdue capture
                     # even if its timer thread was lost
                     self.profiler.poll()
+            if rep.pending_drain or rep.retired:
+                # elastic scale-down: once THIS pump observes its replica
+                # idle it performs the retire itself (frees the slot pool
+                # HBM on the thread that owns the scheduler) and exits;
+                # add_replica reusing the index spawns a fresh pump
+                if rep.retired or self.replicas.finish_scale_down(rep):
+                    break
             if rep.idle() or rep.sick:
                 if self.draining and not len(self._fair) and not self._active:
                     break
@@ -669,6 +732,206 @@ class Gateway:
         except RuntimeError:
             pass  # event loop closed mid-drain
 
+    # ------------------------------------------------------------------ elastic fleet
+    def fleet_signals(self, now=None):
+        """One consolidated :class:`FleetSignals` snapshot — the controller
+        tick's entire world view, assembled here so the decision function
+        never reads live gateway state (deterministic under test: tests
+        construct FleetSignals directly)."""
+        now = time.monotonic() if now is None else now
+        burn_fast = burn_slow = 0.0
+        if self.slo is not None:
+            for obj in (self.slo._last_state or {}).get("objectives", []):
+                burn_fast = max(burn_fast, float(obj.get("burn_fast") or 0.0))
+                burn_slow = max(burn_slow, float(obj.get("burn_slow") or 0.0))
+        reps = [r for r in self.replicas if not r.retired]
+        active = [r for r in reps if r.available()]
+        placeable = active or reps  # degenerate all-drained fleet: avoid /0
+        pre_depth = (len(self._fair)
+                     + sum(len(r.scheduler.queue) for r in active
+                           if r.prefill_capable()))
+        total_slots = sum(r.scheduler.num_slots for r in placeable) or 1
+        busy = sum(r.busy_slots() for r in active)
+        mfu = bw = 0.0
+        goodput = 1.0
+        cap = self.scheduler.capacity
+        if cap is not None:
+            goodput = float(cap.goodput_fraction)
+            # per-program roofline entries hold the LAST sampled dispatch;
+            # the max across programs is the "how hot is the device" signal
+            for ent in cap.programs.values():
+                mfu = max(mfu, float(ent.get("mfu", 0.0)))
+                bw = max(bw, float(ent.get("hbm_bw_util", 0.0)))
+        # host-gap fraction: device-idle seconds accrued per wall second
+        # since the previous snapshot, summed over the fleet's trackers —
+        # the "the host is the bottleneck" veto input
+        host_gap_frac = 0.0
+        gap_total = sum(r.scheduler._gap.total_gap_s for r in reps
+                        if r.scheduler._gap is not None)
+        mark, self._gap_mark = self._gap_mark, (now, gap_total)
+        if mark is not None and now > mark[0]:
+            host_gap_frac = max(0.0, min(1.0, (gap_total - mark[1])
+                                         / (now - mark[0])))
+        return FleetSignals(
+            now=now, burn_fast=burn_fast, burn_slow=burn_slow,
+            queue_depth=len(self._fair),
+            oldest_wait_s=self._fair.oldest_wait_s(),
+            prefill_sat=pre_depth / max(1, self.replicas.phase_slots("prefill")),
+            decode_sat=len(self._active) / max(1, self.replicas.phase_slots("decode")),
+            mfu=mfu, hbm_bw_util=bw, host_gap_frac=host_gap_frac,
+            goodput_fraction=goodput, occupancy=busy / total_slots,
+            replicas=len(reps), replicas_active=len(active),
+            inflight=len(self._active),
+            disaggregated=self.replicas.disaggregated())
+
+    def _scale_up(self):
+        """Autoscaler actuator: grow the fleet by one replica over the
+        SHARED weight tree + compiled-program set (zero new XLA programs —
+        warmup is pool allocation; on_replica_added spawns its pump)."""
+        if self.replicas.active_count() >= int(self.autoscaler.config.max_replicas):
+            return False
+        rep = self.replicas.add_replica()
+        self.stats["replicas_added"] += 1
+        logger.info(f"autoscaler: added replica {rep.idx} "
+                    f"(fleet {self.replicas.active_count()})")
+        self._wake.set()
+        return True
+
+    def _scale_down(self):
+        """Autoscaler actuator: begin the two-phase retire of the
+        highest-index drainable replica (never 0 — it owns the fleet-wide
+        pump duties). Its own pump finishes the retire once idle."""
+        victims = [r for r in self.replicas
+                   if r.idx != 0 and not r.retired and not r.pending_drain
+                   and not r.sick]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda r: r.idx)
+        self.replicas.begin_scale_down(victim.idx)
+        self.stats["replicas_retired"] += 1
+        logger.info(f"autoscaler: draining replica {victim.idx} for retire")
+        self._wake.set()
+        return True
+
+    def _rebalance(self, phase):
+        """Autoscaler actuator: flip ONE replica's role toward the
+        saturated ``phase``. Prefers a pure opposite-role replica, then a
+        non-primary mixed one; set_role's both-phases-coverable invariant
+        (ValueError) is the backstop — a rejected flip reports False and
+        the controller retries after its cooldown."""
+        opposite = "decode" if phase == "prefill" else "prefill"
+        eligible = [r for r in self.replicas
+                    if not r.retired and not r.sick and not r.pending_drain]
+        cands = ([r for r in eligible if r.phase_role == opposite]
+                 + [r for r in eligible
+                    if r.phase_role == "mixed" and r.idx != 0])
+        for rep in cands:
+            was = rep.phase_role
+            try:
+                self.replicas.set_role(rep.idx, phase)
+            except ValueError:
+                continue
+            logger.info(f"autoscaler: re-balanced replica {rep.idx} "
+                        f"{was}->{phase}")
+            self._wake.set()
+            return True
+        return False
+
+    def _set_brownout(self, level):
+        """Autoscaler actuator: move the shedding ladder to ``level``.
+        Level 0 lifts the brownout (parked work resumes, the door reopens).
+        Odd levels EVICT the FairQueue's flows below the level's tier (503
+        + brownout Retry-After) and keep shedding arrivals below the bar at
+        the door; even levels additionally PREEMPT in-flight work below the
+        tier — cancelled outright, or parked for resume through the
+        migrate-out transport when ``brownout_park`` is on and a KV demote
+        tier exists. De-escalation never re-preempts: stepping DOWN from an
+        even level releases parked work."""
+        ctl = self.autoscaler
+        cfg = ctl.config
+        tel = self.telemetry
+        prev = ctl.brownout_level
+        if level <= 0:
+            self._brownout_bar = None
+            self._park_pending.clear()
+            released = self.replicas.release_parked()
+            if released or prev:
+                logger.info(f"autoscaler: brownout lifted "
+                            f"({released} parked request(s) released)")
+            self._wake.set()
+            return True
+        tier = ctl.brownout_tier(level)
+        bar = self._fair.tier_weight(tier)
+        self._brownout_bar = bar
+        escalating = level > prev
+        if not escalating and prev % 2 == 0:
+            # stepping down out of a preemption level: stop preempting and
+            # let parked decode state resume (the calm signal that drove
+            # the de-escalation says there is capacity again)
+            self._park_pending.clear()
+            self.replicas.release_parked()
+        if escalating and level % 2 == 1:
+            # evict the queued backlog below the tier, oldest first; each
+            # evicted row owes its client a 503 + brownout Retry-After
+            retry = str(int(cfg.brownout_retry_after_s))
+            for greq, _tenant, _prio in self._fair.evict_flows(tier):
+                self.stats["shed_503"] += 1
+                self.stats["brownout_evicted"] += 1
+                if tel.enabled:
+                    tel.counter("gateway/shed_503")
+                    tel.counter("autoscale/brownout_evicted")
+                if greq.trace is not None:
+                    greq.trace.instant("brownout_evicted", level=level)
+                self._post(greq, ("failed", 503,
+                                  "brownout: request tier shed under overload",
+                                  [("Retry-After", retry)]))
+        if escalating and level % 2 == 0:
+            # preempt in-flight work below the tier: park when the migrate
+            # transport can hold the KV for resume, else cancel
+            park = bool(cfg.brownout_park) and self.scheduler.kv_tier is not None
+            for greq in list(self._active):
+                if greq.finished or self._fair.tier_weight(greq.priority) >= bar:
+                    continue
+                self.stats["brownout_preempted"] += 1
+                if tel.enabled:
+                    tel.counter("autoscale/brownout_preempted")
+                if park:
+                    self._park_pending.add(greq)
+                else:
+                    greq.cancel_requested = True
+                    greq.cancel_reason = "brownout"
+        logger.info(f"autoscaler: brownout level {prev}->{level} "
+                    f"(shedding below {tier!r})")
+        self._wake.set()
+        return True
+
+    def _park_owned(self, rep):
+        """Park brownout-preempted requests whose decode state ``rep``'s
+        scheduler owns — must run on its pump thread (migrate_out is a
+        scheduler call). Unparkable requests (mid-prefill, already
+        migrating, no demote tier) fall back to cancellation so an even
+        brownout level always sheds the work one way or the other."""
+        for greq in list(self._park_pending):
+            if greq.finished or greq.handle is None:
+                self._park_pending.discard(greq)
+                continue
+            req = greq.handle._req
+            if req.done or req.cancelled or req.migrating:
+                self._park_pending.discard(greq)
+                continue
+            if not rep.scheduler.owns(req):
+                continue  # another replica's pump parks it
+            self._park_pending.discard(greq)
+            if self.replicas.park_out(rep, req) is not None:
+                self.stats["brownout_parked"] += 1
+                if self.telemetry.enabled:
+                    self.telemetry.counter("autoscale/brownout_parked")
+                if greq.trace is not None:
+                    greq.trace.instant("brownout_parked", replica=rep.idx)
+            else:
+                greq.cancel_requested = True
+                greq.cancel_reason = "brownout"
+
     # ------------------------------------------------------------------ admission math
     def _retry_after(self):
         """Advertised backoff, from live state: time for the current backlog
@@ -826,6 +1089,36 @@ class Gateway:
                                       "duration_ms": duration_s * 1e3,
                                       "note": "trace files land when the "
                                               "capture window elapses"})
+        elif method == "GET" and path == "/v1/autoscaler":
+            if self.autoscaler is None:
+                await self._json(writer, 200,
+                                 {"enabled": False,
+                                  "reason": "no continuous_batching.autoscaler "
+                                            "config section"})
+            else:
+                await self._json(writer, 200, self.autoscaler.state())
+        elif method == "POST" and path == "/v1/autoscaler":
+            if self.autoscaler is None:
+                await self._json(writer, 503,
+                                 {"error": {"message": "no autoscaler "
+                                            "configured"}})
+                return
+            try:
+                req = json.loads(body.decode("utf-8") or "{}")
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                await self._json(writer, 400, {"error": {"message": str(e)}})
+                return
+            if not isinstance(req, dict) or \
+                    not set(req) <= {"enabled", "dry_run"}:
+                await self._json(writer, 400,
+                                 {"error": {"message": "body must be a JSON "
+                                            "object with only 'enabled' and/or "
+                                            "'dry_run' keys"}})
+                return
+            changed = self.autoscaler.admin(req)
+            self._wake.set()
+            await self._json(writer, 200,
+                             {"changed": changed, **self.autoscaler.state()})
         elif method == "GET" and path == "/v1/replicas":
             await self._json(writer, 200, {"replicas": self.replicas.states()})
         elif method == "POST" and path.startswith("/v1/replicas/"):
@@ -887,9 +1180,14 @@ class Gateway:
             "scheduler/active_slots": float(sched.cache.active_slots),
             "scheduler/slot_occupancy": float(sched.cache.occupancy()),
             "scheduler/compiled_programs": float(sched.compiled_program_count()),
-            "serving/replicas": float(len(self.replicas)),
+            # elastic fleet: "replicas" is the LIVE (non-retired) count —
+            # a scraped capacity dashboard must not count freed pools
+            "serving/replicas": float(self.replicas.active_count()),
             "serving/replicas_available": float(
                 sum(1 for r in self.replicas if r.available())),
+            "serving/replicas_pending_drain": float(
+                sum(1 for r in self.replicas
+                    if r.pending_drain and not r.retired)),
             "serving/tp_size": float(sched.tp_size),
             "serving/ep_size": float(sched.ep_size),
         }
@@ -926,6 +1224,11 @@ class Gateway:
                     sched.adapters.stats()["resident"]),
                 "serving/adapter_hit_rate": sched.adapters.hit_rate(),
             })
+        if self.autoscaler is not None:
+            out["autoscale/enabled"] = 1.0 if self.autoscaler.enabled else 0.0
+            out["autoscale/brownout_level"] = float(self.autoscaler.brownout_level)
+            for action, n in self.autoscaler.counters.items():
+                out[f"autoscale/decisions_{action}"] = float(n)
         return out
 
     def _metrics(self):
@@ -961,6 +1264,9 @@ class Gateway:
             "expert_store": (sched.experts.stats()
                              if sched.experts is not None else None),
             "replicas": self.replicas.states(),
+            # elastic fleet controller rollup (live detail: /v1/autoscaler)
+            "autoscaler": (self.autoscaler.state()
+                           if self.autoscaler is not None else None),
             # capacity rollup (telemetry/capacity.py): per-compiled-program
             # roofline table + goodput + host-gap totals for the primary
             # scheduler; the live gauges are in the telemetry snapshot
@@ -1099,6 +1405,24 @@ class Gateway:
             await self._json(writer, 400,
                              {"error": {"message": str(e), "type": "invalid_request"}})
             return
+        # brownout door: while the shedding ladder is engaged, arrivals in
+        # priority classes below the bar 503 immediately with the brownout
+        # Retry-After — evicting the backlog once and then re-queueing the
+        # same tier would just rebuild it
+        bar = self._brownout_bar
+        if bar is not None and self._fair.tier_weight(kwargs["priority"]) < bar:
+            self.stats["shed_503"] += 1
+            self.stats["brownout_shed"] += 1
+            if tel.enabled:
+                tel.counter("gateway/shed_503")
+                tel.counter("autoscale/brownout_shed")
+            retry = str(int(self.autoscaler.config.brownout_retry_after_s))
+            await self._json(writer, 503,
+                             {"error": {"message": "brownout: request tier "
+                                        "shed under overload",
+                                        "type": "overloaded"}},
+                             extra=[("Retry-After", retry)])
+            return
         # request identity: accept an inbound W3C traceparent / x-request-id,
         # else mint one; echoed back as x-request-id and used as the span
         # tree's track id when request tracing is on
@@ -1208,10 +1532,13 @@ class Gateway:
                     self._client_gone(greq)
                     return
                 if kind == "failed":
-                    _, status, msg = ev
+                    # optional 4th element: extra response headers (the
+                    # brownout 503 carries its own Retry-After)
+                    status, msg = ev[1], ev[2]
                     if not headers_sent:
                         await self._json(writer, status,
-                                         {"error": {"message": msg}})
+                                         {"error": {"message": msg}},
+                                         extra=list(ev[3]) if len(ev) > 3 else ())
                     return
                 if not headers_sent:
                     headers_sent = True
@@ -1255,8 +1582,9 @@ class Gateway:
                     self._client_gone(greq)
                     return
                 if kind == "failed":
-                    _, status, msg = ev
-                    await self._json(writer, status, {"error": {"message": msg}})
+                    status, msg = ev[1], ev[2]
+                    await self._json(writer, status, {"error": {"message": msg}},
+                                     extra=list(ev[3]) if len(ev) > 3 else ())
                     return
                 if kind == "token":
                     _, tok, reason = ev

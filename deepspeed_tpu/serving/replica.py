@@ -57,6 +57,18 @@ as-is. ``migrate_min_tokens`` colocates short prompts (the handoff round
 trip isn't worth it); a fleet whose decode side vanishes entirely falls
 back to colocating on whatever is left rather than stalling.
 
+**Elastic fleet** (``continuous_batching.autoscaler`` —
+``serving/controller.py`` drives these): :meth:`ReplicaSet.add_replica`
+grows the fleet at runtime over the SAME weight tree and compiled-program
+dict (zero new XLA programs; warmup is pool allocation);
+:meth:`ReplicaSet.begin_scale_down` / :meth:`ReplicaSet.finish_scale_down`
+shrink it two-phase — pending-drain replicas stop counting toward every
+advertised-capacity surface immediately, then retire from their own pump
+thread once idle, releasing their KV pool's HBM;
+:meth:`ReplicaSet.park_out` / :meth:`ReplicaSet.release_parked` implement
+brownout preemption-with-resume over the PR 13 migrate-out transport
+(held handoff records that decode pumps skip until the brownout lifts).
+
 Why replicas (vs one bigger pool): each replica is its own scheduler loop —
 on a pod, its own tensor-sharded device group stepping independently; on
 one host, independent pools whose aggregate KV capacity (and radix
@@ -94,7 +106,7 @@ class _Migration:
     READY records."""
 
     __slots__ = ("req", "key", "kv_len", "version", "entry", "ready",
-                 "src_idx", "t_start")
+                 "src_idx", "t_start", "held")
 
     def __init__(self, req, key, src_idx, t_start):
         self.req = req
@@ -105,6 +117,10 @@ class _Migration:
         self.ready = False
         self.src_idx = src_idx
         self.t_start = t_start
+        # brownout parking (serving/controller.py): a held record is NOT
+        # claimable by decode pumps — release_parked() flips it back into
+        # the normal pull rotation when the brownout lifts
+        self.held = False
 
 
 class _FleetPump:
@@ -139,6 +155,14 @@ class Replica:
         self.draining = False
         self.sick = False
         self.sick_error = None
+        # elastic scale-down lifecycle (serving/controller.py): pending_drain
+        # = the controller is shrinking the fleet through this replica — it
+        # stops counting toward EVERY advertised-capacity surface
+        # (total_slots / phase_slots / Retry-After / metrics) immediately,
+        # not when the drain completes; retired = drained and released (its
+        # pump thread exited, its KV pool freed, its index reusable)
+        self.pending_drain = False
+        self.retired = False
         self.dispatched = 0
         self.tokens = 0
         # disaggregated serving: "prefill" replicas run prefills and hand
@@ -169,8 +193,12 @@ class Replica:
         return self.busy_slots() < self.scheduler.num_slots
 
     def available(self):
-        """Placement-eligible: healthy and accepting new work."""
-        return not self.sick and not self.draining
+        """Placement-eligible: healthy and accepting new work. A
+        pending-drain (or retired) replica is never available — the
+        controller's scale-down must stop it counting toward advertised
+        capacity the moment the decision lands, not when the drain ends."""
+        return not (self.sick or self.draining
+                    or self.pending_drain or self.retired)
 
     def idle(self):
         s = self.scheduler
@@ -220,10 +248,17 @@ class Replica:
                               else 0.9 * self.ema_service_s + 0.1 * service_s)
 
     def state(self):
+        if self.retired:
+            # the KV pool is released: report the terminal record without
+            # touching pool-backed stats
+            return {"idx": self.idx, "status": "retired", "error": None,
+                    "phase_role": self.phase_role,
+                    "dispatched": self.dispatched, "tokens": self.tokens}
         s = self.scheduler
         return {
             "idx": self.idx,
             "status": ("sick" if self.sick else
+                       "pending_drain" if self.pending_drain else
                        "draining" if self.draining else "active"),
             "error": self.sick_error,
             # disaggregated serving: this replica's phase role and how many
@@ -363,8 +398,11 @@ class ReplicaSet:
                    if r.available() and want(r))
 
     def disaggregated(self):
-        """Any non-mixed role in the fleet (phase-aware paths switch on)."""
-        return any(r.phase_role != "mixed" for r in self.replicas)
+        """Any non-mixed role among LIVE replicas (phase-aware paths switch
+        on). A retired replica's stale role must not pin the fleet into
+        phase-aware math after elastic scale-down removed the split."""
+        return any(r.phase_role != "mixed" for r in self.replicas
+                   if not r.retired)
 
     def any_capacity(self):
         """A fresh prompt can be placed right now: an available
@@ -374,10 +412,14 @@ class ReplicaSet:
                    for r in self.replicas)
 
     def healthy(self):
-        return [r for r in self.replicas if not r.sick]
+        """Replicas that could serve (not sick, not retired) — retired
+        slots are index placeholders, not failover capacity."""
+        return [r for r in self.replicas if not r.sick and not r.retired]
 
     def all_sick(self):
-        return all(r.sick for r in self.replicas)
+        """No live replica left: every non-retired replica is sick (a
+        retired slot must not read as a healthy survivor)."""
+        return all(r.sick or r.retired for r in self.replicas)
 
     def compiled_program_count(self):
         """One shared program set — the fleet's compile count IS the
@@ -431,6 +473,153 @@ class ReplicaSet:
         for key in [k for k, v in self._sticky.items() if v == idx]:
             del self._sticky[key]
 
+    # ---------------------------------------------------------------- elastic fleet
+    # (serving/controller.py drives these through cooldown-guarded
+    # transitions; the gateway owns pump-thread lifecycle)
+    def add_replica(self, phase_role="mixed"):
+        """Grow the fleet by one scheduler sharing the primary's weight
+        tree AND compiled-program dict — same shapes, same programs, ZERO
+        new XLA compiles (the O(1)-programs invariant the gateway's
+        recompile watch guards), so scale-up warmup is just pool
+        allocation. Reuses a retired replica's index when one exists
+        (indices stay dense for /v1/replicas); otherwise appends. The
+        caller owns starting a pump thread: ``on_replica_added`` fires
+        with the new replica after it is routable."""
+        from ..inference.scheduler import DecodeScheduler
+        primary = self.primary
+        sched = DecodeScheduler(primary.engine, compiled_cache=primary._compiled,
+                                **primary._init_kwargs)
+        if self._hooks_installed:
+            # a disaggregated fleet's migrate hook consults CURRENT roles
+            # per prefill completion, so installing it on a mixed newcomer
+            # is inert until someone flips its role
+            sched.migrate_hook = self._maybe_migrate
+        with self._lock:
+            slot = next((i for i, r in enumerate(self.replicas) if r.retired),
+                        None)
+            idx = slot if slot is not None else len(self.replicas)
+            rep = Replica(idx, sched, phase_role=phase_role)
+            if slot is None:
+                self.replicas.append(rep)
+            else:
+                self.replicas[slot] = rep
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/replica_added")
+        cb = self.on_replica_added
+        if cb is not None:
+            cb(rep)
+        return rep
+
+    def begin_scale_down(self, idx):
+        """Two-phase scale-down, phase 1 (any thread): mark replica ``idx``
+        pending-drain — no further placement, EXCLUDED from every
+        advertised-capacity surface immediately (a draining replica that
+        still counted toward slots would understate Retry-After for the
+        whole drain) — and purge its sticky entries so its prompt families
+        re-home. Phase 2 (:meth:`finish_scale_down`) retires it from its
+        own pump thread once idle. Replica 0 never scales down: it owns
+        the shared compiled-program cache and the fleet-wide pump duties."""
+        if idx == 0:
+            raise ValueError("replica 0 cannot scale down (it owns the shared "
+                             "compiled-program cache and the primary pump)")
+        with self._lock:
+            rep = self.replicas[idx]
+            if rep.retired or rep.pending_drain:
+                return rep.state()
+            rep.pending_drain = True
+            rep.draining = True
+            self._purge_sticky(idx)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/replica_drains")
+        return rep.state()
+
+    def finish_scale_down(self, rep):
+        """Two-phase scale-down, phase 2 (``rep``'s OWN pump thread, once
+        its in-flight work finished): retire the replica and drop its KV
+        pool tree — the device buffers backing its slots are the HBM the
+        scale-down exists to reclaim. Returns True when the replica
+        retired (its pump thread should exit)."""
+        if not rep.pending_drain or rep.retired or not rep.idle():
+            return False
+        with self._lock:
+            if rep.retired:
+                return False
+            rep.retired = True
+        # the scheduler never steps again: releasing the pool frees the
+        # dominant HBM cost of the replica (shared stores — prefix tier,
+        # adapters, experts — are fleet-global and stay)
+        rep.scheduler.cache.pool = None
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/replica_retired")
+            tel.gauge(f"serving/replica/{rep.idx}/slot_occupancy", 0.0)
+        return True
+
+    def active_count(self):
+        """Fleet size as capacity planning sees it (retired slots are
+        index placeholders, not replicas)."""
+        return sum(1 for r in self.replicas if not r.retired)
+
+    def park_out(self, rep, req):
+        """Brownout preemption WITH resume: demote ``req``'s whole KV
+        through the migration transport (PR 13's migrate-out path) and
+        HOLD the parked record — decode pumps skip held records — until
+        :meth:`release_parked` re-admits it when the brownout lifts. Must
+        run on ``rep``'s own pump thread (migrate_out touches its pool).
+        Returns the record, or None when the request isn't parkable (no
+        transport, not decoding here, mid-prefill, already terminal)."""
+        sched = rep.scheduler
+        if sched.kv_tier is None or req.done or req.cancelled or req.migrating:
+            return None
+        if req.slot is None or sched.active.get(req.slot) is not req:
+            return None
+        if sched._prefill is not None and sched._prefill.req is req:
+            return None
+        with self._lock:
+            self._mig_id += 1
+            mig_id = self._mig_id
+        ns = (sched.adapters.namespace(req.adapter_ref.uid)
+              if req.adapter_ref is not None else ())
+        key = tuple(ns) + (_MIG_SENTINEL, mig_id)
+        record = _Migration(req, key, rep.idx, time.monotonic())
+        record.version = int(sched.cache.weights_version)
+        record.held = True
+
+        def on_ready(entry):
+            record.entry = entry
+            record.ready = True
+            cb = self.on_migration_ready
+            if cb is not None:
+                cb()
+        record.kv_len = sched.migrate_out(req, key, on_ready)
+        if req.handle is not None:
+            req.handle._sched = self._pump_proxy
+        with self._lock:
+            self._migrations.append(record)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/parked")
+        return record
+
+    def release_parked(self):
+        """Lift the brownout hold: every held record re-enters the normal
+        pull rotation, so decode-capable pumps adopt and resume them
+        bit-identically (sampling seeds fold absolute step indices; the
+        KV rows moved byte-exact). Returns the number released."""
+        released = 0
+        with self._lock:
+            for rec in self._migrations:
+                if rec.held:
+                    rec.held = False
+                    released += 1
+        if released:
+            cb = self.on_migration_ready
+            if cb is not None:
+                cb()
+        return released
+
     # ---------------------------------------------------------------- phase roles
     def set_role(self, idx, role):
         """Assign replica ``idx`` a phase role (config seeding and the
@@ -442,14 +631,18 @@ class ReplicaSet:
         if role not in _PHASE_ROLES:
             raise ValueError(f"phase_role must be one of {_PHASE_ROLES}, got {role!r}")
         rep = self.replicas[idx]
+        if rep.retired:
+            raise ValueError(f"replica {idx} is retired (scaled down); "
+                             f"add_replica() reuses its index")
         if role != "mixed" and self.primary.kv_tier is None:
             raise ValueError(
                 "phase roles need the hierarchical-KV prefix store as the "
                 "migration transport: enable continuous_batching.disaggregation "
                 "(or hierarchical_kv) so the fleet shares a GlobalPrefixStore")
         prev, rep.phase_role = rep.phase_role, role
-        if not (any(r.prefill_capable() for r in self.replicas)
-                and any(r.decode_capable() for r in self.replicas)):
+        if not (any(r.prefill_capable() for r in self.replicas if not r.retired)
+                and any(r.decode_capable() for r in self.replicas
+                        if not r.retired)):
             rep.phase_role = prev
             raise ValueError(
                 f"role {role!r} on replica {idx} would leave the fleet with no "
@@ -544,6 +737,9 @@ class ReplicaSet:
     # gateway wakeup for parked decode pumps (set by Gateway; None = polling
     # direct-drive callers)
     on_migration_ready = None
+    # gateway hook: a freshly added replica needs a pump thread (set by
+    # Gateway; None = direct-drive callers, whose pump_once covers it)
+    on_replica_added = None
 
     def pending_migrations(self):
         return len(self._migrations)
@@ -577,7 +773,10 @@ class ReplicaSet:
                         record, settle = rec, True
                         del self._migrations[i]
                         break
-                    if rec.ready and can_admit and not rec.req.cancelled:
+                    # held records (brownout parking) settle above but are
+                    # never adopted until release_parked() lifts the hold
+                    if (rec.ready and can_admit and not rec.req.cancelled
+                            and not rec.held):
                         record = rec
                         del self._migrations[i]
                         break
@@ -716,10 +915,14 @@ class ReplicaSet:
         calls per turn, one replica each)."""
         progressed = False
         for rep in self.replicas:
+            if rep.retired:
+                continue
             if self.admit_migrations(rep):
                 progressed = True
             if not rep.idle() and not rep.sick:
                 rep.step()
+                progressed = True
+            elif rep.pending_drain and self.finish_scale_down(rep):
                 progressed = True
         return progressed
 
@@ -741,6 +944,13 @@ class ReplicaSet:
                     tier = rep.scheduler.kv_tier
                     if tier is not None:
                         tier.executor.drain_fetches()
+                continue
+            if all(rec.held for rec in list(self._migrations)):
+                # only brownout-parked records remain and this is a
+                # direct-drive pump with no controller to lift the hold:
+                # release rather than spin (the gateway path releases
+                # explicitly on de-escalation and on begin_drain)
+                self.release_parked()
                 continue
             if not any(r.available() for r in self.replicas):
                 self._fail_handoffs()

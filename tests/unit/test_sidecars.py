@@ -50,6 +50,72 @@ def test_elastic_batch_overrides_config():
     assert cfg.train_batch_size % (cfg.train_micro_batch_size_per_gpu * 4) == 0
 
 
+class _Telemetry:
+    enabled = True
+
+    def __init__(self):
+        self.counters = {}
+        self.events = []
+
+    def counter(self, name, n=1):
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def event(self, name, payload=None):
+        self.events.append((name, payload))
+
+
+def test_elastic_manager_plan_tiling():
+    from deepspeed_tpu.elasticity import ElasticityManager, ElasticityConfigError
+    mgr = ElasticityManager(elastic_dict())
+    plan = mgr.plan(4)
+    assert plan.world_size == 4 and plan.data_parallel == 4
+    assert plan.train_batch == plan.micro_batch * plan.grad_accum * plan.data_parallel
+    assert 4 in plan.compatible_worlds
+    assert plan.as_dict()["train_batch"] == plan.train_batch
+    # v0.2 with model parallelism: dp is the world divided by the mp degree
+    mgr2 = ElasticityManager(elastic_dict(version=0.2, model_parallel_size=2))
+    plan2 = mgr2.plan(8)
+    assert plan2.data_parallel == 4
+    assert plan2.train_batch == plan2.micro_batch * plan2.grad_accum * plan2.data_parallel
+    # disabled / absent elasticity section is a hard config error
+    with pytest.raises(ElasticityConfigError):
+        ElasticityManager({"elasticity": {"enabled": False}})
+    with pytest.raises(ElasticityConfigError):
+        ElasticityManager({})
+
+
+def test_elastic_manager_restore_noop_and_resize():
+    from deepspeed_tpu.elasticity import ElasticityManager
+    mgr = ElasticityManager(elastic_dict())
+    # same world, or a checkpoint from before the stamp: nothing resized
+    assert mgr.on_restore(4, {"world_size": 4}) is None
+    assert mgr.on_restore(4, {}) is None
+    assert mgr.on_restore(4, None) is None
+    # world changed: the new plan re-tiles the SAME effective batch
+    old = mgr.plan(2)
+    tel = _Telemetry()
+    plan = mgr.on_restore(4, {"world_size": 2, "ds_config": elastic_dict()},
+                          telemetry=tel)
+    assert plan is not None and plan.world_size == 4
+    assert plan.train_batch == old.train_batch  # the invariant
+    assert tel.counters.get("elasticity/resizes") == 1
+    assert [e for e in tel.events if e[0] == "elasticity/resize"]
+
+
+def test_elastic_manager_restore_rejects_drifted_config():
+    from deepspeed_tpu.elasticity import (ElasticityManager, ElasticityConfigError,
+                                          ElasticityIncompatibleWorldSize)
+    mgr = ElasticityManager(elastic_dict())
+    worlds = mgr.plan(4).compatible_worlds
+    # saved world outside today's compatible set: section changed shape
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        mgr.on_restore(4, {"world_size": worlds[-1] + 7919})
+    # saved config solves a different effective batch: loss curve would bend
+    with pytest.raises(ElasticityConfigError):
+        mgr.on_restore(4, {"world_size": 2,
+                           "ds_config": elastic_dict(max_train_batch_size=97)})
+
+
 # ---------------------------------------------------------------- curriculum
 def test_curriculum_schedules():
     from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler

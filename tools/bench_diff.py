@@ -52,12 +52,17 @@ import sys
 # marks mid-stream compile counts (new_programs_mid_stream must stay 0);
 # tokens_per_sec / resident_fraction / *_over_* ratios keep the
 # higher-is-better default.
+# autoscale leg notes: "preempted"/"resize" mark brownout preemptions and
+# elastic fleet churn (more preempted in-flight work or more resizes at
+# the same stream = a twitchier controller); "shed"/"programs" already
+# ride their tokens, and ttft_p95_static_over_autoscaled keeps the
+# higher-is-better ratio default.
 _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
                  "shed", "evict", "evictions", "evicts", "miss", "misses",
                  "s", "seconds", "loss", "ppl", "perplexity", "spill",
                  "spills", "dropped", "swaps", "degradation", "pending",
                  "failed", "loads", "replays", "programs", "gap",
-                 "ttft", "itl"}
+                 "ttft", "itl", "preempted", "resize", "resizes"}
 # long_context leg notes: "ttft"/"itl" read lower-is-better on their own so
 # ms-less variants (ttft_p50, itl_p95) resolve too; new_programs_after_first_ctx
 # rides "programs" (a length mix that compiles mid-stream is the regression);

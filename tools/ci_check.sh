@@ -2,7 +2,7 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane + fusedblock lane + longctx lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane + fusedblock lane + longctx lane + autoscale lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
@@ -16,6 +16,7 @@
 #   tools/ci_check.sh --capacity # serving capacity/roofline + profiling lane only
 #   tools/ci_check.sh --fusedblock # fused llama-family decode-block lane only
 #   tools/ci_check.sh --longctx  # long-context serving (multi-extent KV + seq-parallel prefill) lane only
+#   tools/ci_check.sh --autoscale # elastic fleet control plane (autoscaler/brownout/elastic resize) lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -220,6 +221,29 @@ capacity_lane() {
     tests/unit/test_profiling.py -q -p no:cacheprovider
 }
 
+autoscale_lane() {
+  echo "== elastic fleet (autoscale) lane =="
+  # elastic fleet control-plane guards, run UNFILTERED (the lifecycle
+  # bit-identity nodeids live in slow_tests.txt to keep tier-1 in budget):
+  # the FleetController decision ladder against scripted signal traces
+  # (multi-window burn, host-gap veto, cooldowns, goodput-priced brownout
+  # escalation/de-escalation, rebalance skew), mid-stream add_replica
+  # BIT-identical with ZERO new XLA programs (jax.monitoring), the full
+  # grow -> park -> two-phase shrink -> role-flip cycle bit-identical to a
+  # never-resized run, fair-queue tier eviction, the gateway brownout
+  # door (503 + Retry-After below the bar) and /v1/autoscaler admin
+  # surface, plus the training-side ElasticityManager resize-plan/restore
+  # validation. The matching perf leg is `python bench.py serving`
+  # ("autoscale" entry: ramp/spike/decay controller on-vs-off,
+  # BENCH_SERVING_AUTOSCALE knob).
+  timeout -k 10 600 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/serving/test_controller.py \
+    "tests/unit/test_sidecars.py::test_elastic_manager_plan_tiling" \
+    "tests/unit/test_sidecars.py::test_elastic_manager_restore_noop_and_resize" \
+    "tests/unit/test_sidecars.py::test_elastic_manager_restore_rejects_drifted_config" \
+    -q -p no:cacheprovider
+}
+
 bench_diff() {
   echo "== bench diff (advisory) =="
   # diff the given fresh bench JSON (or the latest committed round) against
@@ -299,6 +323,10 @@ if [ "${1:-}" = "--fusedblock" ]; then
   fusedblock_lane
   exit $?
 fi
+if [ "${1:-}" = "--autoscale" ]; then
+  autoscale_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -353,7 +381,10 @@ fb_rc=$?
 longctx_lane
 lc_rc=$?
 
+autoscale_lane
+as_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ] && [ "$fb_rc" -eq 0 ] && [ "$lc_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ] && [ "$fb_rc" -eq 0 ] && [ "$lc_rc" -eq 0 ] && [ "$as_rc" -eq 0 ]
