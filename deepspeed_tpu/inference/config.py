@@ -98,6 +98,54 @@ class DisaggregationConfig(DeepSpeedConfigModel):
                                      "prompts); 0 migrates everything")
 
 
+class MultihostConfig(DeepSpeedConfigModel):
+    """Multi-host serving (``serving/router.py``): this process joins a
+    cross-process worker fleet behind a router tier. The worker registers
+    with the router, heartbeats the gateway's capacity signals (the same
+    dict the local Retry-After reads), and swaps its KV-tier store for a
+    networked shard (``memory/net_store.py``) so cross-HOST prefix restore
+    and prefill->decode handoff work exactly like their cross-replica
+    versions — weights-version stamps and the pinned-entry protocol stay
+    the consistency contract. ``python -m deepspeed_tpu.serving --worker``
+    sets these from flags. See ``benchmarks/SERVING.md`` ("Multi-host
+    serving")."""
+
+    router_url = ConfigField(default=None, help="router base URL (e.g. "
+                             "http://10.0.0.1:8800); None = standalone "
+                             "single-process serving (everything off)")
+    worker_id = ConfigField(default=None, help="stable fleet-unique worker id; "
+                            "default w<pid>. Re-registering an id tells the "
+                            "router the process RESTARTED (its shard is empty), "
+                            "so keep ids stable across restarts, unique across "
+                            "live workers")
+    worker_role = ConfigField(default="mixed", help="process-level phase role "
+                              "(prefill/decode/mixed): 'prefill' workers hand "
+                              "finished prefills to decode workers through the "
+                              "networked shard; conflicts with in-process "
+                              "disaggregation roles — pick ONE phase split")
+    heartbeat_interval_s = ConfigField(default=2.0, help="capacity-signal "
+                                       "heartbeat cadence (owner-side lease "
+                                       "reaping rides the same timer)")
+    heartbeat_timeout_s = ConfigField(default=10.0, help="router-side: a worker "
+                                      "silent this long stops receiving "
+                                      "placements (marked sick) until it "
+                                      "heartbeats again")
+    lease_s = ConfigField(default=30.0, help="handoff claim deadline: a parked "
+                          "cross-process handoff nobody resumed within this "
+                          "window is reclaimed (owner frees the pinned entry, "
+                          "router drops the directory record)")
+    net_timeout_s = ConfigField(default=30.0, help="per-call timeout for "
+                                "worker<->router control traffic and "
+                                "worker<->worker KV fetches")
+    advertise_host = ConfigField(default=None, help="host other processes dial "
+                                 "to reach this worker; default = the gateway "
+                                 "bind host (set this when binding 0.0.0.0)")
+    migrate_min_tokens = ConfigField(default=0, help="colocate threshold for "
+                                     "cross-process handoff, same semantics as "
+                                     "disaggregation.migrate_min_tokens but the "
+                                     "round trip now crosses hosts")
+
+
 class ExpertOffloadConfig(DeepSpeedConfigModel):
     """Cold-expert host offload (``deepspeed_tpu/moe/expert_store.py``):
     MoE expert kernels leave the device param tree at engine build and page
@@ -343,6 +391,12 @@ class ContinuousBatchingConfig(DeepSpeedConfigModel):
         help="disaggregated prefill/decode: phase-specialized replicas with "
         "KV migration over the hierarchical-KV transport "
         "(serving/replica.py; see benchmarks/SERVING.md)")
+    multihost = ConfigField(
+        default=MultihostConfig,
+        help="multi-host serving: join a cross-process worker fleet behind "
+        "a router tier, with a networked prefix/handoff store "
+        "(serving/router.py + memory/net_store.py; see "
+        "benchmarks/SERVING.md)")
     autoscaler = ConfigField(
         default=AutoscalerConfig,
         help="elastic fleet control plane: SLO-driven replica autoscaling, "

@@ -171,7 +171,11 @@ def _resolve_hosts(args):
     return hosts
 
 
-_ENV_PASSTHROUGH = ("PYTHONPATH", "JAX_PLATFORMS", "DSTPU_LOG_LEVEL")
+# XLA_FLAGS rides along for CPU-hosted fleets (forced host device counts —
+# the multi-host serving smoke path spawns workers with
+# --xla_force_host_platform_device_count and the workers must see it)
+_ENV_PASSTHROUGH = ("PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS",
+                    "DSTPU_LOG_LEVEL")
 
 
 def run_elastic(args):

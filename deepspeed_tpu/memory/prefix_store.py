@@ -466,6 +466,16 @@ class GlobalPrefixStore:
             self._gauge()
 
     # ------------------------------------------------------------------ introspection
+    def get_exact(self, tokens):
+        """The exact-key entry, or None. Touches LRU recency (the caller is
+        about to read it — ``memory/net_store.py``'s owner-side fetch
+        endpoint serves remote restores through this)."""
+        with self._lock:
+            e = self._by_key.get(tuple(int(t) for t in tokens))
+            if e is not None:
+                self._touch(e)
+            return e
+
     def contains_exact(self, tokens, origin=None):
         """Exact-key registration check (the tier invariant: a scheduler
         never holds a prefix on device while ITS OWN demoted copy of the

@@ -14,3 +14,4 @@ from .controller import FleetController, FleetSignals  # noqa: F401
 from .fair_queue import FairQueue, QueueFull  # noqa: F401
 from .replica import Replica, ReplicaSet  # noqa: F401
 from .gateway import Gateway  # noqa: F401
+from .router import Router, WorkerAgent  # noqa: F401

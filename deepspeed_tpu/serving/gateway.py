@@ -105,6 +105,7 @@ from ..telemetry import (DEFAULT_SERVING_OBJECTIVES, RequestTrace, SLOEngine,
                          extract_trace_context)
 from ..telemetry import prometheus as prom
 from ..utils.logging import logger
+from . import capacity_math
 from .controller import FleetController, FleetSignals
 from .fair_queue import FairQueue, QueueFull
 from .replica import ReplicaSet
@@ -125,11 +126,12 @@ class _GatewayRequest:
                  "cost", "deadline", "stream", "loop", "events", "handle",
                  "cancel_requested", "cancel_reason", "finished", "enq_ts",
                  "admit_ts", "n_tokens", "trace", "trace_id", "replica",
-                 "adapter_id")
+                 "adapter_id", "return_logits", "resume")
 
     def __init__(self, rid, prompt, *, max_new_tokens, eos_token_id, do_sample,
                  temperature, top_k, top_p, seed, tenant, priority, deadline,
-                 stream, loop, trace=None, trace_id=None, adapter_id=None):
+                 stream, loop, trace=None, trace_id=None, adapter_id=None,
+                 return_logits=False, resume=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -157,6 +159,14 @@ class _GatewayRequest:
         self.trace_id = trace_id    # request identity echoed as x-request-id
         self.replica = None         # serving replica this request landed on
         self.adapter_id = adapter_id  # model variant (multi-LoRA serving)
+        # unary responses can carry per-step logits (the multihost
+        # bit-identity surface: logits must round-trip process boundaries)
+        self.return_logits = return_logits
+        # cross-process migration resume: the handoff descriptor a router
+        # POSTed after a prefill worker handed this request off (None for
+        # ordinary arrivals — resume requests bypass the fair queue and go
+        # straight to the fleet's migration admission)
+        self.resume = resume
 
 
 class Gateway:
@@ -206,7 +216,10 @@ class Gateway:
                       "shed_503": 0, "deadline_expired": 0, "disconnects": 0,
                       "rejected": 0, "brownout_shed": 0, "brownout_evicted": 0,
                       "brownout_preempted": 0, "brownout_parked": 0,
-                      "replicas_added": 0, "replicas_retired": 0}
+                      "replicas_added": 0, "replicas_retired": 0,
+                      # multi-host serving: requests handed off to another
+                      # process (prefill side) / adopted from one (decode)
+                      "handoffs_out": 0, "resumed_in": 0}
         self.host = config.host
         self.port = None  # bound port (after start)
         self.ready = False
@@ -282,6 +295,14 @@ class Gateway:
         self._brownout_bar = None   # weight bar arrivals shed under (None=off)
         self._park_pending = set()  # greqs awaiting park-out on their owning pump
         self._gap_mark = None       # (now, fleet host-gap total) delta basis
+        # multi-host serving (serving/router.py): the WorkerAgent attaches a
+        # NetPrefixStore here so /v1/store/fetch can serve this shard's KV
+        # bytes to remote restores; None on single-process gateways
+        self.net_store = None
+        # POST /v1/debug/flush_radix: replica idxs whose pump must evict the
+        # whole radix trie through the tier next turn (multihost tests force
+        # cross-host demotion with it)
+        self._flush_radix_pending = set()
 
     # ------------------------------------------------------------------ lifecycle
     def start_background(self, timeout=120.0):
@@ -445,6 +466,10 @@ class Gateway:
                     # brownout park-for-resume: only the owning pump may
                     # call migrate_out on its scheduler
                     self._park_owned(rep)
+                if rep.idx in self._flush_radix_pending:
+                    # debug-forced demotion: only this pump may touch its
+                    # scheduler's radix trie
+                    self._flush_radix(rep)
                 if not rep.idle() and not rep.sick:
                     rep.step()
             except Exception:  # noqa: BLE001 — fail requests, not the server
@@ -579,6 +604,7 @@ class Gateway:
                     eos_token_id=greq.eos_token_id, do_sample=greq.do_sample,
                     temperature=greq.temperature, top_k=greq.top_k,
                     top_p=greq.top_p, seed=greq.seed,
+                    collect_logits=True if greq.return_logits else None,
                     on_token=self._make_on_token(greq), trace=greq.trace,
                     adapter_id=greq.adapter_id)
             except ValueError as e:
@@ -932,42 +958,106 @@ class Gateway:
                 greq.cancel_requested = True
                 greq.cancel_reason = "brownout"
 
+    def _flush_radix(self, rep):
+        """Evict ``rep``'s whole radix trie through the KV tier (each
+        eviction demotes to the prefix store — with a NetPrefixStore
+        attached that makes every cached prefix directory-visible), then
+        join the async demote fetches so the entries are probe-visible
+        before the debug endpoint answers. Runs on ``rep``'s own pump."""
+        sched = rep.scheduler
+        try:
+            if sched.radix is not None:
+                while True:
+                    victim = sched.radix.evict_lru()
+                    if victim is None:
+                        break
+                    sched.cache.reclaim(victim)
+            if sched.kv_tier is not None:
+                sched.kv_tier.executor.drain_fetches()
+        finally:
+            self._flush_radix_pending.discard(rep.idx)
+
+    # ------------------------------------------------------------------ multi-host handoff
+    def _handoff_complete(self, req, desc):
+        """A cross-process prefill->decode handoff's demote landed (called
+        from the KV transfer thread by the WorkerAgent's migrate hook):
+        finish the gateway request with a terminal ``("handoff", desc)``
+        event — the response carries the descriptor instead of further
+        tokens, and the ROUTER resumes the request on a decode worker.
+        Not a completion (no EMA fold, no completed count): the request's
+        life continues in another process. Returns False when no in-flight
+        gateway request owns ``req`` (direct-drive caller)."""
+        for greq in list(self._active):
+            if greq.handle is not None and greq.handle._req is req:
+                self.stats["handoffs_out"] += 1
+                self._finish(greq, ("handoff", desc))
+                self._wake.set()
+                return True
+        return False
+
+    def _admit_resume(self, greq):
+        """Admit a router-POSTed resume request (event-loop thread): bypass
+        the fair queue — the request was already admitted fleet-wide by the
+        prefill worker — and park it in the ReplicaSet's migration queue as
+        a READY record whose entry points at the remote shard. The normal
+        ``admit_migrations`` pull then restores it bit-identically."""
+        try:
+            handle = self.replicas.inject_resume(
+                greq.resume, on_token=self._make_on_token(greq),
+                trace=greq.trace, collect_logits=greq.return_logits)
+        except (ValueError, KeyError, TypeError) as e:
+            self.stats["rejected"] += 1
+            self._post(greq, ("failed", 400, f"bad resume descriptor: {e}"))
+            return
+        greq.handle = handle
+        greq.admit_ts = time.monotonic()
+        self.stats["resumed_in"] += 1
+        self._active.add(greq)
+        if self.telemetry.enabled:
+            self.telemetry.gauge("gateway/active_requests", len(self._active))
+        self._wake.set()
+
     # ------------------------------------------------------------------ admission math
-    def _retry_after(self):
-        """Advertised backoff, from live state: time for the current backlog
-        to drain through the FLEET's slot pools at the measured per-request
-        service time (EMA). Floor 1s; capped; integer seconds per RFC 9110.
-
-        Phase-aware under disaggregation: a new request needs a PREFILL
-        slot first and a DECODE slot after, and the two pools are disjoint
-        — so the estimate is the WORSE of (queued work / prefill capacity)
-        and (in-flight + parked-handoff work / decode capacity), not the
-        blended depth over the blended fleet (which under-advertises
-        exactly when one phase is the bottleneck)."""
-        ema = self._ema_service_s
-        cap = int(self.config.retry_after_cap_s)
-
-        def est(depth, slots):
-            if ema is None:
-                return 1 + depth // max(1, slots)
-            return (depth + 1) * ema / max(1, slots)
-
-        if self.replicas.disaggregated():
-            pre_depth = (len(self._fair)
-                         + sum(len(r.scheduler.queue) for r in self.replicas
-                               if r.prefill_capable()))
+    def capacity_signals(self):
+        """Live capacity-signals dict (``serving/capacity_math.py`` shape):
+        the single source both the local Retry-After and the multi-host
+        router's fleet-wide merge read. Backlog sums count AVAILABLE
+        replicas only — a drained or pending-drain replica's queue is
+        already excluded from ``total_slots``/``phase_slots``, and counting
+        its backlog against capacity it no longer advertises would inflate
+        the estimate for the whole drain."""
+        reps = self.replicas
+        sched_backlog = sum(len(r.scheduler.queue) for r in reps
+                            if r.available())
+        prefill_backlog = sum(len(r.scheduler.queue) for r in reps
+                              if r.available() and r.prefill_capable())
+        return {
+            "queued": len(self._fair),
             # _active already covers parked handoffs (their handles are
             # not done) and soon-to-decode prefills — adding
             # pending_migrations() on top would double-count each parked
             # request and over-advertise the backoff
-            dec_depth = len(self._active)
-            val = max(est(pre_depth, self.replicas.phase_slots("prefill")),
-                      est(dec_depth, self.replicas.phase_slots("decode")))
-        else:
-            depth = (len(self._fair) + len(self._active)
-                     + sum(len(r.scheduler.queue) for r in self.replicas))
-            val = est(depth, self.replicas.total_slots())
-        return max(1, min(cap, int(val + 0.999)))
+            "inflight": len(self._active),
+            "sched_backlog": sched_backlog,
+            "prefill_backlog": prefill_backlog,
+            "total_slots": reps.total_slots(),
+            "prefill_slots": reps.phase_slots("prefill"),
+            "decode_slots": reps.phase_slots("decode"),
+            "ema_service_s": self._ema_service_s,
+            "disaggregated": reps.disaggregated(),
+        }
+
+    def _retry_after(self):
+        """Advertised backoff, from live state: time for the current backlog
+        to drain through the FLEET's slot pools at the measured per-request
+        service time (EMA). Floor 1s; capped; integer seconds per RFC 9110.
+        The math lives in ``serving/capacity_math.py`` so the multi-host
+        router computes fleet-wide backoff with the SAME formula over
+        merged per-worker signals (phase-aware under disaggregation: the
+        estimate is the WORSE of queued-work/prefill-capacity and
+        in-flight/decode-capacity, not the blended depth)."""
+        return capacity_math.estimate_retry_after(
+            self.capacity_signals(), self.config.retry_after_cap_s)
 
     def _next_rid(self):
         with self._rid_lock:
@@ -1119,6 +1209,52 @@ class Gateway:
             self._wake.set()
             await self._json(writer, 200,
                              {"changed": changed, **self.autoscaler.state()})
+        elif method == "POST" and path == "/v1/store/fetch":
+            # multi-host prefix/handoff store: serve THIS shard's KV bytes
+            # to a remote restore (memory/net_store.py's wire format: one
+            # meta JSON line + concatenated raw leaf bytes). Runs in an
+            # executor thread — the pop may do an NVMe load, and the event
+            # loop must keep serving heartbeats meanwhile.
+            if self.net_store is None:
+                await self._json(writer, 404,
+                                 {"error": {"message": "no networked store "
+                                            "attached (worker mode only)"}})
+                return
+            try:
+                req = json.loads(body.decode("utf-8") or "{}")
+                key = tuple(int(t) for t in req["key"])
+                consume = bool(req.get("consume", True))
+            except (ValueError, KeyError, TypeError, UnicodeDecodeError) as e:
+                await self._json(writer, 400, {"error": {"message": str(e)}})
+                return
+            loop = asyncio.get_running_loop()
+            out = await loop.run_in_executor(
+                None, lambda: self.net_store.serve_fetch(key, consume=consume))
+            if out is None:
+                await self._json(writer, 404,
+                                 {"error": {"message": "entry not resident "
+                                            "(claimed, reaped, or evicted)"}})
+                return
+            payload, blob = out
+            writer.write(self._head(200, "application/octet-stream",
+                                    length=len(payload) + len(blob)))
+            writer.write(payload)
+            writer.write(blob)
+            await writer.drain()
+        elif method == "POST" and path == "/v1/debug/flush_radix":
+            # force-demote every replica's radix trie through the KV tier
+            # (multihost tests drive cross-host prefix restore with this);
+            # each pump flushes its own scheduler, the endpoint waits
+            self._flush_radix_pending |= {
+                r.idx for r in self.replicas
+                if not r.retired and r.scheduler.radix is not None}
+            self._wake.set()
+            for _ in range(600):
+                if not self._flush_radix_pending:
+                    break
+                await asyncio.sleep(0.05)
+            await self._json(writer, 200,
+                             {"flushed": not self._flush_radix_pending})
         elif method == "GET" and path == "/v1/replicas":
             await self._json(writer, 200, {"replicas": self.replicas.states()})
         elif method == "POST" and path.startswith("/v1/replicas/"):
@@ -1289,6 +1425,11 @@ class Gateway:
                 "failed": self.replicas.migrations_failed,
                 "migrate_min_tokens": self.replicas.migrate_min_tokens,
             } if self.replicas.disaggregated() else None),
+            # multi-host serving: the networked shard's traffic counters
+            # (net_bytes_{in,out}, remote_restores, leases_expired, ...) —
+            # present only when a WorkerAgent attached a NetPrefixStore
+            "net_store": (self.net_store.stats()
+                          if self.net_store is not None else None),
             "telemetry": self.telemetry.snapshot(),
         }
 
@@ -1302,6 +1443,27 @@ class Gateway:
             raise ValueError(f"body is not valid JSON: {e}")
         if not isinstance(req, dict):
             raise ValueError("body must be a JSON object")
+        resume = req.get("resume")
+        if resume is not None:
+            # cross-process migration resume (router -> decode worker): the
+            # descriptor IS the request — prompt/sampling params travel in
+            # it so the resumed decode is bit-identical to the in-process
+            # continuation it replaces
+            if not isinstance(resume, dict):
+                raise ValueError("'resume' must be a handoff descriptor object")
+            for field in ("key", "kv_len", "version", "owner_url", "prompt",
+                          "max_new_tokens"):
+                if field not in resume:
+                    raise ValueError(f"resume descriptor missing {field!r}")
+            req = dict(req, prompt=resume["prompt"],
+                       max_tokens=int(resume["max_new_tokens"]),
+                       eos_token_id=resume.get("eos_token_id"),
+                       do_sample=resume.get("do_sample", False),
+                       temperature=resume.get("temperature", 0.0),
+                       top_k=resume.get("top_k", 0),
+                       top_p=resume.get("top_p", 1.0),
+                       seed=resume.get("seed", 0),
+                       adapter_id=resume.get("adapter_id"))
         prompt = req.get("prompt")
         if isinstance(prompt, str):
             try:
@@ -1379,6 +1541,8 @@ class Gateway:
             deadline=(time.monotonic() + timeout_s) if timeout_s > 0 else None,
             stream=bool(req.get("stream", False)),
             adapter_id=adapter_id,
+            return_logits=bool(req.get("return_logits", False)),
+            resume=resume,
         )
 
     async def _completions(self, headers, body, reader, writer):
@@ -1442,6 +1606,16 @@ class Gateway:
             # async track (interleaved trees, colliding flow ids). The bare
             # id is still what x-request-id echoes.
             trace.track = f"{trace_id}:{greq.rid}"
+        if greq.resume is not None:
+            # cross-process resume: fleet-wide admission already happened on
+            # the prefill worker — parking it behind the fair queue would
+            # double-charge its tenant and could deadlock a full queue
+            self._admit_resume(greq)
+            if greq.stream:
+                await self._respond_stream(greq, reader, writer)
+            else:
+                await self._respond_unary(greq, reader, writer)
+            return
         try:
             self._fair.push(greq, greq.tenant, greq.priority, cost=greq.cost,
                             adapter=greq.adapter_id)
@@ -1563,6 +1737,15 @@ class Gateway:
                     payload = json.dumps(self._chunk(greq, [], ev[1]))
                     writer.write(f"data: {payload}\n\n".encode())
                     break
+                elif kind == "handoff":
+                    # cross-process migration: the stream ends HERE with the
+                    # handoff descriptor — the router (the only client that
+                    # ever sees this event) consumes it, resumes the request
+                    # on a decode worker, and stitches that worker's stream
+                    # onto everything already relayed
+                    writer.write(f"data: {json.dumps({'handoff': ev[1]})}\n\n"
+                                 .encode())
+                    break
             writer.write(b"data: [DONE]\n\n")
             await writer.drain()
         except ConnectionError:
@@ -1574,6 +1757,7 @@ class Gateway:
         eof_task = asyncio.ensure_future(self._watch_eof(reader))
         toks = []
         finish_reason = None
+        handoff = None
         try:
             while True:
                 ev = await self._next_event(greq, eof_task)
@@ -1598,6 +1782,13 @@ class Gateway:
                 elif kind == "cancelled":
                     finish_reason = ev[1]
                     break
+                elif kind == "handoff":
+                    # cross-process migration: partial response — the tokens
+                    # decoded so far plus the descriptor the router needs to
+                    # resume the request on a decode worker and concatenate
+                    finish_reason = "handoff"
+                    handoff = ev[1]
+                    break
             if finish_reason == "deadline" and not toks:
                 await self._json(writer, 504,
                                  {"error": {"message": "deadline expired"}},
@@ -1606,7 +1797,7 @@ class Gateway:
             if self.telemetry.enabled:
                 self.telemetry.histogram("gateway/ttfb_ms",
                                          (time.monotonic() - greq.enq_ts) * 1e3)
-            await self._json(writer, 200, {
+            out = {
                 "id": f"cmpl-{greq.rid}", "object": "text_completion",
                 "model": type(self.engine.module).__name__,
                 "choices": [{"index": 0,
@@ -1616,7 +1807,17 @@ class Gateway:
                 "usage": {"prompt_tokens": int(len(greq.prompt)),
                           "completion_tokens": len(toks),
                           "total_tokens": int(len(greq.prompt)) + len(toks)},
-            }, extra=[("x-request-id", greq.trace_id)])
+            }
+            if handoff is not None:
+                out["handoff"] = handoff
+            if greq.return_logits and greq.handle is not None:
+                # float32 -> JSON double is exact: the logits survive the
+                # process boundary bitwise (the multihost identity matrix
+                # asserts on them)
+                out["logits"] = [np.asarray(step, np.float32).tolist()
+                                 for step in greq.handle._req.logits]
+            await self._json(writer, 200, out,
+                             extra=[("x-request-id", greq.trace_id)])
         except ConnectionError:
             self._client_gone(greq)
         finally:

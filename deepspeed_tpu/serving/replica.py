@@ -835,6 +835,78 @@ class ReplicaSet:
                 self.migrations_failed += 1
         return len(records)
 
+    def inject_resume(self, desc, on_token=None, trace=None,
+                      collect_logits=False):
+        """Cross-process migration, decode side: rebuild the request a
+        PREFILL WORKER handed off (its descriptor carries the prompt,
+        sampling params, and where the KV is parked) and park it in this
+        fleet's migration queue as a READY record whose entry points at the
+        remote shard. ``admit_migrations`` then pulls it through the exact
+        in-process adoption path — ``admit_migration`` restores the KV
+        (the NetPrefixStore fetches the bytes from the owner over HTTP) and
+        decode resumes bit-identically: the rebuilt request carries the
+        original seed (sampling keys fold ABSOLUTE step indices), the
+        already-decoded tokens, and the original budget rounding. Returns
+        the request's :class:`~deepspeed_tpu.inference.scheduler.
+        SchedulerHandle` (fleet-pumped until adoption). Raises ValueError
+        on a descriptor this fleet cannot honor."""
+        from ..inference.scheduler import (SchedulerHandle, _Request,
+                                           _round_up)
+        from ..memory.net_store import RemoteEntry
+        if desc.get("adapter_id") is not None:
+            raise ValueError("cross-process resume does not carry adapter "
+                             "page pins; route adapter traffic to a worker "
+                             "with the adapter resident instead")
+        sched = self.primary
+        if sched.kv_tier is None:
+            raise ValueError("resume requires the hierarchical KV tier as "
+                             "the migration transport (continuous_batching."
+                             "disaggregation or hierarchical_kv)")
+        with self._lock:
+            self._mig_id += 1
+            rid = -self._mig_id  # never collides with submit()'s own rids
+        req = _Request(rid, np.asarray(desc["prompt"], np.int32),
+                       int(desc["max_new_tokens"]), desc.get("eos_token_id"),
+                       bool(desc.get("do_sample", False)),
+                       float(desc.get("temperature", 1.0)),
+                       int(desc.get("top_k", 0)),
+                       float(desc.get("top_p", 1.0)),
+                       int(desc.get("seed", 0)), bool(collect_logits),
+                       sched.telemetry.now(), on_token=on_token, trace=trace)
+        # tokens the prefill side's final fused sync already decoded (and
+        # already streamed): part of the KV rows, and the absolute decode
+        # step the sampling keys fold continues from len(out)
+        req.out = [int(t) for t in desc.get("done_tokens", ())]
+        if len(req.out) >= req.max_new_tokens:
+            raise ValueError("resume descriptor is already complete")
+        req.migrating = True
+        # the same overshoot rounding submit() stamped on the original
+        # request: admission sizes extent chains against it
+        budget = _round_up(req.max_new_tokens, sched.steps_per_sync)
+        if sched.spec_tokens > 0:
+            budget = max(budget, req.max_new_tokens + sched._spec_width - 1)
+        req.row_budget = int(budget)
+        handle = SchedulerHandle(self._pump_proxy, req)
+        req.handle = handle
+        key = tuple(int(t) for t in desc["key"])
+        entry = RemoteEntry(key, int(desc["kv_len"]), int(desc["version"]),
+                            int(desc.get("nbytes", 0)), True,
+                            desc["owner_url"], desc.get("owner_wid"))
+        record = _Migration(req, key, None, time.monotonic())
+        record.kv_len = int(desc["kv_len"])
+        record.version = int(desc["version"])
+        record.entry = entry
+        record.ready = True
+        with self._lock:
+            self._migrations.append(record)
+        tel = self.telemetry
+        if tel.enabled:
+            tel.counter("serving/migrations")
+        cb = self.on_migration_ready
+        if cb is not None:
+            cb()
+        return handle
+
     # ---------------------------------------------------------------- dispatch
     def _sticky_key(self, prompt, adapter=None):
         # the adapter id is part of the prefix identity: a prefix cached

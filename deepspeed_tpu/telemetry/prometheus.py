@@ -29,6 +29,10 @@ _COMM_RE = re.compile(r"^comm/(?P<op>[^/]+)/(?P<group>[^/]+)/bytes$")
 _REPLICA_RE = re.compile(r"^serving/replica/(?P<replica>\d+)/(?P<metric>.+)$")
 _ADAPTER_RE = re.compile(r"^serving/adapter/(?P<adapter>.+)/"
                          r"(?P<metric>loads|evicts|requests|tokens)$")
+# multi-host serving (serving/router.py): per-worker fleet families fold
+# into one labeled series per metric, same shape as per-replica — the
+# router caps wid cardinality at 256 labels before these ever render
+_WORKER_RE = re.compile(r"^serving/worker/(?P<worker>[^/]+)/(?P<metric>.+)$")
 
 _PREFIX = "dstpu_"
 
@@ -73,6 +77,10 @@ def _counter_series(raw_name):
     if m:
         return (_name("serving/replica/" + m.group("metric")) + "_total",
                 [("replica", m.group("replica"))])
+    m = _WORKER_RE.match(raw_name)
+    if m:
+        return (_name("serving/worker/" + m.group("metric")) + "_total",
+                [("worker", m.group("worker"))])
     m = _ADAPTER_RE.match(raw_name)
     if m:  # per-adapter multi-LoRA counters: one labeled family per metric.
         # "per_adapter" (not "adapter") keeps the labeled family's name
@@ -91,6 +99,10 @@ def _gauge_series(raw_name):
     if m:
         return (_name("serving/replica/" + m.group("metric")),
                 [("replica", m.group("replica"))])
+    m = _WORKER_RE.match(raw_name)
+    if m:
+        return (_name("serving/worker/" + m.group("metric")),
+                [("worker", m.group("worker"))])
     return _name(raw_name), []
 
 

@@ -1,0 +1,610 @@
+"""Multi-host serving tests: router tier + cross-process worker fleet +
+networked prefix/handoff store.
+
+Two layers:
+
+- In-process units (tier-1): capacity math merging, the store directory's
+  prefix/version semantics, lease expiry reclaiming orphaned handoffs,
+  leaf serialization bitwise round-trip, router placement (sticky /
+  least-loaded / sick exclusion / fleet Retry-After), and the per-worker
+  Prometheus family fold.
+
+- Spawned-subprocess fleet tests (slow lane — ``tests/slow_tests.txt``):
+  a REAL router process fronting worker processes on localhost (CPU, tiny
+  model), asserting the ISSUE's acceptance bars: 2-process fleet token
+  streams AND logits bit-identical to a 1-process run (greedy + sampled ×
+  radix hit/cold), zero new XLA programs per worker beyond the
+  single-process set, worker death mid-decode sheds instead of sinking the
+  fleet, and cross-host prefix restore matching local restore bitwise.
+"""
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.memory.net_store import (NetPrefixStore, StoreDirectory,
+                                            deserialize_leaves,
+                                            serialize_leaves)
+from deepspeed_tpu.memory.prefix_store import GlobalPrefixStore
+from deepspeed_tpu.serving import capacity_math
+from deepspeed_tpu.serving.replica import _MIG_SENTINEL
+from deepspeed_tpu.serving.router import Router, _Worker
+
+PROMPT = list(range(5, 70))  # > one prefill chunk: chunked prefill really runs
+
+
+# ======================================================================
+# capacity math (satellite a: shared helper, no double counting)
+# ======================================================================
+
+def _sig(**kw):
+    base = {"queued": 0, "inflight": 0, "sched_backlog": 0,
+            "prefill_backlog": 0, "total_slots": 4, "prefill_slots": 4,
+            "decode_slots": 4, "ema_service_s": None, "disaggregated": False}
+    base.update(kw)
+    return base
+
+
+def test_estimate_retry_after_monotone_and_clamped():
+    idle = capacity_math.estimate_retry_after(_sig(), 600)
+    busy = capacity_math.estimate_retry_after(
+        _sig(queued=12, inflight=4, ema_service_s=2.0), 600)
+    assert 1 <= idle <= busy
+    assert capacity_math.estimate_retry_after(
+        _sig(queued=10_000, ema_service_s=60.0), 600) == 600
+
+
+def test_estimate_phase_aware_takes_bottleneck():
+    # decode side saturated, prefill idle: the estimate must reflect the
+    # decode bottleneck, not the blended average
+    blended = capacity_math.estimate_retry_after(
+        _sig(inflight=8, ema_service_s=4.0), 600)
+    split = capacity_math.estimate_retry_after(
+        _sig(inflight=8, ema_service_s=4.0, disaggregated=True,
+             prefill_slots=2, decode_slots=2), 600)
+    assert split >= blended
+
+
+def test_merge_signals_sums_depths_and_detects_phase_split():
+    merged = capacity_math.merge_signals([
+        _sig(queued=2, inflight=1, ema_service_s=1.0),
+        _sig(queued=4, inflight=3, ema_service_s=3.0)])
+    assert merged["queued"] == 6 and merged["inflight"] == 4
+    assert merged["total_slots"] == 8
+    assert merged["ema_service_s"] == pytest.approx(2.0)
+    assert not merged["disaggregated"]
+    # a process-level phase split (prefill-role worker contributes zero
+    # decode slots) flips the merged fleet into phase-aware math
+    merged = capacity_math.merge_signals([
+        _sig(decode_slots=0), _sig(prefill_slots=0)])
+    assert merged["disaggregated"]
+
+
+def test_merge_signals_empty_fleet():
+    merged = capacity_math.merge_signals([])
+    assert merged["total_slots"] == 0
+    assert capacity_math.estimate_retry_after(merged, 600) >= 1
+
+
+# ======================================================================
+# store directory + networked shard (in-process)
+# ======================================================================
+
+def test_directory_longest_prefix_same_version_only():
+    d = StoreDirectory()
+    d.register("w0", "http://a", (1, 2, 3, 4), 4, 7, 64, False)
+    d.register("w1", "http://b", (1, 2), 2, 7, 32, False)
+    d.register("w2", "http://c", (1, 2, 3, 4, 5, 6), 6, 9, 96, False)
+    hit = d.probe((1, 2, 3, 4, 5, 9), 7)
+    assert hit["wid"] == "w0" and hit["match_len"] == 4
+    # version 9's longer entry is invisible at version 7 (weights-version
+    # stamp is the consistency contract, cross-host included)
+    assert d.probe((1, 2, 3, 4, 5, 6), 7)["wid"] == "w0"
+    # a mid-entry divergence is not a usable hit
+    d2 = StoreDirectory()
+    d2.register("w0", "http://a", (1, 2, 3, 4), 4, 7, 64, False)
+    assert d2.probe((1, 2, 9), 7) is None
+    # self-exclusion: a shard's own records never probe remote
+    assert d.probe((1, 2, 3, 4), 7, exclude_wid="w0")["wid"] == "w1"
+
+
+def test_directory_drop_worker_and_reregister_semantics():
+    d = StoreDirectory()
+    d.register("w0", "http://a", (1, 2), 2, 1, 8, False)
+    d.register("w1", "http://b", (3, 4), 2, 1, 8, False)
+    assert d.drop_worker("w0") == 1
+    assert d.probe((1, 2), 1) is None
+    assert d.probe((3, 4), 1)["wid"] == "w1"
+
+
+def test_serialize_leaves_bitwise_roundtrip():
+    leaves = [np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+              (np.arange(8, dtype=np.int8) - 4).reshape(2, 4),
+              np.asarray([[1.5, -2.25]], np.float16)]
+    meta, blob = serialize_leaves(leaves)
+    back = deserialize_leaves(meta, blob)
+    assert len(back) == len(leaves)
+    for a, b in zip(leaves, back):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+
+
+def test_lease_expiry_reclaims_orphaned_handoff():
+    """ISSUE acceptance: an unclaimed cross-process handoff is reclaimed on
+    lease expiry — owner shard frees the pinned rows, directory record
+    drops — while a claimed (popped) handoff never expires."""
+    local = GlobalPrefixStore(capacity_bytes=1 << 20)
+    directory = StoreDirectory()
+    net = NetPrefixStore(local, directory, "w0", "http://127.0.0.1:1",
+                         lease_s=0.05)
+    leaves = [np.ones((2, 3), np.float32)]
+    orphan = (_MIG_SENTINEL, 7, 1)
+    claimed = (_MIG_SENTINEL, 7, 2)
+    assert net.put(orphan, leaves, 3, origin=1, pinned=True, length=2)
+    assert net.put(claimed, [x.copy() for x in leaves], 3, origin=1,
+                   pinned=True, length=2)
+    assert directory.stats()["handoffs"] == 2
+    # claim one before expiry (the decode side's restore pop)
+    entry = net.get_exact(claimed)
+    assert net.pop(entry, consume=True) is not None
+    time.sleep(0.1)
+    assert net.reap_expired() == 1          # only the orphan
+    assert net.get_exact(orphan) is None    # rows freed
+    assert directory.probe(orphan, 3) is None
+    assert net.leases_expired == 1
+    # router-side reap is idempotent with owner-side (record already gone)
+    assert directory.reap() == 0
+
+
+def test_plain_prefix_put_has_no_lease():
+    local = GlobalPrefixStore(capacity_bytes=1 << 20)
+    directory = StoreDirectory()
+    net = NetPrefixStore(local, directory, "w0", "http://127.0.0.1:1",
+                         lease_s=0.01)
+    assert net.put((10, 11, 12), [np.ones((3, 2), np.float32)], 1,
+                   origin=1, length=3)
+    time.sleep(0.05)
+    assert net.reap_expired() == 0
+    assert directory.probe((10, 11, 12, 13), 1) is not None
+    assert directory.stats()["handoffs"] == 0
+
+
+def test_pinned_extent_pages_never_advertised():
+    # pinned NON-handoff entries (long-context extent pages) are slot-local
+    local = GlobalPrefixStore(capacity_bytes=1 << 20)
+    directory = StoreDirectory()
+    net = NetPrefixStore(local, directory, "w0", "http://127.0.0.1:1")
+    assert net.put((-5, 1, 2), [np.ones((2, 2), np.float32)], 1,
+                   origin=1, pinned=True, length=2)
+    assert directory.stats()["entries"] == 0
+
+
+def test_remote_probe_miss_and_fetch_failure_degrade():
+    """Directory points at a dead owner: probe returns a RemoteEntry, pop
+    degrades to None (cold prefill), never raises."""
+    local = GlobalPrefixStore(capacity_bytes=1 << 20)
+    directory = StoreDirectory()
+    directory.register("w9", "http://127.0.0.1:9", (1, 2, 3), 3, 1, 64, False)
+    net = NetPrefixStore(local, directory, "w0", "http://127.0.0.1:1",
+                         fetch_timeout_s=0.2)
+    m, entry = net.probe((1, 2, 3, 4), 1)
+    assert m == 3 and entry is not None and entry.leaves is None
+    assert net.pop(entry, consume=False) is None
+    assert net.net_errors >= 1
+    assert net.stats()["remote_probe_hits"] == 1
+
+
+# ======================================================================
+# router placement (in-process, no sockets)
+# ======================================================================
+
+def _mk_worker(wid, role="mixed", **sig):
+    w = _Worker(wid, f"http://127.0.0.1:9{len(wid)}", role, 64, 0, _sig(**sig))
+    return w
+
+
+def test_router_placement_least_loaded_then_sticky():
+    r = Router()
+    idle = _mk_worker("idle", ema_service_s=1.0)
+    busy = _mk_worker("busy", queued=6, inflight=4, ema_service_s=1.0)
+    r.workers = {"idle": idle, "busy": busy}
+    chosen = r._place(PROMPT)
+    assert chosen is idle
+    # repeat with the same leading chunk: sticky beats load
+    busy.signals = _sig(ema_service_s=0.01)
+    assert r._place(PROMPT) is idle
+    assert r._place(list(range(500, 600))) is not None  # different prefix ok
+
+
+def test_router_placement_excludes_sick_and_stale():
+    r = Router(heartbeat_timeout_s=0.05)
+    w0, w1 = _mk_worker("w0"), _mk_worker("w1")
+    r.workers = {"w0": w0, "w1": w1}
+    w0.sick = True
+    assert r._place(PROMPT) is w1
+    w1.last_seen -= 1.0  # heartbeat stale
+    assert r._place(PROMPT) is None
+    assert r._fleet_retry_after() >= 1  # empty fleet still answers
+
+
+def test_router_placement_phase_roles_and_degraded_fallback():
+    r = Router()
+    pre = _mk_worker("pre", role="prefill")
+    dec = _mk_worker("dec", role="decode")
+    r.workers = {"pre": pre, "dec": dec}
+    assert r._place(PROMPT, phase="prefill") is pre
+    assert r._place(PROMPT, phase="decode") is dec
+    # degraded: no decode-capable worker left -> any live worker (the
+    # owner-loopback colocation fallback)
+    dec.sick = True
+    assert r._place(PROMPT, phase="decode") is pre
+
+
+def test_router_fleet_retry_after_skips_draining_workers():
+    """Satellite a: a draining worker's backlog must not count against
+    capacity it no longer advertises — no double counting."""
+    r = Router()
+    live = _mk_worker("live", queued=1, ema_service_s=1.0)
+    drain = _mk_worker("drain", queued=500, ema_service_s=9.0)
+    drain.draining = True
+    r.workers = {"live": live, "drain": drain}
+    ra = r._fleet_retry_after()
+    both = capacity_math.estimate_retry_after(capacity_math.merge_signals(
+        [live.signals, drain.signals]), 600)
+    assert ra <= both and ra <= 2
+
+
+def test_worker_merged_signals_zero_opposite_phase():
+    pre = _mk_worker("pre", role="prefill")
+    assert pre.merged_signals()["decode_slots"] == 0
+    dec = _mk_worker("dec", role="decode")
+    assert dec.merged_signals()["prefill_slots"] == 0
+    merged = capacity_math.merge_signals(
+        [pre.merged_signals(), dec.merged_signals()])
+    assert merged["disaggregated"]
+
+
+# ======================================================================
+# per-worker Prometheus families (satellite b)
+# ======================================================================
+
+def test_prometheus_worker_labeled_families():
+    from deepspeed_tpu.telemetry import prometheus as prom
+    snap = {"counters": {
+        "serving/router/requests": {"count": 3, "total": 3},
+        "serving/worker/w0/tokens": {"count": 5, "total": 5},
+        "serving/worker/w1/tokens": {"count": 7, "total": 7}},
+        "gauges": {}, "histograms": {}, "uptime_s": 1.0}
+    text = prom.render(snap, extra_gauges={
+        "serving/worker/w0/up": 1.0, "serving/worker/w1/up": 0.0})
+    assert 'dstpu_serving_worker_tokens_total{worker="w0"} 5' in text
+    assert 'dstpu_serving_worker_tokens_total{worker="w1"} 7' in text
+    assert 'dstpu_serving_worker_up{worker="w0"} 1' in text
+    assert "dstpu_serving_router_requests_total 3" in text
+    # one contiguous family: exactly one TYPE header for the folded metric
+    assert text.count("# TYPE dstpu_serving_worker_tokens_total") == 1
+
+
+def test_router_prom_snapshot_renders():
+    r = Router()
+    r.workers = {"w0": _mk_worker("w0")}
+    r.counters["requests"] += 2
+    from deepspeed_tpu.telemetry import prometheus as prom
+    text = prom.render(r._prom_snapshot(), extra_gauges=r._prom_extra())
+    assert "dstpu_serving_router_requests_total 2" in text
+    assert 'dstpu_serving_worker_up{worker="w0"}' in text
+    assert "dstpu_router_workers 1" in text
+
+
+def test_router_worker_label_cardinality_cap():
+    r = Router()
+    r.workers = {f"w{i}": _mk_worker(f"w{i}") for i in range(300)}
+    extra = r._prom_extra()
+    labeled = {k.split("/")[2] for k in extra
+               if k.startswith("serving/worker/")}
+    assert len(labeled) == 257  # 256 real wids + __other__
+    assert "__other__" in labeled
+
+
+# ======================================================================
+# spawned-subprocess fleet (slow lane)
+# ======================================================================
+
+def _spawn_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # single-device workers: the forced 8-device pytest mesh is an
+    # in-process conftest artifact; fleet workers each own a 1-device CPU
+    # mesh (the cross-process contract under test is identical)
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def _read_ready(proc, token, timeout=240):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError(f"process exited before {token}")
+        if token in line:
+            return json.loads(line[line.index("{"):])
+    raise AssertionError(f"no {token} within {timeout}s")
+
+
+def _launch_router(extra=()):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepspeed_tpu.serving", "--router",
+         "--port", "0", "--heartbeat-timeout-s", "5", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_spawn_env(),
+        text=True)
+    info = _read_ready(proc, "ROUTER_READY")
+    return proc, info["port"]
+
+
+def _launch_worker(router_port, wid, role="mixed", extra=()):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepspeed_tpu.serving", "--worker",
+         "--router-url", f"http://127.0.0.1:{router_port}",
+         "--worker-id", wid, "--worker-role", role, "--model", "tiny",
+         "--dtype", "float32", "--port", "0", "--hierarchical-kv",
+         "--heartbeat-s", "0.5", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_spawn_env(),
+        text=True)
+    info = _read_ready(proc, "GATEWAY_READY")
+    return proc, info["port"]
+
+
+def _launch_solo():
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepspeed_tpu.serving", "--model", "tiny",
+         "--dtype", "float32", "--port", "0", "--hierarchical-kv"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_spawn_env(),
+        text=True)
+    info = _read_ready(proc, "GATEWAY_READY")
+    return proc, info["port"]
+
+
+def _post(port, body, timeout=240):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions", json.dumps(body),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def _get_json(port, path, timeout=30):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        return json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+def _stream_tokens(port, body, timeout=240):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("POST", "/v1/completions",
+                     json.dumps(dict(body, stream=True)),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()[:300]
+        raw = resp.read().decode()
+    finally:
+        conn.close()
+    toks, done = [], False
+    for line in raw.splitlines():
+        if line.startswith("data: {"):
+            ev = json.loads(line[5:])
+            assert "handoff" not in ev  # never leaks past the router
+            toks += ev.get("choices", [{}])[0].get("token_ids", [])
+        elif line.startswith("data: [DONE]"):
+            done = True
+    return toks, done
+
+
+def _wait_live(router_port, n, timeout=240):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        doc = _get_json(router_port, "/v1/workers")
+        live = [w for w in doc["workers"] if w["status"] == "active"]
+        if len(live) >= n:
+            return doc["workers"]
+        time.sleep(0.5)
+    raise AssertionError(f"fewer than {n} live workers: {doc}")
+
+
+def _terminate(*procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    """One router + two mixed workers + a solo 1-process baseline."""
+    procs = []
+    try:
+        router, rport = _launch_router()
+        procs.append(router)
+        for wid in ("w0", "w1"):
+            proc, _ = _launch_worker(rport, wid)
+            procs.append(proc)
+        workers = _wait_live(rport, 2)
+        solo, sport = _launch_solo()
+        procs.append(solo)
+        yield {"rport": rport, "sport": sport, "workers": workers}
+    finally:
+        _terminate(*procs)
+
+
+def _matrix_cases():
+    return [
+        ("greedy", {"prompt": PROMPT, "max_tokens": 8}),
+        ("sampled", {"prompt": PROMPT, "max_tokens": 8, "do_sample": True,
+                     "temperature": 0.8, "top_k": 12, "seed": 1234}),
+    ]
+
+
+def test_fleet_bit_identity_matrix(fleet):
+    """Acceptance bar: 2-process fleet tokens AND logits bit-identical to
+    the 1-process run, greedy + sampled, cold AND radix-hit admission."""
+    for name, body in _matrix_cases():
+        for pass_name in ("cold", "hit"):  # second pass admits via radix
+            _, sb = _post(fleet["sport"], dict(body, return_logits=True))
+            sdoc = json.loads(sb)
+            _, rb = _post(fleet["rport"], dict(body, return_logits=True))
+            rdoc = json.loads(rb)
+            stoks = sdoc["choices"][0]["token_ids"]
+            rtoks = rdoc["choices"][0]["token_ids"]
+            assert rtoks == stoks, (name, pass_name, rtoks, stoks)
+            assert rdoc["logits"] == sdoc["logits"], (name, pass_name)
+            # streamed tokens match the unary run bit-for-bit too — on BOTH
+            # surfaces (also keeps the solo/fleet compiled-program sets
+            # comparable: same traffic mix, logits and non-logits variants)
+            solo_toks, solo_done = _stream_tokens(fleet["sport"], body)
+            assert solo_toks == stoks and solo_done, (name, pass_name)
+            toks, done = _stream_tokens(fleet["rport"], body)
+            assert toks == stoks and done, (name, pass_name, toks)
+
+
+def test_fleet_zero_new_programs_per_worker(fleet):
+    """Acceptance bar: no worker compiled more XLA programs than the solo
+    1-process baseline serving the same traffic."""
+    solo_metrics = _get_json(fleet["sport"], "/v1/metrics")
+    solo_compiled = solo_metrics["scheduler"]["compiled_programs"]
+    for w in _get_json(fleet["rport"], "/v1/workers")["workers"]:
+        port = int(w["url"].rsplit(":", 1)[1])
+        compiled = _get_json(port, "/v1/metrics")["scheduler"]["compiled_programs"]
+        assert compiled <= solo_compiled, (w["wid"], compiled, solo_compiled)
+
+
+def test_cross_host_prefix_restore_bit_identical(fleet):
+    """Flush worker A's radix (demoting every cached prefix into its shard,
+    directory-visible), then serve the same prompt on worker B directly:
+    B restores A's rows over the wire and the result is bitwise equal."""
+    prompt = list(range(200, 280))
+    body = {"prompt": prompt, "max_tokens": 6, "return_logits": True}
+    workers = _get_json(fleet["rport"], "/v1/workers")["workers"]
+    ports = {w["wid"]: int(w["url"].rsplit(":", 1)[1]) for w in workers}
+    st, ab = _post(ports["w0"], body)      # A computes + radix-caches
+    assert st == 200, ab[:300]
+    adoc = json.loads(ab)
+    conn = http.client.HTTPConnection("127.0.0.1", ports["w0"], timeout=120)
+    conn.request("POST", "/v1/debug/flush_radix", b"{}",
+                 {"Content-Type": "application/json"})
+    flushed = json.loads(conn.getresponse().read())
+    conn.close()
+    assert flushed["flushed"], flushed
+    before = _get_json(ports["w1"], "/v1/metrics")["net_store"]
+    st, bb = _post(ports["w1"], body)      # B: local miss -> remote restore
+    assert st == 200, bb[:300]
+    bdoc = json.loads(bb)
+    assert bdoc["choices"][0]["token_ids"] == adoc["choices"][0]["token_ids"]
+    assert bdoc["logits"] == adoc["logits"]
+    after = _get_json(ports["w1"], "/v1/metrics")["net_store"]
+    assert after["remote_restores"] > before["remote_restores"]
+    assert after["net_bytes_in"] > before["net_bytes_in"]
+
+
+def test_disagg_fleet_handoff_bit_identical():
+    """Prefill-role + decode-role workers: the request crosses processes
+    mid-flight (prefill -> networked handoff -> decode) and the stitched
+    stream is bit-identical to the solo run; the router consumed the
+    handoff (handoff_resumes moved, no handoff event reached the client)."""
+    procs = []
+    try:
+        router, rport = _launch_router()
+        procs.append(router)
+        procs.append(_launch_worker(rport, "pre", role="prefill")[0])
+        procs.append(_launch_worker(rport, "dec", role="decode")[0])
+        _wait_live(rport, 2)
+        solo, sport = _launch_solo()
+        procs.append(solo)
+        for name, body in _matrix_cases():
+            _, sb = _post(sport, dict(body, return_logits=True))
+            sdoc = json.loads(sb)
+            _, rb = _post(rport, dict(body, return_logits=True))
+            rdoc = json.loads(rb)
+            assert (rdoc["choices"][0]["token_ids"]
+                    == sdoc["choices"][0]["token_ids"]), name
+            assert rdoc["logits"] == sdoc["logits"], name
+            toks, done = _stream_tokens(rport, body)
+            assert toks == sdoc["choices"][0]["token_ids"] and done, name
+        m = _get_json(rport, "/v1/metrics")
+        assert m["router"]["handoff_resumes"] >= 2
+        stats = {w["wid"]: w["stats"] for w in m["workers"]}
+        assert stats["pre"].get("handoffs_out", 0) >= 1 or \
+            stats["dec"].get("resumed_in", 0) >= 1
+    finally:
+        _terminate(*procs)
+
+
+def test_worker_death_mid_decode_sheds_not_sinks():
+    """SIGKILL one worker mid-stream: its stream ends (truncated, no
+    silent re-run), the router marks it sick, and the SURVIVOR keeps
+    serving new requests — the fleet sheds, it does not sink."""
+    procs = []
+    try:
+        router, rport = _launch_router()
+        procs.append(router)
+        w0, _ = _launch_worker(rport, "w0")
+        procs.append(w0)
+        w1, _ = _launch_worker(rport, "w1")
+        procs.append(w1)
+        _wait_live(rport, 2)
+        # identify the victim FIRST: a short probe records the sticky
+        # mapping, so the long stream with the same prompt lands on the
+        # same worker and the kill can follow the first token immediately
+        st, body = _post(rport, {"prompt": PROMPT, "max_tokens": 1})
+        assert st == 200, body[:300]
+        victim = survivor_wid = None
+        for w in _get_json(rport, "/v1/workers")["workers"]:
+            if w["routed"] > 0:
+                victim = w0 if w["wid"] == "w0" else w1
+                survivor_wid = "w1" if w["wid"] == "w0" else "w0"
+        assert victim is not None
+        conn = http.client.HTTPConnection("127.0.0.1", rport, timeout=240)
+        conn.request("POST", "/v1/completions",
+                     json.dumps({"prompt": PROMPT, "max_tokens": 48,
+                                 "stream": True}),
+                     {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        first = resp.fp.readline()
+        assert first.startswith(b"data:")
+        victim.send_signal(signal.SIGKILL)
+        raw = first + resp.fp.read()  # stream must END, not hang
+        conn.close()
+        assert b"data: [DONE]" not in raw  # honest truncation
+        # the fleet still serves: retries land on the survivor
+        deadline = time.time() + 120
+        served = False
+        while time.time() < deadline and not served:
+            st, body = _post(rport, {"prompt": PROMPT, "max_tokens": 4},
+                             timeout=120)
+            served = st == 200
+            if not served:
+                time.sleep(1.0)
+        assert served, (st, body[:300])
+        m = _get_json(rport, "/v1/metrics")
+        assert m["router"]["worker_sick"] >= 1
+        states = {w["wid"]: w["status"] for w in m["workers"]}
+        assert states[survivor_wid] == "active"
+    finally:
+        _terminate(*procs)

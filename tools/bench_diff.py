@@ -57,12 +57,19 @@ import sys
 # the same stream = a twitchier controller); "shed"/"programs" already
 # ride their tokens, and ttft_p95_static_over_autoscaled keeps the
 # higher-is-better ratio default.
+# multihost leg notes: "sick" marks router health churn (a worker going
+# sick during the same fixed stream is a fleet regression) and "retries"
+# marks shed-and-retry re-placements; net_bytes_{in,out} read lower-is-
+# better via the compound below (more store bytes moved for an identical
+# stream = worse placement locality); tokens_per_sec / scaling_efficiency
+# / speedup_vs_single_process keep the higher-is-better default.
 _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
                  "shed", "evict", "evictions", "evicts", "miss", "misses",
                  "s", "seconds", "loss", "ppl", "perplexity", "spill",
                  "spills", "dropped", "swaps", "degradation", "pending",
                  "failed", "loads", "replays", "programs", "gap",
-                 "ttft", "itl", "preempted", "resize", "resizes"}
+                 "ttft", "itl", "preempted", "resize", "resizes",
+                 "sick", "retries"}
 # long_context leg notes: "ttft"/"itl" read lower-is-better on their own so
 # ms-less variants (ttft_p50, itl_p95) resolve too; new_programs_after_first_ctx
 # rides "programs" (a length mix that compiles mid-stream is the regression);
@@ -75,10 +82,11 @@ _LOWER_TOKENS = {"ms", "latency", "stall", "err", "error", "errors", "wait",
 
 def _lower_better(path):
     leaf = path.split(".")[-1].lower()
-    # explicit compounds: bytes_per_token (kv/weight traffic) and step_ms
-    # (the fused_block leg's per-decode-step wall time) read lower-is-better
+    # explicit compounds: bytes_per_token (kv/weight traffic), step_ms (the
+    # fused_block leg's per-decode-step wall time), and net_bytes (the
+    # multihost leg's cross-process store traffic) read lower-is-better
     # even though their leading token alone wouldn't resolve them
-    if "bytes_per_token" in leaf or "step_ms" in leaf:
+    if "bytes_per_token" in leaf or "step_ms" in leaf or "net_bytes" in leaf:
         return True
     return any(tok in _LOWER_TOKENS for tok in leaf.split("_"))
 

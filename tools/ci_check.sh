@@ -2,7 +2,7 @@
 # One-invocation CI entrypoint: tier-1 core lane + the perf-regression
 # guards (compile-count bound for the continuous-batching scheduler).
 #
-#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane + fusedblock lane + longctx lane + autoscale lane
+#   tools/ci_check.sh            # tier-1 + guards + offload lane + gateway smoke + observability lane + rlhf lane + sharded lane + hierkv lane + multilora lane + disagg lane + moe lane + capacity lane + fusedblock lane + longctx lane + autoscale lane + multihost lane
 #   tools/ci_check.sh --guards   # guards only (fast pre-push check)
 #   tools/ci_check.sh --gateway  # gateway smoke only
 #   tools/ci_check.sh --offload  # offload-streaming lane only
@@ -17,6 +17,7 @@
 #   tools/ci_check.sh --fusedblock # fused llama-family decode-block lane only
 #   tools/ci_check.sh --longctx  # long-context serving (multi-extent KV + seq-parallel prefill) lane only
 #   tools/ci_check.sh --autoscale # elastic fleet control plane (autoscaler/brownout/elastic resize) lane only
+#   tools/ci_check.sh --multihost # multi-host router/worker-fleet + networked store lane only
 #   tools/ci_check.sh --bench-diff [NEW.json]  # advisory bench-round diff only
 #
 # Exit code is nonzero if any lane fails. DOTS_PASSED echoes the tier-1
@@ -244,6 +245,27 @@ autoscale_lane() {
     -q -p no:cacheprovider
 }
 
+multihost_lane() {
+  echo "== multi-host serving lane =="
+  # router tier + cross-process worker fleet + networked prefix/handoff
+  # store guards, run UNFILTERED (the spawned-subprocess nodeids live in
+  # slow_tests.txt to keep tier-1 in budget): a 2-process fleet behind the
+  # router BIT-identical (tokens AND logits, greedy + sampled x radix
+  # hit/cold, unary + SSE) to the 1-process gateway, zero XLA programs per
+  # worker beyond the solo set, cross-host prefix restore bitwise equal to
+  # local with net_store counters moving, prefill->decode handoff across
+  # PROCESSES stitched into one client stream, SIGKILL mid-decode shedding
+  # (honest truncation + survivor keeps serving + sick marking), handoff
+  # lease expiry reclaiming orphaned entries, directory version/coverage
+  # semantics, capacity_math fleet merging (no draining double-count), and
+  # the per-worker labeled Prometheus families under the 256-label cap.
+  # The matching perf leg is `python bench.py serving` ("multihost" entry:
+  # 1 vs 2 process aggregate tok/s + TTFT p95, BENCH_SERVING_MULTIHOST
+  # knob, scaling_efficiency reported).
+  timeout -k 10 900 env JAX_PLATFORMS=cpu python -m pytest \
+    tests/unit/serving/test_multihost.py -q -p no:cacheprovider
+}
+
 bench_diff() {
   echo "== bench diff (advisory) =="
   # diff the given fresh bench JSON (or the latest committed round) against
@@ -327,6 +349,10 @@ if [ "${1:-}" = "--autoscale" ]; then
   autoscale_lane
   exit $?
 fi
+if [ "${1:-}" = "--multihost" ]; then
+  multihost_lane
+  exit $?
+fi
 if [ "${1:-}" = "--bench-diff" ]; then
   bench_diff "${2:-}"
   exit $?
@@ -384,7 +410,10 @@ lc_rc=$?
 autoscale_lane
 as_rc=$?
 
+multihost_lane
+mh_rc=$?
+
 # advisory: surfaces last round's bench regressions, never fails the build
 bench_diff
 
-[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ] && [ "$fb_rc" -eq 0 ] && [ "$lc_rc" -eq 0 ] && [ "$as_rc" -eq 0 ]
+[ "$t1_rc" -eq 0 ] && [ "$g_rc" -eq 0 ] && [ "$o_rc" -eq 0 ] && [ "$gw_rc" -eq 0 ] && [ "$ob_rc" -eq 0 ] && [ "$rl_rc" -eq 0 ] && [ "$sh_rc" -eq 0 ] && [ "$hk_rc" -eq 0 ] && [ "$ml_rc" -eq 0 ] && [ "$dg_rc" -eq 0 ] && [ "$me_rc" -eq 0 ] && [ "$cp_rc" -eq 0 ] && [ "$fb_rc" -eq 0 ] && [ "$lc_rc" -eq 0 ] && [ "$as_rc" -eq 0 ] && [ "$mh_rc" -eq 0 ]
